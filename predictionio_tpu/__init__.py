@@ -1,0 +1,18 @@
+"""predictionio_tpu — a TPU-native ML server framework.
+
+A brand-new framework with the capability surface of Apache PredictionIO
+(event-collection server + pluggable storage, DASE engine templates,
+train/deploy/eval/batch-predict lifecycle, CLI) on an idiomatic JAX/XLA
+substrate: algorithms are pure functions over pytrees, training is sharded
+over a `jax.sharding.Mesh` with collectives compiled by XLA over ICI/DCN,
+models persist via Orbax-style checkpoints, and serving keeps models
+TPU-resident with batched jit dispatch.
+"""
+
+__version__ = "0.1.0"
+
+from .data.datamap import DataMap, PropertyMap
+from .data.event import Event
+from .data.bimap import BiMap
+
+__all__ = ["DataMap", "PropertyMap", "Event", "BiMap", "__version__"]

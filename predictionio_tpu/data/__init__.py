@@ -1,0 +1,25 @@
+"""Data layer: event model, property maps, aggregation, storage, stores."""
+
+from .datamap import DataMap, DataMapError, PropertyMap
+from .event import Event, EventValidationError, SPECIAL_EVENTS
+from .bimap import BiMap
+from .aggregation import (
+    EventOp,
+    aggregate_properties,
+    aggregate_properties_ordered,
+    aggregate_properties_single,
+)
+
+__all__ = [
+    "DataMap",
+    "DataMapError",
+    "PropertyMap",
+    "Event",
+    "EventValidationError",
+    "SPECIAL_EVENTS",
+    "BiMap",
+    "EventOp",
+    "aggregate_properties",
+    "aggregate_properties_ordered",
+    "aggregate_properties_single",
+]

@@ -1,0 +1,206 @@
+"""Property aggregation: replaying ``$set/$unset/$delete`` into entity state.
+
+Behavior parity with the reference's two aggregators:
+
+- the commutative ``EventOp`` monoid used for parallel aggregation
+  (``data/.../storage/PEventAggregator.scala:30-151``: ``SetProp.++`` per-field
+  latest-time merge, ``UnsetProp.++``, ``DeleteEntity.++``, ``EventOp.++``
+  at :96-111 and ``toPropertyMap`` at :113-151), and
+- the time-ordered fold used for local aggregation
+  (``data/.../storage/LEventAggregator.scala:42-141``).
+
+The monoid form is the important one for the TPU build: it is
+order-insensitive and associative, so host-side shards of the event log can
+be aggregated independently and merged — the same property that let the
+reference run it under Spark's ``aggregateByKey``. The fold form is used on
+the serving path for single-entity lookups.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from .datamap import DataMap, PropertyMap
+from .event import Event, to_millis
+
+#: Event names that drive property aggregation.
+AGGREGATION_EVENTS = ("$set", "$unset", "$delete")
+
+
+@dataclass(frozen=True)
+class EventOp:
+    """Commutative, associative summary of an entity's property events.
+
+    ``set_fields`` maps field name → (value, set-time-millis); ``set_t`` is
+    the latest ``$set`` time (a ``$set`` with no fields still moves it);
+    ``unset_fields`` maps field name → latest unset-time; ``delete_t`` is the
+    latest ``$delete`` time. ``merge`` is the monoid ``++``.
+    """
+
+    set_fields: Dict[str, Tuple[Any, int]] = field(default_factory=dict)
+    set_t: Optional[int] = None
+    unset_fields: Dict[str, int] = field(default_factory=dict)
+    delete_t: Optional[int] = None
+    first_updated: Optional[datetime] = None
+    last_updated: Optional[datetime] = None
+
+    @staticmethod
+    def from_event(e: Event) -> "EventOp":
+        t = e.event_time_millis
+        if e.event == "$set":
+            return EventOp(
+                set_fields={k: (v, t) for k, v in e.properties.items()},
+                set_t=t, first_updated=e.event_time, last_updated=e.event_time)
+        if e.event == "$unset":
+            return EventOp(
+                unset_fields={k: t for k in e.properties.keys()},
+                first_updated=e.event_time, last_updated=e.event_time)
+        if e.event == "$delete":
+            return EventOp(
+                delete_t=t, first_updated=e.event_time, last_updated=e.event_time)
+        return EventOp()
+
+    def merge(self, other: "EventOp") -> "EventOp":
+        """Order-insensitive combine: per-field latest-write-wins."""
+        set_fields = dict(self.set_fields)
+        for k, (v, t) in other.set_fields.items():
+            if k not in set_fields or t > set_fields[k][1]:
+                set_fields[k] = (v, t)
+        unset_fields = dict(self.unset_fields)
+        for k, t in other.unset_fields.items():
+            if k not in unset_fields or t > unset_fields[k]:
+                unset_fields[k] = t
+        return EventOp(
+            set_fields=set_fields,
+            set_t=_max_opt(self.set_t, other.set_t),
+            unset_fields=unset_fields,
+            delete_t=_max_opt(self.delete_t, other.delete_t),
+            first_updated=_min_time(self.first_updated, other.first_updated),
+            last_updated=_max_time(self.last_updated, other.last_updated),
+        )
+
+    def to_property_map(self) -> Optional[PropertyMap]:
+        """Materialize current entity properties, or None if the entity does
+        not exist (never ``$set``, or deleted after the last ``$set``).
+        Matches ``EventOp.toPropertyMap`` (``PEventAggregator.scala:113-151``):
+        a field survives unless unset at-or-after its set time, or the entity
+        was deleted at-or-after the *latest* set time; fields set at-or-before
+        a non-superseding delete are dropped.
+        """
+        if self.set_t is None:
+            return None
+        if self.delete_t is not None and self.delete_t >= self.set_t:
+            return None
+        fields = {}
+        for k, (v, t) in self.set_fields.items():
+            if k in self.unset_fields and self.unset_fields[k] >= t:
+                continue
+            if self.delete_t is not None and self.delete_t >= t:
+                continue
+            fields[k] = v
+        assert self.first_updated is not None and self.last_updated is not None
+        return PropertyMap(fields, self.first_updated, self.last_updated)
+
+
+def _max_opt(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)
+
+
+def _min_time(a: Optional[datetime], b: Optional[datetime]) -> Optional[datetime]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return b if to_millis(b) < to_millis(a) else a
+
+
+def _max_time(a: Optional[datetime], b: Optional[datetime]) -> Optional[datetime]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return b if to_millis(b) > to_millis(a) else a
+
+
+def aggregate_properties(events: Iterable[Event]) -> Dict[str, PropertyMap]:
+    """Aggregate a stream of events into per-entity current properties using
+    the commutative monoid (parallel semantics,
+    ``PEventAggregator.aggregateProperties`` at :196-210). Shard-safe: callers
+    may aggregate shards independently and combine with
+    :func:`merge_aggregates`."""
+    ops: Dict[str, EventOp] = {}
+    for e in events:
+        op = EventOp.from_event(e)
+        prev = ops.get(e.entity_id)
+        ops[e.entity_id] = prev.merge(op) if prev is not None else op
+    out: Dict[str, PropertyMap] = {}
+    for entity_id, op in ops.items():
+        pm = op.to_property_map()
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def merge_aggregates(a: Dict[str, EventOp], b: Dict[str, EventOp]) -> Dict[str, EventOp]:
+    """Combine per-shard partial aggregates (the ``combOp`` of the reference's
+    ``aggregateByKey``)."""
+    out = dict(a)
+    for k, op in b.items():
+        prev = out.get(k)
+        out[k] = prev.merge(op) if prev is not None else op
+    return out
+
+
+def partial_aggregate(events: Iterable[Event]) -> Dict[str, EventOp]:
+    """Per-shard partial aggregation (the ``seqOp`` side)."""
+    ops: Dict[str, EventOp] = {}
+    for e in events:
+        op = EventOp.from_event(e)
+        prev = ops.get(e.entity_id)
+        ops[e.entity_id] = prev.merge(op) if prev is not None else op
+    return ops
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
+    """Time-ordered fold for one entity (local semantics,
+    ``LEventAggregator.aggregatePropertiesSingle`` at :73-91): ``$set`` merges
+    right-biased, ``$unset`` drops keys, ``$delete`` resets existence; the
+    entity exists only if the fold ends with a defined map."""
+    dm: Optional[DataMap] = None
+    first: Optional[datetime] = None
+    last: Optional[datetime] = None
+    for e in sorted(events, key=lambda ev: ev.event_time_millis):
+        if e.event not in AGGREGATION_EVENTS:
+            continue
+        if e.event == "$set":
+            dm = e.properties if dm is None else dm.union(e.properties)
+        elif e.event == "$unset":
+            dm = None if dm is None else dm.without(e.properties.keys())
+        elif e.event == "$delete":
+            dm = None
+        first = _min_time(first, e.event_time)
+        last = _max_time(last, e.event_time)
+    if dm is None:
+        return None
+    assert first is not None and last is not None
+    return PropertyMap(dm.to_dict(), first, last)
+
+
+def aggregate_properties_ordered(events: Iterable[Event]) -> Dict[str, PropertyMap]:
+    """Grouped time-ordered fold (``LEventAggregator.aggregateProperties`` at
+    :42-60)."""
+    by_entity: Dict[str, list] = {}
+    for e in events:
+        by_entity.setdefault(e.entity_id, []).append(e)
+    out: Dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        pm = aggregate_properties_single(evs)
+        if pm is not None:
+            out[entity_id] = pm
+    return out

@@ -1,0 +1,341 @@
+"""Storage contracts: event-log DAO and metadata DAOs.
+
+Capability parity with the reference's storage layer:
+
+- ``EventStore`` is the event-log DAO contract, the analogue of ``LEvents``
+  (``data/.../storage/LEvents.scala:40-513``: init/remove/close, insert,
+  batch insert, get, delete, find with the full filter set, aggregate).
+  The reference also had a Spark-RDD flavor (``PEvents.scala:38-189``);
+  here a single contract serves both roles — bulk training reads go through
+  :meth:`EventStore.find` into columnar host shards (see
+  ``predictionio_tpu.data.columnar``) instead of RDD partitions.
+- Metadata entities/DAOs mirror ``Apps.scala:32``, ``AccessKeys.scala:35``,
+  ``Channels.scala:32``, ``EngineInstances.scala:46``,
+  ``EvaluationInstances.scala`` and ``Models.scala:33``.
+
+The reference made every event call async (Scala Futures) because JVM
+threads were cheap and storage remote; here the core contract is synchronous
+and the REST servers wrap calls in executor threads — simpler, and the hot
+training path reads in bulk anyway.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import uuid
+from dataclasses import dataclass, field, replace
+from datetime import datetime
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+from ..datamap import PropertyMap
+from ..event import Event
+
+#: Sentinel for "no filter" on nullable fields, distinguishing "match any"
+#: from "match None" (the reference's Option[Option[String]] trick,
+#: ``LEvents.scala:188``).
+ANY: Any = ...
+
+
+@dataclass(frozen=True)
+class EventFilter:
+    """Filter set of ``LEvents.futureFind`` (``LEvents.scala:188-214``)."""
+
+    start_time: Optional[datetime] = None
+    until_time: Optional[datetime] = None
+    entity_type: Optional[str] = None
+    entity_id: Optional[str] = None
+    event_names: Optional[Sequence[str]] = None
+    target_entity_type: Any = ANY  # ANY | None | str
+    target_entity_id: Any = ANY
+    limit: Optional[int] = None
+    reversed: bool = False
+
+    def matches(self, e: Event) -> bool:
+        if self.start_time is not None and e.event_time < self.start_time:
+            return False
+        if self.until_time is not None and e.event_time >= self.until_time:
+            return False
+        if self.entity_type is not None and e.entity_type != self.entity_type:
+            return False
+        if self.entity_id is not None and e.entity_id != self.entity_id:
+            return False
+        if self.event_names is not None and e.event not in self.event_names:
+            return False
+        if self.target_entity_type is not ANY \
+                and e.target_entity_type != self.target_entity_type:
+            return False
+        if self.target_entity_id is not ANY \
+                and e.target_entity_id != self.target_entity_id:
+            return False
+        return True
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+class EventStore(abc.ABC):
+    """Append-only event log, partitioned by (app_id, channel_id)."""
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize storage for an app/channel (create tables etc.)."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Remove all events of an app/channel."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        """Release client resources."""
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        """Insert one event, returning its event id."""
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        """Insert many events (``LEvents.futureInsertBatch``); backends may
+        override with a faster bulk path."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        """Get an event by id."""
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        """Delete an event by id; True if it existed."""
+
+    @abc.abstractmethod
+    def find(self, app_id: int, channel_id: Optional[int] = None,
+             filter: EventFilter = EventFilter()) -> Iterator[Event]:
+        """Stream events matching the filter, in event-time order
+        (reversed when ``filter.reversed``)."""
+
+    def aggregate_properties(
+            self, app_id: int, channel_id: Optional[int] = None,
+            *, entity_type: str, start_time: Optional[datetime] = None,
+            until_time: Optional[datetime] = None,
+            required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """Replay ``$set/$unset/$delete`` into current per-entity properties
+        (``LEvents.futureAggregateProperties``, ``LEvents.scala:215-278``)."""
+        from ..aggregation import AGGREGATION_EVENTS, aggregate_properties
+        events = self.find(app_id, channel_id, EventFilter(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, event_names=list(AGGREGATION_EVENTS)))
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {k: v for k, v in result.items() if req <= set(v.keys())}
+        return result
+
+    def write(self, events: Iterable[Event], app_id: int,
+              channel_id: Optional[int] = None) -> None:
+        """Bulk write (the ``PEvents.write`` role, ``PEvents.scala:172-185``)."""
+        batch: List[Event] = []
+        for e in events:
+            batch.append(e)
+            if len(batch) >= 1000:
+                self.insert_batch(batch, app_id, channel_id)
+                batch = []
+        if batch:
+            self.insert_batch(batch, app_id, channel_id)
+
+
+# ---------------------------------------------------------------------------
+# Metadata entities
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class App:
+    """``data/.../storage/Apps.scala:32``"""
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    """``data/.../storage/AccessKeys.scala:35``; empty ``events`` means all
+    event names are allowed."""
+    key: str
+    app_id: int
+    events: Sequence[str] = ()
+
+
+@dataclass(frozen=True)
+class Channel:
+    """``data/.../storage/Channels.scala:32``; name validity: 1-16 chars,
+    alphanumeric and dashes (``Channels.scala:70``)."""
+    id: int
+    name: str
+    app_id: int
+
+    @staticmethod
+    def is_valid_name(s: str) -> bool:
+        import re
+        return bool(re.fullmatch(r"[a-zA-Z0-9-]{1,16}", s))
+
+
+#: EngineInstance / EvaluationInstance lifecycle states
+#: (``EngineInstances.scala``: INIT → COMPLETED; eval: EVALCOMPLETED).
+STATUS_INIT = "INIT"
+STATUS_COMPLETED = "COMPLETED"
+STATUS_EVALCOMPLETED = "EVALCOMPLETED"
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """A training run (``data/.../storage/EngineInstances.scala:46-66``)."""
+    id: str
+    status: str
+    start_time: datetime
+    end_time: datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    spark_conf: Dict[str, str] = field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+    def copy(self, **changes: Any) -> "EngineInstance":
+        return replace(self, **changes)
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    """An evaluation run (``data/.../storage/EvaluationInstances.scala``)."""
+    id: str
+    status: str
+    start_time: datetime
+    end_time: datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    spark_conf: Dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+    def copy(self, **changes: Any) -> "EvaluationInstance":
+        return replace(self, **changes)
+
+
+@dataclass(frozen=True)
+class Model:
+    """A persisted model blob keyed by engine-instance id
+    (``data/.../storage/Models.scala:33``)."""
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAO contracts
+# ---------------------------------------------------------------------------
+
+class AppsDAO(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]: ...
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+    @abc.abstractmethod
+    def update(self, app: App) -> None: ...
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> None: ...
+
+
+class AccessKeysDAO(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        """Insert; if ``key`` is empty, generate one (reference generates
+        url-safe base64 of a UUID, ``AccessKeys.scala:46``)."""
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]: ...
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> None: ...
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    @staticmethod
+    def generate_key() -> str:
+        return base64.urlsafe_b64encode(uuid.uuid4().bytes).decode().rstrip("=")
+
+
+class ChannelsDAO(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]: ...
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[Channel]: ...
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> None: ...
+
+
+class EngineInstancesDAO(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str: ...
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> None: ...
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def get_completed(self, engine_id: str, engine_version: str,
+                      engine_variant: str) -> List[EngineInstance]:
+        """COMPLETED instances, latest start-time first
+        (``EngineInstances.scala:74-81``)."""
+
+    def get_latest_completed(self, engine_id: str, engine_version: str,
+                             engine_variant: str) -> Optional[EngineInstance]:
+        """``EngineInstances.getLatestCompleted`` (:83-91)."""
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+
+class EvaluationInstancesDAO(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]: ...
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> None: ...
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> None: ...
+
+
+class ModelsDAO(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> None: ...

@@ -1,0 +1,230 @@
+"""Storage registry: env-var-driven backend bootstrap.
+
+Capability parity with the reference's ``Storage`` object
+(``data/.../storage/Storage.scala:146-466``): configuration comes from
+``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ per-source keys) and
+``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
+(env parse at :158-228), with accessors per repository and a
+``verify_all_data_objects`` smoke check (:372-394) used by ``pio status``.
+
+Where the reference discovered backends reflectively by classname
+convention (:310-337), this registry is an explicit type→factory table —
+same pluggability (register_backend), no classpath scanning.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Mapping, Optional
+
+from .base import (
+    AccessKeysDAO,
+    AppsDAO,
+    ChannelsDAO,
+    EngineInstancesDAO,
+    EvaluationInstancesDAO,
+    EventStore,
+    ModelsDAO,
+    StorageError,
+)
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+_DAO_NAMES = ("events", "apps", "access_keys", "channels",
+              "engine_instances", "evaluation_instances", "models")
+
+
+@dataclass
+class Backend:
+    """Factory bundle for one storage source type."""
+
+    make_client: Callable[[dict], object]
+    daos: Dict[str, Callable[[object], object]] = field(default_factory=dict)
+    close: Callable[[object], None] = lambda c: None
+
+
+_BACKENDS: Dict[str, Backend] = {}
+
+
+def register_backend(type_name: str, backend: Backend) -> None:
+    _BACKENDS[type_name.upper()] = backend
+
+
+def _register_builtins() -> None:
+    from . import memory, sqlite
+
+    register_backend("MEMORY", Backend(
+        make_client=lambda cfg: memory,
+        daos={
+            "events": lambda c: memory.MemoryEventStore(),
+            "apps": lambda c: memory.MemoryApps(),
+            "access_keys": lambda c: memory.MemoryAccessKeys(),
+            "channels": lambda c: memory.MemoryChannels(),
+            "engine_instances": lambda c: memory.MemoryEngineInstances(),
+            "evaluation_instances": lambda c: memory.MemoryEvaluationInstances(),
+            "models": lambda c: memory.MemoryModels(),
+        }))
+
+    register_backend("SQLITE", Backend(
+        make_client=lambda cfg: sqlite.SQLiteClient.from_config(cfg),
+        daos={
+            "events": lambda c: sqlite.SQLiteEventStore(c),
+            "apps": lambda c: sqlite.SQLiteApps(c),
+            "access_keys": lambda c: sqlite.SQLiteAccessKeys(c),
+            "channels": lambda c: sqlite.SQLiteChannels(c),
+            "engine_instances": lambda c: sqlite.SQLiteEngineInstances(c),
+            "evaluation_instances": lambda c: sqlite.SQLiteEvaluationInstances(c),
+            "models": lambda c: sqlite.SQLiteModels(c),
+        },
+        close=lambda c: c.close()))
+
+
+_register_builtins()
+
+
+@dataclass
+class SourceConfig:
+    name: str
+    type: str
+    properties: Dict[str, str] = field(default_factory=dict)
+
+
+class Storage:
+    """One configured storage environment: sources + repository bindings.
+
+    The default configuration (no env vars) is a SQLite file at
+    ``$PIO_HOME/pio.db`` (or ``./pio_data/pio.db``) for all three
+    repositories — the role PGSQL played in the reference's default
+    ``pio-env.sh``. With multiple sources configured, unbound
+    repositories fall back to the alphabetically-first source name
+    (deterministic across processes).
+    """
+
+    def __init__(self, env: Optional[Mapping[str, str]] = None):
+        self.env = dict(env if env is not None else os.environ)
+        self._sources: Dict[str, SourceConfig] = {}
+        self._repos: Dict[str, str] = {}
+        self._clients: Dict[str, object] = {}
+        self._dao_cache: Dict[tuple, object] = {}
+        self._lock = threading.RLock()
+        self._parse_env()
+
+    # -- configuration -----------------------------------------------------
+    def _parse_env(self) -> None:
+        prefix = "PIO_STORAGE_SOURCES_"
+        names = sorted({k[len(prefix):-len("_TYPE")] for k in self.env
+                        if k.startswith(prefix) and k.endswith("_TYPE")})
+        for name in names:
+            props = {}
+            p = f"{prefix}{name}_"
+            for k, v in self.env.items():
+                if k.startswith(p) and k != f"{p}TYPE":
+                    props[k[len(p):]] = v
+            self._sources[name] = SourceConfig(
+                name=name, type=self.env[f"{p}TYPE"].upper(), properties=props)
+
+        for repo in REPOSITORIES:
+            src = self.env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
+            if src is not None:
+                if src not in self._sources:
+                    raise StorageError(
+                        f"repository {repo} references undefined source {src}")
+                self._repos[repo] = src
+
+        if not self._sources:
+            # dev default: one SQLite file for everything
+            home = self.env.get("PIO_HOME", os.path.join(os.getcwd(), "pio_data"))
+            path = self.env.get("PIO_SQLITE_PATH",
+                                os.path.join(home, "pio.db"))
+            self._sources["DEFAULT"] = SourceConfig(
+                name="DEFAULT", type="SQLITE", properties={"PATH": path})
+        default = next(iter(self._sources))
+        for repo in REPOSITORIES:
+            self._repos.setdefault(repo, default)
+
+    # -- accessors ---------------------------------------------------------
+    def _client(self, source_name: str) -> object:
+        with self._lock:
+            if source_name not in self._clients:
+                cfg = self._sources[source_name]
+                backend = _BACKENDS.get(cfg.type)
+                if backend is None:
+                    raise StorageError(f"unknown storage type {cfg.type!r} "
+                                       f"(registered: {sorted(_BACKENDS)})")
+                self._clients[source_name] = backend.make_client(cfg.properties)
+            return self._clients[source_name]
+
+    def _dao(self, repo: str, dao: str):
+        source_name = self._repos[repo]
+        key = (source_name, dao)
+        with self._lock:
+            if key not in self._dao_cache:
+                cfg = self._sources[source_name]
+                backend = _BACKENDS[cfg.type]
+                if dao not in backend.daos:
+                    raise StorageError(
+                        f"storage type {cfg.type!r} has no {dao!r} DAO")
+                self._dao_cache[key] = backend.daos[dao](self._client(source_name))
+            return self._dao_cache[key]
+
+    def events(self) -> EventStore:
+        return self._dao("EVENTDATA", "events")
+
+    def apps(self) -> AppsDAO:
+        return self._dao("METADATA", "apps")
+
+    def access_keys(self) -> AccessKeysDAO:
+        return self._dao("METADATA", "access_keys")
+
+    def channels(self) -> ChannelsDAO:
+        return self._dao("METADATA", "channels")
+
+    def engine_instances(self) -> EngineInstancesDAO:
+        return self._dao("METADATA", "engine_instances")
+
+    def evaluation_instances(self) -> EvaluationInstancesDAO:
+        return self._dao("METADATA", "evaluation_instances")
+
+    def models(self) -> ModelsDAO:
+        return self._dao("MODELDATA", "models")
+
+    # -- ops ---------------------------------------------------------------
+    def verify_all_data_objects(self) -> None:
+        """Instantiate every repository DAO and smoke-test the event store
+        (``Storage.verifyAllDataObjects``, ``Storage.scala:372-394``)."""
+        for dao in _DAO_NAMES:
+            repo = ("EVENTDATA" if dao == "events"
+                    else "MODELDATA" if dao == "models" else "METADATA")
+            self._dao(repo, dao)
+        ev = self.events()
+        ev.init(0)
+        ev.remove(0)
+
+    def close(self) -> None:
+        with self._lock:
+            for name, client in self._clients.items():
+                _BACKENDS[self._sources[name].type].close(client)
+            self._clients.clear()
+            self._dao_cache.clear()
+
+
+_global: Optional[Storage] = None
+_global_lock = threading.Lock()
+
+
+def get_storage(refresh: bool = False) -> Storage:
+    """Process-wide storage environment (lazily built from os.environ)."""
+    global _global
+    with _global_lock:
+        if _global is None or refresh:
+            _global = Storage()
+        return _global
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    """Override the process-wide storage (tests, embedded use)."""
+    global _global
+    with _global_lock:
+        _global = storage

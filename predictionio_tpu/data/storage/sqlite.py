@@ -1,0 +1,498 @@
+"""SQLite storage backend — the durable dev default.
+
+Plays the role the reference's JDBC backend played
+(``storage/jdbc/src/main/scala/.../JDBCLEvents.scala`` event tables
+``events_<appId>[_<channelId>]``, ``JDBCApps/JDBCAccessKeys/...`` metadata
+tables), on Python's built-in sqlite3: one database file holds the event
+log, metadata, and model blobs. WAL mode + a process-wide write lock give
+safe concurrent access from server executor threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Iterator, List, Optional
+
+from ..datamap import DataMap
+from ..event import Event, from_millis, new_event_id, to_millis
+from .base import (
+    ANY,
+    AccessKey,
+    AccessKeysDAO,
+    App,
+    AppsDAO,
+    Channel,
+    ChannelsDAO,
+    EngineInstance,
+    EngineInstancesDAO,
+    EvaluationInstance,
+    EvaluationInstancesDAO,
+    EventFilter,
+    EventStore,
+    Model,
+    ModelsDAO,
+    STATUS_COMPLETED,
+    STATUS_EVALCOMPLETED,
+)
+
+
+class SQLiteClient:
+    """Shared connection + write lock for one database file."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.lock = threading.RLock()
+
+    def close(self) -> None:
+        with self.lock:
+            self.conn.close()
+
+    @staticmethod
+    def from_config(config: Optional[dict]) -> "SQLiteClient":
+        path = (config or {}).get("PATH", ":memory:")
+        return SQLiteClient(path)
+
+
+def _table(app_id: int, channel_id: Optional[int]) -> str:
+    return f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
+
+
+class SQLiteEventStore(EventStore):
+    def __init__(self, client: SQLiteClient):
+        self.client = client
+
+    @property
+    def _conn(self) -> sqlite3.Connection:
+        return self.client.conn
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.client.lock:
+            self._conn.execute(f"""
+                CREATE TABLE IF NOT EXISTS {_table(app_id, channel_id)} (
+                    id TEXT PRIMARY KEY,
+                    event TEXT NOT NULL,
+                    entity_type TEXT NOT NULL,
+                    entity_id TEXT NOT NULL,
+                    target_entity_type TEXT,
+                    target_entity_id TEXT,
+                    properties TEXT,
+                    event_time INTEGER NOT NULL,
+                    tags TEXT,
+                    pr_id TEXT,
+                    creation_time INTEGER NOT NULL
+                )""")
+            self._conn.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{_table(app_id, channel_id)}_t "
+                f"ON {_table(app_id, channel_id)} (event_time)")
+            self._conn.commit()
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.client.lock:
+            self._conn.execute(
+                f"DROP TABLE IF EXISTS {_table(app_id, channel_id)}")
+            self._conn.commit()
+        return True
+
+    def close(self) -> None:
+        pass  # client is shared; closed by the registry
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events, app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        rows, ids = [], []
+        for e in events:
+            eid = e.event_id or new_event_id()
+            ids.append(eid)
+            rows.append((
+                eid, e.event, e.entity_type, e.entity_id,
+                e.target_entity_type, e.target_entity_id,
+                e.properties.to_json(), to_millis(e.event_time),
+                json.dumps(list(e.tags)), e.pr_id,
+                to_millis(e.creation_time)))
+        with self.client.lock:
+            try:
+                self._conn.executemany(
+                    f"INSERT OR REPLACE INTO {_table(app_id, channel_id)} "
+                    f"VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+            except sqlite3.OperationalError as e:
+                if "no such table" not in str(e):
+                    raise
+                self.init(app_id, channel_id)
+                self._conn.executemany(
+                    f"INSERT OR REPLACE INTO {_table(app_id, channel_id)} "
+                    f"VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+            self._conn.commit()
+        return ids
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        with self.client.lock:
+            try:
+                cur = self._conn.execute(
+                    f"SELECT * FROM {_table(app_id, channel_id)} WHERE id=?",
+                    (event_id,))
+                row = cur.fetchone()
+            except sqlite3.OperationalError as e:
+                if "no such table" in str(e):
+                    return None
+                raise
+        return _row_to_event(row) if row else None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        with self.client.lock:
+            try:
+                cur = self._conn.execute(
+                    f"DELETE FROM {_table(app_id, channel_id)} WHERE id=?",
+                    (event_id,))
+            except sqlite3.OperationalError as e:
+                if "no such table" in str(e):
+                    return False
+                raise
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def find(self, app_id: int, channel_id: Optional[int] = None,
+             filter: EventFilter = EventFilter()) -> Iterator[Event]:
+        clauses, params = [], []
+        if filter.start_time is not None:
+            clauses.append("event_time >= ?")
+            params.append(to_millis(filter.start_time))
+        if filter.until_time is not None:
+            clauses.append("event_time < ?")
+            params.append(to_millis(filter.until_time))
+        if filter.entity_type is not None:
+            clauses.append("entity_type = ?")
+            params.append(filter.entity_type)
+        if filter.entity_id is not None:
+            clauses.append("entity_id = ?")
+            params.append(filter.entity_id)
+        if filter.event_names is not None:
+            qs = ",".join("?" * len(filter.event_names))
+            clauses.append(f"event IN ({qs})")
+            params.extend(filter.event_names)
+        for col, val in (("target_entity_type", filter.target_entity_type),
+                         ("target_entity_id", filter.target_entity_id)):
+            if val is ANY:
+                continue
+            if val is None:
+                clauses.append(f"{col} IS NULL")
+            else:
+                clauses.append(f"{col} = ?")
+                params.append(val)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        order = " ORDER BY event_time " + ("DESC" if filter.reversed else "ASC")
+        lim = ""
+        if filter.limit is not None and filter.limit >= 0:
+            lim = " LIMIT ?"
+            params.append(filter.limit)
+        sql = f"SELECT * FROM {_table(app_id, channel_id)}{where}{order}{lim}"
+        with self.client.lock:
+            try:
+                rows = self._conn.execute(sql, params).fetchall()
+            except sqlite3.OperationalError as e:
+                if "no such table" in str(e):
+                    return iter(())
+                raise
+        return (_row_to_event(r) for r in rows)
+
+
+def _row_to_event(row) -> Event:
+    (eid, event, etype, eidd, tetype, teid, props, t, tags, pr_id, ct) = row
+    return Event(
+        event=event, entity_type=etype, entity_id=eidd,
+        target_entity_type=tetype, target_entity_id=teid,
+        properties=DataMap.from_json(props) if props else DataMap(),
+        event_time=from_millis(t), tags=tuple(json.loads(tags or "[]")),
+        pr_id=pr_id, creation_time=from_millis(ct), event_id=eid)
+
+
+class _SQLiteMeta:
+    """Shared setup for metadata DAOs."""
+
+    DDL = """
+        CREATE TABLE IF NOT EXISTS apps (
+            id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT UNIQUE NOT NULL,
+            description TEXT);
+        CREATE TABLE IF NOT EXISTS access_keys (
+            key TEXT PRIMARY KEY, app_id INTEGER NOT NULL, events TEXT);
+        CREATE TABLE IF NOT EXISTS channels (
+            id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT NOT NULL,
+            app_id INTEGER NOT NULL);
+        CREATE TABLE IF NOT EXISTS engine_instances (
+            id TEXT PRIMARY KEY, status TEXT, start_time INT,
+            end_time INT, engine_id TEXT, engine_version TEXT,
+            engine_variant TEXT, engine_factory TEXT, batch TEXT,
+            env TEXT, spark_conf TEXT, data_source_params TEXT,
+            preparator_params TEXT, algorithms_params TEXT,
+            serving_params TEXT);
+        CREATE TABLE IF NOT EXISTS evaluation_instances (
+            id TEXT PRIMARY KEY, status TEXT, start_time INT,
+            end_time INT, evaluation_class TEXT,
+            engine_params_generator_class TEXT, batch TEXT, env TEXT,
+            spark_conf TEXT, evaluator_results TEXT,
+            evaluator_results_html TEXT, evaluator_results_json TEXT);
+        CREATE TABLE IF NOT EXISTS models (
+            id TEXT PRIMARY KEY, models BLOB NOT NULL);
+    """
+
+    def __init__(self, client: SQLiteClient):
+        self.client = client
+        with client.lock:
+            client.conn.executescript(self.DDL)
+            client.conn.commit()
+
+    def _exec(self, sql, params=()):
+        with self.client.lock:
+            cur = self.client.conn.execute(sql, params)
+            self.client.conn.commit()
+            return cur
+
+    def _query(self, sql, params=()):
+        with self.client.lock:
+            return self.client.conn.execute(sql, params).fetchall()
+
+
+class SQLiteApps(_SQLiteMeta, AppsDAO):
+    def insert(self, app: App) -> Optional[int]:
+        try:
+            if app.id > 0:
+                cur = self._exec(
+                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                    (app.id, app.name, app.description))
+            else:
+                cur = self._exec(
+                    "INSERT INTO apps (name, description) VALUES (?,?)",
+                    (app.name, app.description))
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id: int) -> Optional[App]:
+        rows = self._query("SELECT id,name,description FROM apps WHERE id=?",
+                           (app_id,))
+        return App(*rows[0]) if rows else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        rows = self._query("SELECT id,name,description FROM apps WHERE name=?",
+                           (name,))
+        return App(*rows[0]) if rows else None
+
+    def get_all(self) -> List[App]:
+        return [App(*r) for r in
+                self._query("SELECT id,name,description FROM apps ORDER BY id")]
+
+    def update(self, app: App) -> None:
+        self._exec("UPDATE apps SET name=?, description=? WHERE id=?",
+                   (app.name, app.description, app.id))
+
+    def delete(self, app_id: int) -> None:
+        self._exec("DELETE FROM apps WHERE id=?", (app_id,))
+
+
+class SQLiteAccessKeys(_SQLiteMeta, AccessKeysDAO):
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        key = access_key.key or self.generate_key()
+        try:
+            self._exec("INSERT INTO access_keys VALUES (?,?,?)",
+                       (key, access_key.app_id,
+                        json.dumps(list(access_key.events))))
+            return key
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        rows = self._query("SELECT * FROM access_keys WHERE key=?", (key,))
+        if not rows:
+            return None
+        k, app_id, events = rows[0]
+        return AccessKey(k, app_id, tuple(json.loads(events or "[]")))
+
+    def get_all(self) -> List[AccessKey]:
+        return [AccessKey(k, a, tuple(json.loads(ev or "[]")))
+                for k, a, ev in self._query("SELECT * FROM access_keys")]
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [AccessKey(k, a, tuple(json.loads(ev or "[]")))
+                for k, a, ev in self._query(
+                    "SELECT * FROM access_keys WHERE app_id=?", (app_id,))]
+
+    def update(self, access_key: AccessKey) -> None:
+        self._exec("UPDATE access_keys SET app_id=?, events=? WHERE key=?",
+                   (access_key.app_id, json.dumps(list(access_key.events)),
+                    access_key.key))
+
+    def delete(self, key: str) -> None:
+        self._exec("DELETE FROM access_keys WHERE key=?", (key,))
+
+
+class SQLiteChannels(_SQLiteMeta, ChannelsDAO):
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        cur = self._exec("INSERT INTO channels (name, app_id) VALUES (?,?)",
+                         (channel.name, channel.app_id))
+        return cur.lastrowid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        rows = self._query("SELECT id,name,app_id FROM channels WHERE id=?",
+                           (channel_id,))
+        return Channel(*rows[0]) if rows else None
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        return [Channel(*r) for r in self._query(
+            "SELECT id,name,app_id FROM channels WHERE app_id=?", (app_id,))]
+
+    def delete(self, channel_id: int) -> None:
+        self._exec("DELETE FROM channels WHERE id=?", (channel_id,))
+
+
+_EI_COLS = ("id,status,start_time,end_time,engine_id,engine_version,"
+            "engine_variant,engine_factory,batch,env,spark_conf,"
+            "data_source_params,preparator_params,algorithms_params,"
+            "serving_params")
+
+
+def _ei_from_row(r) -> EngineInstance:
+    return EngineInstance(
+        id=str(r[0]), status=r[1], start_time=from_millis(r[2]),
+        end_time=from_millis(r[3]), engine_id=r[4], engine_version=r[5],
+        engine_variant=r[6], engine_factory=r[7], batch=r[8],
+        env=json.loads(r[9] or "{}"), spark_conf=json.loads(r[10] or "{}"),
+        data_source_params=r[11], preparator_params=r[12],
+        algorithms_params=r[13], serving_params=r[14])
+
+
+class SQLiteEngineInstances(_SQLiteMeta, EngineInstancesDAO):
+    def insert(self, i: EngineInstance) -> str:
+        iid = i.id or new_event_id()
+        self._exec(
+            f"INSERT INTO engine_instances ({_EI_COLS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (iid, i.status, to_millis(i.start_time), to_millis(i.end_time),
+             i.engine_id, i.engine_version, i.engine_variant,
+             i.engine_factory, i.batch, json.dumps(i.env),
+             json.dumps(i.spark_conf), i.data_source_params,
+             i.preparator_params, i.algorithms_params, i.serving_params))
+        return iid
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        rows = self._query(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE id=?",
+            (instance_id,))
+        return _ei_from_row(rows[0]) if rows else None
+
+    def get_all(self) -> List[EngineInstance]:
+        return [_ei_from_row(r) for r in
+                self._query(f"SELECT {_EI_COLS} FROM engine_instances")]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        rows = self._query(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE status=? AND "
+            "engine_id=? AND engine_version=? AND engine_variant=? "
+            "ORDER BY start_time DESC",
+            (STATUS_COMPLETED, engine_id, engine_version, engine_variant))
+        return [_ei_from_row(r) for r in rows]
+
+    def update(self, i: EngineInstance) -> None:
+        self._exec(
+            "UPDATE engine_instances SET status=?, start_time=?, end_time=?, "
+            "engine_id=?, engine_version=?, engine_variant=?, "
+            "engine_factory=?, batch=?, env=?, spark_conf=?, "
+            "data_source_params=?, preparator_params=?, algorithms_params=?, "
+            "serving_params=? WHERE id=?",
+            (i.status, to_millis(i.start_time), to_millis(i.end_time),
+             i.engine_id, i.engine_version, i.engine_variant,
+             i.engine_factory, i.batch, json.dumps(i.env),
+             json.dumps(i.spark_conf), i.data_source_params,
+             i.preparator_params, i.algorithms_params, i.serving_params,
+             i.id))
+
+    def delete(self, instance_id: str) -> None:
+        self._exec("DELETE FROM engine_instances WHERE id=?", (instance_id,))
+
+
+_EV_COLS = ("id,status,start_time,end_time,evaluation_class,"
+            "engine_params_generator_class,batch,env,spark_conf,"
+            "evaluator_results,evaluator_results_html,evaluator_results_json")
+
+
+def _ev_from_row(r) -> EvaluationInstance:
+    return EvaluationInstance(
+        id=str(r[0]), status=r[1], start_time=from_millis(r[2]),
+        end_time=from_millis(r[3]), evaluation_class=r[4],
+        engine_params_generator_class=r[5], batch=r[6],
+        env=json.loads(r[7] or "{}"), spark_conf=json.loads(r[8] or "{}"),
+        evaluator_results=r[9], evaluator_results_html=r[10],
+        evaluator_results_json=r[11])
+
+
+class SQLiteEvaluationInstances(_SQLiteMeta, EvaluationInstancesDAO):
+    def insert(self, i: EvaluationInstance) -> str:
+        iid = i.id or new_event_id()
+        self._exec(
+            f"INSERT INTO evaluation_instances ({_EV_COLS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            (iid, i.status, to_millis(i.start_time), to_millis(i.end_time),
+             i.evaluation_class, i.engine_params_generator_class, i.batch,
+             json.dumps(i.env), json.dumps(i.spark_conf),
+             i.evaluator_results, i.evaluator_results_html,
+             i.evaluator_results_json))
+        return iid
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        rows = self._query(
+            f"SELECT {_EV_COLS} FROM evaluation_instances WHERE id=?",
+            (instance_id,))
+        return _ev_from_row(rows[0]) if rows else None
+
+    def get_all(self) -> List[EvaluationInstance]:
+        return [_ev_from_row(r) for r in
+                self._query(f"SELECT {_EV_COLS} FROM evaluation_instances")]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        rows = self._query(
+            f"SELECT {_EV_COLS} FROM evaluation_instances WHERE status=? "
+            "ORDER BY start_time DESC", (STATUS_EVALCOMPLETED,))
+        return [_ev_from_row(r) for r in rows]
+
+    def update(self, i: EvaluationInstance) -> None:
+        self._exec(
+            "UPDATE evaluation_instances SET status=?, start_time=?, "
+            "end_time=?, evaluation_class=?, engine_params_generator_class=?, "
+            "batch=?, env=?, spark_conf=?, evaluator_results=?, "
+            "evaluator_results_html=?, evaluator_results_json=? WHERE id=?",
+            (i.status, to_millis(i.start_time), to_millis(i.end_time),
+             i.evaluation_class, i.engine_params_generator_class, i.batch,
+             json.dumps(i.env), json.dumps(i.spark_conf),
+             i.evaluator_results, i.evaluator_results_html,
+             i.evaluator_results_json, i.id))
+
+    def delete(self, instance_id: str) -> None:
+        self._exec("DELETE FROM evaluation_instances WHERE id=?",
+                   (instance_id,))
+
+
+class SQLiteModels(_SQLiteMeta, ModelsDAO):
+    def insert(self, model: Model) -> None:
+        self._exec("INSERT OR REPLACE INTO models VALUES (?,?)",
+                   (model.id, model.models))
+
+    def get(self, model_id: str) -> Optional[Model]:
+        rows = self._query("SELECT id, models FROM models WHERE id=?",
+                           (model_id,))
+        return Model(rows[0][0], bytes(rows[0][1])) if rows else None
+
+    def delete(self, model_id: str) -> None:
+        self._exec("DELETE FROM models WHERE id=?", (model_id,))

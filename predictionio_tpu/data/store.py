@@ -1,0 +1,113 @@
+"""Template-facing event store facades.
+
+Capability parity with the reference's ``PEventStore``/``LEventStore``
+(``data/.../store/PEventStore.scala:35-121``,
+``data/.../store/LEventStore.scala:48-265``): templates address data by
+**app name** (+ optional channel name), and the facade resolves names to
+ids through the metadata DAOs (``store/Common.scala``).
+
+The L/P split collapses here: one facade serves both the bulk training
+reads (events stream into columnar host shards → sharded ``jax.Array``s)
+and the serving-time point lookups (``find_by_entity`` with a deadline).
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from .datamap import PropertyMap
+from .event import Event
+from .storage.base import ANY, EventFilter, StorageError
+from .storage.registry import Storage, get_storage
+
+
+class EventStoreFacade:
+    def __init__(self, storage: Optional[Storage] = None):
+        self._storage = storage
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage if self._storage is not None else get_storage()
+
+    # -- name resolution (store/Common.scala appNameToId) ------------------
+    def resolve(self, app_name: str,
+                channel_name: Optional[str] = None) -> tuple:
+        app = self.storage.apps().get_by_name(app_name)
+        if app is None:
+            raise StorageError(f"App {app_name!r} does not exist; create it "
+                               f"first (pio app new {app_name})")
+        channel_id = None
+        if channel_name is not None:
+            chans = self.storage.channels().get_by_app_id(app.id)
+            match = next((c for c in chans if c.name == channel_name), None)
+            if match is None:
+                raise StorageError(f"Channel {channel_name!r} does not exist "
+                                   f"in app {app_name!r}")
+            channel_id = match.id
+        return app.id, channel_id
+
+    # -- bulk reads (PEventStore.find, :59) --------------------------------
+    def find(self, app_name: str, channel_name: Optional[str] = None,
+             start_time: Optional[datetime] = None,
+             until_time: Optional[datetime] = None,
+             entity_type: Optional[str] = None,
+             entity_id: Optional[str] = None,
+             event_names: Optional[Sequence[str]] = None,
+             target_entity_type=ANY, target_entity_id=ANY,
+             limit: Optional[int] = None,
+             reversed: bool = False) -> Iterator[Event]:
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.storage.events().find(app_id, channel_id, EventFilter(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names, target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit,
+            reversed=reversed))
+
+    # -- property aggregation (PEventStore.aggregateProperties, :99) -------
+    def aggregate_properties(
+            self, app_name: str, entity_type: str,
+            channel_name: Optional[str] = None,
+            start_time: Optional[datetime] = None,
+            until_time: Optional[datetime] = None,
+            required: Optional[Sequence[str]] = None) -> Dict[str, PropertyMap]:
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.storage.events().aggregate_properties(
+            app_id, channel_id, entity_type=entity_type,
+            start_time=start_time, until_time=until_time, required=required)
+
+    # -- serving-time point lookups (LEventStore.findByEntity, :76) --------
+    def find_by_entity(self, app_name: str, entity_type: str, entity_id: str,
+                       channel_name: Optional[str] = None,
+                       event_names: Optional[Sequence[str]] = None,
+                       target_entity_type=ANY, target_entity_id=ANY,
+                       start_time: Optional[datetime] = None,
+                       until_time: Optional[datetime] = None,
+                       limit: Optional[int] = None,
+                       latest: bool = True,
+                       timeout_ms: Optional[int] = None) -> List[Event]:
+        """Blocking point read used by serving-time filters (e.g. the
+        e-commerce template's seen/unavailable lookups). ``timeout_ms``
+        bounds wall-clock like the reference's Duration argument; storage
+        backends here are local so it is a soft deadline check."""
+        t0 = time.monotonic()
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        it = self.storage.events().find(app_id, channel_id, EventFilter(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names, target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit,
+            reversed=latest))
+        out = list(it)
+        if timeout_ms is not None \
+                and (time.monotonic() - t0) * 1000 > timeout_ms:
+            raise TimeoutError(
+                f"find_by_entity exceeded {timeout_ms}ms deadline")
+        return out
+
+
+#: Default facade bound to the process-wide storage — what templates import,
+#: in the position of the reference's `PEventStore`/`LEventStore` objects.
+event_store = EventStoreFacade()

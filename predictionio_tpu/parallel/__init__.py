@@ -1,0 +1,23 @@
+"""Parallelism layer: meshes, shardings, collective helpers."""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    model_sharding,
+    pad_to_multiple,
+    replicated,
+    single_device_mesh,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "make_mesh",
+    "model_sharding",
+    "pad_to_multiple",
+    "replicated",
+    "single_device_mesh",
+]

@@ -1,0 +1,81 @@
+"""Device mesh construction and sharding helpers.
+
+The TPU-native replacement for the reference's Spark substrate: where the
+reference configured a SparkContext (`core/.../workflow/WorkflowContext.scala`)
+and let Spark place RDD partitions, this framework lays out a
+`jax.sharding.Mesh` over the available devices and annotates arrays with
+`NamedSharding`s; XLA inserts the collectives (psum/all_gather/…) that ride
+ICI within a slice and DCN across slices.
+
+Axis convention used throughout the framework:
+- ``data``  — batch/data parallelism (event shards, query micro-batches)
+- ``model`` — model parallelism (factor-matrix rows, embedding shards)
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 2D ``(data, model)`` mesh over the devices.
+
+    With no arguments, uses all devices on the data axis — the mesh-of-1
+    case collapses to single-device jit, which is how the reference's
+    L(local) controller variants map onto this framework (one API,
+    mesh size 1..N).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} needs {data * model} devices, "
+                         f"have {n}")
+    dev = np.asarray(devices[: data * model]).reshape(data, model)
+    return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(data=1, model=1, devices=jax.devices()[:1])
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard leading axis over the data axis, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def model_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard leading axis over the model axis (factor/embedding rows)."""
+    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of ``k`` that is >= ``n`` (shard-even padding)."""
+    return ((n + k - 1) // k) * k
+
+
+@contextmanager
+def maybe_mesh(mesh: Optional[Mesh]):
+    """Enter the mesh context if given; no-op for the single-device path."""
+    if mesh is None:
+        yield
+    else:
+        with mesh:
+            yield

@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference's unit tests run Spark with a `local` master
+(`core/src/test/.../workflow/BaseTest.scala`); the TPU build does better —
+multi-device semantics are exercised on every test run via XLA's virtual
+host devices, so `shard_map`/`pjit` sharding is covered without TPU hardware.
+Must run before jax initializes its backends, hence os.environ at import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8, "expected 8 virtual CPU devices"
+    return make_mesh(data=4, model=2)

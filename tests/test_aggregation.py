@@ -1,0 +1,163 @@
+"""Property-aggregation algebra tests.
+
+Scenario parity with the reference's `LEventAggregatorSpec.scala` and
+`PEventAggregatorSpec.scala`, using the same fixture event sequences as
+`data/src/test/.../storage/TestEvents.scala` (u1/u2 event streams, shuffled
+order, delete-in-the-middle).
+"""
+
+from datetime import datetime, timedelta, timezone
+
+from predictionio_tpu.data import (
+    DataMap,
+    Event,
+    aggregate_properties,
+    aggregate_properties_ordered,
+    aggregate_properties_single,
+)
+from predictionio_tpu.data.aggregation import (
+    merge_aggregates,
+    partial_aggregate,
+)
+
+
+def dt(ms):
+    return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+
+
+U1_BASE = dt(654321)
+U2_BASE = dt(6543210)
+DAY = timedelta(days=1)
+
+
+def set_ev(eid, props, t):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=t)
+
+
+def unset_ev(eid, keys, t):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=t)
+
+
+def delete_ev(eid, t):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=t)
+
+
+# fixture streams from TestEvents.scala
+u1e1 = set_ev("u1", {"a": 1, "b": "value2", "d": [1, 2, 3]}, U1_BASE)
+u1e2 = set_ev("u1", {"a": 2}, U1_BASE + 1 * DAY)
+u1e3 = set_ev("u1", {"b": "value4"}, U1_BASE + 2 * DAY)
+u1e4 = unset_ev("u1", ["b"], U1_BASE + 3 * DAY)
+u1e5 = set_ev("u1", {"e": "new"}, U1_BASE + 4 * DAY)
+u1ed = delete_ev("u1", U1_BASE + 5 * DAY)
+U1_EXPECTED = {"a": 2, "d": [1, 2, 3], "e": "new"}
+U1_LAST = U1_BASE + 4 * DAY
+
+u2e1 = set_ev("u2", {"a": 21, "b": "value12", "d": [7, 5, 6]}, U2_BASE)
+u2e2 = unset_ev("u2", ["a"], U2_BASE + 1 * DAY)
+u2e3 = set_ev("u2", {"b": "value9", "g": "new11"}, U2_BASE + 2 * DAY)
+U2_EXPECTED = {"b": "value9", "d": [7, 5, 6], "g": "new11"}
+U2_LAST = U2_BASE + 2 * DAY
+
+SHUFFLED = [u1e5, u2e2, u1e3, u1e1, u2e3, u2e1, u1e4, u1e2]
+
+
+class TestMonoidAggregation:
+    def test_two_entities(self):
+        result = aggregate_properties(SHUFFLED)
+        assert set(result.keys()) == {"u1", "u2"}
+        assert result["u1"].to_dict() == U1_EXPECTED
+        assert result["u2"].to_dict() == U2_EXPECTED
+        assert result["u1"].first_updated == U1_BASE
+        assert result["u1"].last_updated == U1_LAST
+        assert result["u2"].first_updated == U2_BASE
+        assert result["u2"].last_updated == U2_LAST
+
+    def test_deleted_entity_dropped(self):
+        events = [u1e5, u2e2, u1e3, u1ed, u1e1, u2e3, u2e1, u1e4, u1e2]
+        result = aggregate_properties(events)
+        assert set(result.keys()) == {"u2"}
+        assert result["u2"].to_dict() == U2_EXPECTED
+
+    def test_set_after_delete_recreates(self):
+        revive = set_ev("u1", {"z": 9}, U1_BASE + 6 * DAY)
+        result = aggregate_properties([u1e1, u1ed, revive])
+        assert result["u1"].to_dict() == {"z": 9}
+
+    def test_order_insensitive(self):
+        import itertools
+        events = [u1e1, u1e2, u1e4, u1e3]
+        expected = aggregate_properties(events)["u1"].to_dict()
+        for perm in itertools.permutations(events):
+            assert aggregate_properties(list(perm))["u1"].to_dict() == expected
+
+    def test_shard_merge_matches_global(self):
+        # split the shuffled stream across 3 "hosts", aggregate independently,
+        # merge — must equal the global aggregate (aggregateByKey semantics)
+        shards = [SHUFFLED[0::3], SHUFFLED[1::3], SHUFFLED[2::3]]
+        partials = [partial_aggregate(s) for s in shards]
+        merged = partials[0]
+        for p in partials[1:]:
+            merged = merge_aggregates(merged, p)
+        out = {k: op.to_property_map() for k, op in merged.items()}
+        out = {k: v for k, v in out.items() if v is not None}
+        glob = aggregate_properties(SHUFFLED)
+        assert {k: v.to_dict() for k, v in out.items()} == \
+               {k: v.to_dict() for k, v in glob.items()}
+
+    def test_unset_only_entity_absent(self):
+        result = aggregate_properties([unset_ev("ux", ["a"], U1_BASE)])
+        assert result == {}
+
+    def test_unset_before_set_keeps_field(self):
+        # unset strictly before the set time does not remove the field
+        events = [unset_ev("u", ["a"], U1_BASE),
+                  set_ev("u", {"a": 5}, U1_BASE + DAY)]
+        assert aggregate_properties(events)["u"].to_dict() == {"a": 5}
+
+    def test_unset_at_same_time_removes(self):
+        events = [set_ev("u", {"a": 5}, U1_BASE),
+                  unset_ev("u", ["a"], U1_BASE)]
+        assert aggregate_properties(events)["u"].to_dict() == {}
+
+
+class TestOrderedAggregation:
+    def test_two_entities(self):
+        result = aggregate_properties_ordered(SHUFFLED)
+        assert result["u1"].to_dict() == U1_EXPECTED
+        assert result["u2"].to_dict() == U2_EXPECTED
+        assert result["u1"].first_updated == U1_BASE
+        assert result["u1"].last_updated == U1_LAST
+
+    def test_single_entity(self):
+        pm = aggregate_properties_single([u1e5, u1e3, u1e1, u1e4, u1e2])
+        assert pm is not None
+        assert pm.to_dict() == U1_EXPECTED
+        assert pm.first_updated == U1_BASE
+        assert pm.last_updated == U1_LAST
+
+    def test_delete_in_middle_of_unsorted_stream(self):
+        # LEventAggregatorSpec: delete event placed mid-stream still wins
+        # because fold is over time-sorted events
+        pm = aggregate_properties_single([u1e4, u1e2, u1ed, u1e3, u1e1, u1e5])
+        assert pm is None
+
+    def test_non_special_events_ignored(self):
+        rate = Event(event="rate", entity_type="user", entity_id="u1",
+                     target_entity_type="item", target_entity_id="i1",
+                     properties=DataMap({"rating": 5}),
+                     event_time=U1_BASE + 9 * DAY)
+        pm = aggregate_properties_single([u1e1, rate])
+        assert pm is not None
+        assert pm.to_dict() == {"a": 1, "b": "value2", "d": [1, 2, 3]}
+        assert pm.last_updated == U1_BASE  # rate event doesn't touch updated times
+
+
+class TestMonoidVsOrderedParity:
+    def test_same_result_on_fixture_streams(self):
+        m = aggregate_properties(SHUFFLED)
+        o = aggregate_properties_ordered(SHUFFLED)
+        assert {k: v.to_dict() for k, v in m.items()} == \
+               {k: v.to_dict() for k, v in o.items()}

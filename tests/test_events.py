@@ -1,0 +1,163 @@
+"""Event model + DataMap + BiMap tests.
+
+Scenario parity with the reference specs
+(`data/src/test/.../storage/{DataMapSpec,BiMapSpec}.scala`, validation rules
+from `Event.scala:112-160`).
+"""
+
+import pytest
+
+from predictionio_tpu.data import (
+    BiMap,
+    DataMap,
+    DataMapError,
+    Event,
+    EventValidationError,
+)
+from predictionio_tpu.data.event import isoformat_millis, parse_iso
+
+
+class TestEventValidation:
+    def test_basic_event(self):
+        e = Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 4.0}))
+        assert e.event == "rate"
+        assert e.properties.get("rating", float) == 4.0
+
+    def test_empty_event_name_rejected(self):
+        with pytest.raises(EventValidationError):
+            Event(event="", entity_type="user", entity_id="u1")
+
+    def test_unknown_reserved_event_rejected(self):
+        with pytest.raises(EventValidationError):
+            Event(event="$foo", entity_type="user", entity_id="u1")
+
+    def test_special_event_with_target_rejected(self):
+        with pytest.raises(EventValidationError):
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"a": 1}))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            Event(event="$unset", entity_type="user", entity_id="u1")
+
+    def test_target_must_be_paired(self):
+        with pytest.raises(EventValidationError):
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_id="i1")
+
+    def test_reserved_entity_type_prefix(self):
+        with pytest.raises(EventValidationError):
+            Event(event="view", entity_type="pio_thing", entity_id="x")
+        # built-in type is allowed
+        Event(event="predict", entity_type="pio_pr", entity_id="x")
+
+    def test_reserved_property_prefix(self):
+        with pytest.raises(EventValidationError):
+            Event(event="view", entity_type="user", entity_id="u1",
+                  properties=DataMap({"pio_secret": 1}))
+
+    def test_json_roundtrip(self):
+        e = Event(event="buy", entity_type="user", entity_id="u9",
+                  target_entity_type="item", target_entity_id="i3",
+                  properties=DataMap({"qty": 2, "tags": ["a", "b"]}),
+                  pr_id="pred-1")
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == e.event
+        assert e2.entity_id == e.entity_id
+        assert e2.target_entity_id == e.target_entity_id
+        assert e2.properties == e.properties
+        assert e2.pr_id == e.pr_id
+        assert e2.event_time == e.event_time
+
+    def test_iso_parse_variants(self):
+        t = parse_iso("2026-01-02T03:04:05.678Z")
+        assert isoformat_millis(t) == "2026-01-02T03:04:05.678Z"
+        t2 = parse_iso("2026-01-02T03:04:05.678+00:00")
+        assert t2 == t
+
+
+class TestDataMap:
+    # mirrors DataMapSpec.scala: typed get over a mixed-type object
+    DM = DataMap({
+        "string": "a string",
+        "int": 10,
+        "double": 4.56,
+        "boolean": True,
+        "array": [1, 2, 3],
+        "strings": ["a", "b"],
+        "obj": {"k": 1},
+        "null": None,
+    })
+
+    def test_typed_get(self):
+        assert self.DM.get("string", str) == "a string"
+        assert self.DM.get("int", int) == 10
+        assert self.DM.get("double", float) == 4.56
+        assert self.DM.get("boolean", bool) is True
+        assert self.DM.get_list("array", int) == [1, 2, 3]
+        assert self.DM.get_list("strings", str) == ["a", "b"]
+
+    def test_int_coerces_to_float(self):
+        assert self.DM.get("int", float) == 10.0
+
+    def test_missing_field_raises(self):
+        with pytest.raises(DataMapError):
+            self.DM.get("nope")
+
+    def test_missing_field_default(self):
+        assert self.DM.get("nope", int, default=7) == 7
+
+    def test_get_opt(self):
+        assert self.DM.get_opt("null") is None
+        assert self.DM.get_opt("nope") is None
+        assert self.DM.get_opt("int", int) == 10
+
+    def test_wrong_type_raises(self):
+        with pytest.raises(DataMapError):
+            self.DM.get("string", int)
+        with pytest.raises(DataMapError):
+            self.DM.get("int", bool)
+
+    def test_union_right_biased(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert a.union(b) == DataMap({"x": 1, "y": 3, "z": 4})
+
+    def test_without(self):
+        a = DataMap({"x": 1, "y": 2})
+        assert a.without(["y", "zz"]) == DataMap({"x": 1})
+
+    def test_from_json_string(self):
+        assert DataMap('{"a": 1}') == DataMap({"a": 1})
+
+
+class TestBiMap:
+    # mirrors BiMapSpec.scala
+    def test_inverse(self):
+        m = BiMap({"a": 1, "b": 2})
+        assert m["a"] == 1
+        assert m.inverse[2] == "b"
+        assert m.inverse.inverse["a"] == 1
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_string_int_dense(self):
+        m = BiMap.string_int(["u3", "u1", "u3", "u2", "u1"])
+        assert sorted(m.values()) == [0, 1, 2]
+        assert m["u3"] == 0 and m["u1"] == 1 and m["u2"] == 2
+        assert len(m) == 3
+
+    def test_map_array(self):
+        m = BiMap.string_int(["a", "b", "c"])
+        out = m.map_array(["c", "zz", "a"])
+        assert out.tolist() == [2, -1, 0]
+
+    def test_take(self):
+        m = BiMap.string_int(["a", "b", "c"])
+        t = m.take(["a", "c", "zz"])
+        assert set(t.keys()) == {"a", "c"}

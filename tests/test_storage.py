@@ -1,0 +1,310 @@
+"""Storage-backend conformance suite.
+
+The reference duplicated `LEventsSpec`/`PEventsSpec` per backend
+(`storage/jdbc/src/test`, `storage/hbase/src/test`) as the de-facto DAO
+contract test; here one parametrized suite runs the same scenarios against
+every registered backend.
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import (
+    ANY,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    EventFilter,
+    Model,
+    STATUS_COMPLETED,
+    STATUS_EVALCOMPLETED,
+    STATUS_INIT,
+    Storage,
+)
+from predictionio_tpu.data.storage.memory import (
+    MemoryAccessKeys,
+    MemoryApps,
+    MemoryChannels,
+    MemoryEngineInstances,
+    MemoryEvaluationInstances,
+    MemoryEventStore,
+    MemoryModels,
+)
+from predictionio_tpu.data.storage.sqlite import (
+    SQLiteAccessKeys,
+    SQLiteApps,
+    SQLiteChannels,
+    SQLiteClient,
+    SQLiteEngineInstances,
+    SQLiteEvaluationInstances,
+    SQLiteEventStore,
+    SQLiteModels,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+HOUR = timedelta(hours=1)
+
+APP = 7
+
+
+def ev(name, eid, t, etype="user", **kw):
+    return Event(event=name, entity_type=etype, entity_id=eid,
+                 event_time=t, **kw)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def backend(request, tmp_path):
+    if request.param == "memory":
+        yield {
+            "events": MemoryEventStore(),
+            "apps": MemoryApps(),
+            "access_keys": MemoryAccessKeys(),
+            "channels": MemoryChannels(),
+            "engine_instances": MemoryEngineInstances(),
+            "evaluation_instances": MemoryEvaluationInstances(),
+            "models": MemoryModels(),
+        }
+    else:
+        client = SQLiteClient(str(tmp_path / "test.db"))
+        yield {
+            "events": SQLiteEventStore(client),
+            "apps": SQLiteApps(client),
+            "access_keys": SQLiteAccessKeys(client),
+            "channels": SQLiteChannels(client),
+            "engine_instances": SQLiteEngineInstances(client),
+            "evaluation_instances": SQLiteEvaluationInstances(client),
+            "models": SQLiteModels(client),
+        }
+        client.close()
+
+
+class TestEventStoreConformance:
+    def test_insert_get_delete(self, backend):
+        es = backend["events"]
+        es.init(APP)
+        e = ev("view", "u1", T0, target_entity_type="item",
+               target_entity_id="i1", properties=DataMap({"x": 1}))
+        eid = es.insert(e, APP)
+        got = es.get(eid, APP)
+        assert got is not None
+        assert got.event_id == eid
+        assert got.entity_id == "u1"
+        assert got.target_entity_id == "i1"
+        assert got.properties == DataMap({"x": 1})
+        assert got.event_time == T0
+        assert es.delete(eid, APP) is True
+        assert es.get(eid, APP) is None
+        assert es.delete(eid, APP) is False
+
+    def test_find_time_ordering_and_filters(self, backend):
+        es = backend["events"]
+        es.init(APP)
+        events = [
+            ev("view", "u1", T0 + 2 * HOUR, target_entity_type="item",
+               target_entity_id="i2"),
+            ev("rate", "u1", T0, target_entity_type="item",
+               target_entity_id="i1", properties=DataMap({"rating": 4})),
+            ev("view", "u2", T0 + HOUR, target_entity_type="item",
+               target_entity_id="i1"),
+            ev("$set", "u1", T0 + 3 * HOUR, properties=DataMap({"a": 1})),
+        ]
+        es.insert_batch(events, APP)
+
+        allv = list(es.find(APP))
+        assert [e.event_time for e in allv] == sorted(e.event_time for e in allv)
+        assert len(allv) == 4
+
+        rev = list(es.find(APP, filter=EventFilter(reversed=True, limit=2)))
+        assert len(rev) == 2
+        assert rev[0].event_time == T0 + 3 * HOUR
+
+        u1 = list(es.find(APP, filter=EventFilter(entity_id="u1")))
+        assert len(u1) == 3
+
+        views = list(es.find(APP, filter=EventFilter(event_names=["view"])))
+        assert len(views) == 2
+
+        window = list(es.find(APP, filter=EventFilter(
+            start_time=T0 + HOUR, until_time=T0 + 3 * HOUR)))
+        assert len(window) == 2  # until is exclusive, start inclusive
+
+        tgt = list(es.find(APP, filter=EventFilter(target_entity_id="i1")))
+        assert len(tgt) == 2
+        no_tgt = list(es.find(APP, filter=EventFilter(target_entity_id=None)))
+        assert len(no_tgt) == 1 and no_tgt[0].event == "$set"
+        any_tgt = list(es.find(APP, filter=EventFilter(target_entity_id=ANY)))
+        assert len(any_tgt) == 4
+
+    def test_channel_isolation(self, backend):
+        es = backend["events"]
+        es.init(APP)
+        es.init(APP, 3)
+        es.insert(ev("view", "u1", T0), APP)
+        es.insert(ev("buy", "u1", T0), APP, 3)
+        assert [e.event for e in es.find(APP)] == ["view"]
+        assert [e.event for e in es.find(APP, 3)] == ["buy"]
+
+    def test_app_isolation_and_remove(self, backend):
+        es = backend["events"]
+        es.init(APP)
+        es.init(APP + 1)
+        es.insert(ev("view", "u1", T0), APP)
+        assert list(es.find(APP + 1)) == []
+        assert es.remove(APP)
+        assert list(es.find(APP)) == []
+
+    def test_aggregate_properties_through_store(self, backend):
+        es = backend["events"]
+        es.init(APP)
+        es.insert_batch([
+            ev("$set", "u1", T0, properties=DataMap({"a": 1, "b": 2})),
+            ev("$unset", "u1", T0 + HOUR, properties=DataMap({"b": None})),
+            ev("$set", "u2", T0, properties=DataMap({"a": 9})),
+            ev("$delete", "u2", T0 + HOUR),
+            ev("view", "u1", T0 + 2 * HOUR, target_entity_type="item",
+               target_entity_id="i1"),
+        ], APP)
+        props = es.aggregate_properties(APP, entity_type="user")
+        assert set(props) == {"u1"}
+        assert props["u1"].to_dict() == {"a": 1}
+
+    def test_aggregate_required_keys(self, backend):
+        es = backend["events"]
+        es.init(APP)
+        es.insert_batch([
+            ev("$set", "u1", T0, properties=DataMap({"a": 1})),
+            ev("$set", "u2", T0, properties=DataMap({"a": 1, "b": 2})),
+        ], APP)
+        props = es.aggregate_properties(APP, entity_type="user",
+                                        required=["b"])
+        assert set(props) == {"u2"}
+
+
+class TestMetadataConformance:
+    def test_apps(self, backend):
+        apps = backend["apps"]
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id is not None and app_id > 0
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        apps.update(App(app_id, "myapp", "newdesc"))
+        assert apps.get(app_id).description == "newdesc"
+        id2 = apps.insert(App(0, "app2"))
+        assert {a.name for a in apps.get_all()} == {"myapp", "app2"}
+        apps.delete(app_id)
+        assert apps.get(app_id) is None
+        assert apps.get(id2) is not None
+
+    def test_access_keys(self, backend):
+        keys = backend["access_keys"]
+        k = keys.insert(AccessKey("", 1, ["view", "rate"]))
+        assert k
+        got = keys.get(k)
+        assert got.app_id == 1
+        assert tuple(got.events) == ("view", "rate")
+        k2 = keys.insert(AccessKey("explicit-key", 2, []))
+        assert k2 == "explicit-key"
+        assert {a.key for a in keys.get_by_app_id(1)} == {k}
+        keys.delete(k)
+        assert keys.get(k) is None
+
+    def test_channels(self, backend):
+        ch = backend["channels"]
+        cid = ch.insert(Channel(0, "mychan", 1))
+        assert cid is not None
+        assert ch.get(cid).name == "mychan"
+        assert ch.insert(Channel(0, "bad name!", 1)) is None
+        assert ch.insert(Channel(0, "x" * 17, 1)) is None
+        assert [c.id for c in ch.get_by_app_id(1)] == [cid]
+        ch.delete(cid)
+        assert ch.get(cid) is None
+
+    def test_engine_instances_lifecycle(self, backend):
+        eis = backend["engine_instances"]
+        base = EngineInstance(
+            id="", status=STATUS_INIT, start_time=T0, end_time=T0,
+            engine_id="eng", engine_version="1", engine_variant="default",
+            engine_factory="my.Factory", algorithms_params='[{"als":{}}]')
+        i1 = eis.insert(base)
+        i2 = eis.insert(base.copy(start_time=T0 + HOUR))
+        assert eis.get_latest_completed("eng", "1", "default") is None
+        eis.update(eis.get(i1).copy(status=STATUS_COMPLETED))
+        eis.update(eis.get(i2).copy(status=STATUS_COMPLETED))
+        latest = eis.get_latest_completed("eng", "1", "default")
+        assert latest.id == i2
+        assert latest.algorithms_params == '[{"als":{}}]'
+        assert eis.get_latest_completed("eng", "2", "default") is None
+        eis.delete(i1)
+        assert eis.get(i1) is None
+
+    def test_evaluation_instances(self, backend):
+        evs = backend["evaluation_instances"]
+        i = evs.insert(EvaluationInstance(
+            id="", status=STATUS_INIT, start_time=T0, end_time=T0,
+            evaluation_class="my.Eval"))
+        evs.update(evs.get(i).copy(status=STATUS_EVALCOMPLETED,
+                                   evaluator_results="metric=0.5"))
+        done = evs.get_completed()
+        assert [x.id for x in done] == [i]
+        assert done[0].evaluator_results == "metric=0.5"
+
+    def test_models(self, backend):
+        models = backend["models"]
+        models.insert(Model("inst-1", b"\x00\x01binary"))
+        assert models.get("inst-1").models == b"\x00\x01binary"
+        models.insert(Model("inst-1", b"replaced"))
+        assert models.get("inst-1").models == b"replaced"
+        models.delete("inst-1")
+        assert models.get("inst-1") is None
+
+
+class TestRegistry:
+    def test_default_config_sqlite(self, tmp_path):
+        s = Storage(env={"PIO_HOME": str(tmp_path)})
+        s.verify_all_data_objects()
+        es = s.events()
+        es.init(1)
+        es.insert(ev("view", "u1", T0), 1)
+        assert len(list(es.find(1))) == 1
+        s.close()
+        # durable across re-open
+        s2 = Storage(env={"PIO_HOME": str(tmp_path)})
+        assert len(list(s2.events().find(1))) == 1
+        s2.close()
+
+    def test_env_config_memory(self):
+        s = Storage(env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        s.verify_all_data_objects()
+        assert s.apps().insert(App(0, "a")) == 1
+
+    def test_mixed_sources(self, tmp_path):
+        s = Storage(env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "m.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        assert isinstance(s.events(), MemoryEventStore)
+        assert isinstance(s.apps(), SQLiteApps)
+        s.close()
+
+    def test_unknown_source_rejected(self):
+        import pytest as _pytest
+        from predictionio_tpu.data.storage import StorageError
+        with _pytest.raises(StorageError):
+            Storage(env={
+                "PIO_STORAGE_SOURCES_X_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
+            })

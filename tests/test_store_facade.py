@@ -1,0 +1,78 @@
+"""EventStoreFacade tests: app-name resolution, channels, serving lookups."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Channel, Storage, StorageError
+from predictionio_tpu.data.store import EventStoreFacade
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+HOUR = timedelta(hours=1)
+
+
+@pytest.fixture
+def env():
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    app_id = storage.apps().insert(App(0, "shop"))
+    chan_id = storage.channels().insert(Channel(0, "mobile", app_id))
+    facade = EventStoreFacade(storage)
+    es = storage.events()
+    es.init(app_id)
+    es.init(app_id, chan_id)
+
+    def mk(name, uid, iid, t, chan=None, props=None):
+        e = Event(event=name, entity_type="user", entity_id=uid,
+                  target_entity_type="item", target_entity_id=iid,
+                  event_time=t, properties=DataMap(props or {}))
+        es.insert(e, app_id, chan)
+        return e
+
+    mk("view", "u1", "i1", T0)
+    mk("buy", "u1", "i2", T0 + HOUR)
+    mk("view", "u2", "i1", T0 + 2 * HOUR)
+    mk("view", "u1", "i3", T0 + 3 * HOUR, chan=chan_id)
+    return facade
+
+
+def test_find_by_app_name(env):
+    events = list(env.find("shop"))
+    assert len(events) == 3
+
+
+def test_find_channel(env):
+    events = list(env.find("shop", channel_name="mobile"))
+    assert len(events) == 1
+    assert events[0].target_entity_id == "i3"
+
+
+def test_unknown_app_raises(env):
+    with pytest.raises(StorageError):
+        list(env.find("nope"))
+
+
+def test_unknown_channel_raises(env):
+    with pytest.raises(StorageError):
+        list(env.find("shop", channel_name="nope"))
+
+
+def test_find_by_entity_latest_first(env):
+    events = env.find_by_entity("shop", "user", "u1")
+    assert [e.target_entity_id for e in events] == ["i2", "i1"]
+    events = env.find_by_entity("shop", "user", "u1", event_names=["view"])
+    assert [e.target_entity_id for e in events] == ["i1"]
+
+
+def test_aggregate_properties_by_name(env):
+    es = env.storage.events()
+    app_id, _ = env.resolve("shop")
+    es.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                    properties=DataMap({"price": 10}), event_time=T0), app_id)
+    props = env.aggregate_properties("shop", "item")
+    assert props["i1"].to_dict() == {"price": 10}
