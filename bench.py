@@ -1,0 +1,71 @@
+"""Benchmark: implicit-ALS training throughput on the flagship workload.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload is a synthetic MovieLens-20M-shaped problem (the BASELINE.md
+target: 138k users × 27k items; here scaled by BENCH_SCALE so the default
+run finishes in minutes on one chip). The reference publishes no numbers
+(BASELINE.md: "none found"), so ``vs_baseline`` is measured against a
+recorded MLlib-ALS-equivalent throughput estimate below; until the
+reference is benchmarked on equal hardware this is a bookkeeping ratio,
+not a claim.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+#: Spark-MLlib-local ALS throughput on the same synthetic shape, in rated
+#: entries per second per iteration. Placeholder until measured (the
+#: reference ships no numbers); recorded here so the ratio is stable
+#: across rounds.
+BASELINE_RATINGS_PER_SEC = 2_000_000.0
+
+
+def main():
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    n_users = int(138_000 * scale)
+    n_items = int(27_000 * scale)
+    nnz = int(20_000_000 * scale)
+    rank = 64
+    iterations = 5
+
+    import jax
+
+    from predictionio_tpu.models.als import ALSParams, RatingsCOO, train_als
+
+    rng = np.random.default_rng(0)
+    # zipf-ish popularity for items, uniform users — MovieLens-like skew
+    items = (np.random.default_rng(1).zipf(1.3, size=nnz) % n_items).astype(np.int32)
+    users = rng.integers(0, n_users, nnz).astype(np.int32)
+    vals = np.ones(nnz, dtype=np.float32)
+    ratings = RatingsCOO(users, items, vals, n_users, n_items)
+
+    params = ALSParams(rank=rank, num_iterations=1, implicit_prefs=True,
+                       alpha=40.0, reg=0.01, seed=3, max_history=256)
+
+    # warmup (compile both half-steps)
+    U, V = train_als(ratings, params)
+    jax.block_until_ready((U, V))
+
+    t0 = time.monotonic()
+    params_run = ALSParams(rank=rank, num_iterations=iterations,
+                           implicit_prefs=True, alpha=40.0, reg=0.01,
+                           seed=3, max_history=256)
+    U, V = train_als(ratings, params_run)
+    jax.block_until_ready((U, V))
+    dt = time.monotonic() - t0
+
+    ratings_per_sec = nnz * iterations / dt
+    print(json.dumps({
+        "metric": "als_implicit_train_throughput",
+        "value": round(ratings_per_sec, 1),
+        "unit": "ratings/s/iter",
+        "vs_baseline": round(ratings_per_sec / BASELINE_RATINGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""DASE controller contracts: DataSource, Preparator, Algorithm, Serving.
+
+Capability parity with the reference's controller API
+(``core/.../core/BaseDataSource.scala:34-55``, ``BasePreparator.scala:33-45``,
+``BaseAlgorithm.scala:58-126``, ``BaseServing.scala:31-54``), with the
+L/P/P2L split collapsed: the reference needed three flavors of every
+controller because models lived either on the Spark driver (L), across
+executors as RDDs (P), or were trained parallel and collected local (P2L)
+(``controller/{LAlgorithm,PAlgorithm,P2LAlgorithm}.scala``). Here a model is
+a pytree of (possibly sharded) ``jax.Array``s; mesh size 1..N covers all
+three cases with one API.
+
+Type parameters used informally throughout (Python generics kept light):
+TD training data, PD prepared data, M model, Q query, P prediction,
+A actual (ground truth), EI eval info.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generic, List, Optional, Sequence, Tuple, TypeVar
+
+from .context import Context
+
+TD = TypeVar("TD")
+PD = TypeVar("PD")
+M = TypeVar("M")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+EI = TypeVar("EI")
+
+#: One evaluation fold: (training data, eval info, [(query, actual)]).
+EvalFold = Tuple[TD, EI, List[Tuple[Q, A]]]
+
+
+class SanityCheck(abc.ABC):
+    """Optional self-check hook on data/model objects
+    (``controller/SanityCheck.scala``); the workflow calls it after read,
+    prepare, and train unless skipped."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise if the object is malformed (e.g. empty training data)."""
+
+
+class DataSource(abc.ABC, Generic[TD, EI, Q, A]):
+    """Reads training and evaluation data from the event store
+    (``core/BaseDataSource.scala:43,54``)."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx: Context) -> TD:
+        ...
+
+    def read_eval(self, ctx: Context) -> List[EvalFold]:
+        """Folds of (TD, EI, [(Q, A)]) for evaluation; default: none."""
+        return []
+
+
+class Preparator(abc.ABC, Generic[TD, PD]):
+    """Transforms training data into algorithm input
+    (``core/BasePreparator.scala:44``)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: Context, training_data: TD) -> PD:
+        ...
+
+
+class IdentityPreparator(Preparator):
+    """Pass-through preparator (``controller/IdentityPreparator.scala``)."""
+
+    def __init__(self, params: Any = None):
+        pass
+
+    def prepare(self, ctx: Context, training_data):
+        return training_data
+
+
+class Algorithm(abc.ABC, Generic[PD, M, Q, P]):
+    """The train/predict contract (``core/BaseAlgorithm.scala:69-126``).
+
+    Models should be pytrees of arrays (sharded over ``ctx.mesh`` when
+    large); ``predict`` should be thin host glue around jitted device code
+    so serving stays low-latency.
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx: Context, prepared_data: PD) -> M:
+        ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P:
+        ...
+
+    def batch_predict(self, model: M, queries: Sequence[Q]) -> List[P]:
+        """Bulk prediction for eval/batch jobs
+        (``core/BaseAlgorithm.scala:81``). Override with a vectorized/vmapped
+        implementation where shapes allow; default is a host loop."""
+        return [self.predict(model, q) for q in queries]
+
+    # -- persistence flavor (core/BaseAlgorithm.scala:111-115) -------------
+    def make_persistent_model(self, model: M, engine_instance_id: str,
+                              algo_index: int) -> Any:
+        """Decide how ``model`` persists. Return values:
+
+        - the model itself (or any picklable stand-in): stored in the
+          MODELDATA blob (reference default, Kryo → here pickled numpy
+          pytrees);
+        - a :class:`PersistentModelManifest`: the algorithm saved the model
+          itself (custom checkpoint dir, Orbax, ...), only the manifest is
+          stored;
+        - ``None``: nothing persists; deploy retrains (reference ``Unit``
+          model semantics, ``controller/Engine.scala:210-232``).
+        """
+        from ..workflow.persistence import to_host
+        return to_host(model)
+
+    def load_persistent_model(self, ctx: Context, stored: Any) -> M:
+        """Invert :meth:`make_persistent_model` at deploy time."""
+        from ..workflow.persistence import to_device
+        return to_device(stored)
+
+    #: Optional dataclass type for typed query parsing at the REST boundary
+    #: (the reference's queryClass via reflection, BaseAlgorithm.scala:93).
+    query_class: Optional[type] = None
+
+
+class Serving(abc.ABC, Generic[Q, P]):
+    """Combines per-algorithm predictions into the served result
+    (``core/BaseServing.scala:41,53``)."""
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-predict query enrichment (``BaseServing.supplementBase``)."""
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        ...
+
+
+class FirstServing(Serving):
+    """Serve the first algorithm's prediction
+    (``controller/LFirstServing.scala``)."""
+
+    def __init__(self, params: Any = None):
+        pass
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Average numeric predictions (``controller/LAverageServing.scala``)."""
+
+    def __init__(self, params: Any = None):
+        pass
+
+    def serve(self, query, predictions):
+        return sum(predictions) / len(predictions)
+
+
+class PersistentModelManifest:
+    """Marker stored in place of a model blob when the algorithm persists
+    its own model (``workflow/PersistentModelManifest``); records how to
+    find it again."""
+
+    def __init__(self, location: str, extra: Optional[dict] = None):
+        self.location = location
+        self.extra = extra or {}
+
+    def __repr__(self):
+        return f"PersistentModelManifest({self.location!r})"

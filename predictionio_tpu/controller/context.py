@@ -1,0 +1,66 @@
+"""Workflow context: what flows through every DASE stage.
+
+The TPU-native replacement for the SparkContext the reference threads
+through ``BaseDataSource.readTrainingBase(sc)`` etc.
+(``core/BaseDataSource.scala:43``, ``workflow/WorkflowContext.scala``):
+a :class:`Context` carries the device mesh, the PRNG seed, storage access,
+and workflow options. Controllers receive it everywhere the reference
+passed ``sc``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ..data.storage.registry import Storage, get_storage
+from ..data.store import EventStoreFacade
+from ..parallel.mesh import make_mesh
+
+
+@dataclass
+class Context:
+    """Execution context for train/eval/serve.
+
+    ``mesh`` is the device mesh all sharded computation lays out over —
+    mesh of 1 device ≡ the reference's L(local) mode, mesh of N ≡ P mode;
+    one API for both (SURVEY §2.3).
+    """
+
+    mesh: Optional[Mesh] = None
+    seed: int = 0
+    app_name: str = ""
+    batch: str = ""
+    verbose: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    skip_sanity_check: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+    _storage: Optional[Storage] = None
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage if self._storage is not None else get_storage()
+
+    @property
+    def event_store(self) -> EventStoreFacade:
+        return EventStoreFacade(self._storage)
+
+    def rng(self) -> jax.Array:
+        return jax.random.key(self.seed)
+
+    def with_mesh(self) -> Mesh:
+        """The mesh, defaulting to all local devices on the data axis."""
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        return self.mesh
+
+    def copy(self, **changes) -> "Context":
+        return replace(self, **changes)
+
+
+def default_context(**kw) -> Context:
+    return Context(**kw)

@@ -1,0 +1,205 @@
+"""Engine: binds named DASE component classes and runs generic train/eval.
+
+Capability parity with the reference ``Engine``
+(``controller/Engine.scala:82-88`` class maps; generic ``train`` :623-710
+with sanity checks and stop-after-read/prepare; generic ``eval`` :728-817
+k-fold × algorithms with union/served predictions; ``prepareDeploy``
+:198-267 covering the three persistence flavors).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type, Union
+
+from .base import (
+    Algorithm,
+    DataSource,
+    PersistentModelManifest,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from .context import Context
+from .params import EngineParams, engine_params_from_variant, instantiate
+
+log = logging.getLogger(__name__)
+
+ClassMap = Union[Type, Dict[str, Type]]
+
+
+def _as_map(x: ClassMap) -> Dict[str, Type]:
+    return x if isinstance(x, dict) else {"": x}
+
+
+def _sanity(obj: Any, what: str, skip: bool) -> None:
+    if skip:
+        return
+    if isinstance(obj, SanityCheck):
+        log.info("sanity check %s", what)
+        obj.sanity_check()
+
+
+@dataclass
+class TrainResult:
+    """Everything ``train`` produced: per-algorithm models in params order."""
+
+    models: List[Any]
+    engine_params: EngineParams
+
+
+class Engine:
+    """Named class maps for every DASE slot + generic train/eval."""
+
+    def __init__(self,
+                 datasource_classes: ClassMap,
+                 preparator_classes: ClassMap,
+                 algorithm_classes: ClassMap,
+                 serving_classes: ClassMap,
+                 datasource_params_class: Optional[Type] = None,
+                 preparator_params_class: Optional[Type] = None,
+                 algorithm_params_classes: Optional[Dict[str, Type]] = None,
+                 serving_params_class: Optional[Type] = None):
+        self.datasource_classes = _as_map(datasource_classes)
+        self.preparator_classes = _as_map(preparator_classes)
+        self.algorithm_classes = _as_map(algorithm_classes)
+        self.serving_classes = _as_map(serving_classes)
+        self.datasource_params_class = datasource_params_class
+        self.preparator_params_class = preparator_params_class
+        self.algorithm_params_classes = algorithm_params_classes or {}
+        self.serving_params_class = serving_params_class
+
+    # -- component instantiation ------------------------------------------
+    def _make(self, classes: Dict[str, Type], pair: Tuple[str, Any], slot: str):
+        name, params = pair
+        if name not in classes:
+            raise KeyError(f"{slot} {name!r} not registered "
+                           f"(available: {sorted(classes)})")
+        return instantiate(classes[name], params)
+
+    def make_datasource(self, ep: EngineParams) -> DataSource:
+        return self._make(self.datasource_classes, ep.datasource, "datasource")
+
+    def make_preparator(self, ep: EngineParams) -> Preparator:
+        return self._make(self.preparator_classes, ep.preparator, "preparator")
+
+    def make_algorithms(self, ep: EngineParams) -> List[Algorithm]:
+        return [self._make(self.algorithm_classes, pair, "algorithm")
+                for pair in ep.algorithms]
+
+    def make_serving(self, ep: EngineParams) -> Serving:
+        return self._make(self.serving_classes, ep.serving, "serving")
+
+    def params_from_variant(self, variant: dict) -> EngineParams:
+        return engine_params_from_variant(
+            variant,
+            datasource_params_cls=self.datasource_params_class,
+            preparator_params_cls=self.preparator_params_class,
+            algorithm_params_classes=self.algorithm_params_classes,
+            serving_params_cls=self.serving_params_class)
+
+    # -- train (controller/Engine.scala:623-710) ---------------------------
+    def train(self, ctx: Context, engine_params: EngineParams) -> TrainResult:
+        datasource = self.make_datasource(engine_params)
+        td = datasource.read_training(ctx)
+        _sanity(td, "training data", ctx.skip_sanity_check)
+        if ctx.stop_after_read:
+            log.info("stopping after read")
+            return TrainResult(models=[], engine_params=engine_params)
+
+        preparator = self.make_preparator(engine_params)
+        pd = preparator.prepare(ctx, td)
+        _sanity(pd, "prepared data", ctx.skip_sanity_check)
+        if ctx.stop_after_prepare:
+            log.info("stopping after prepare")
+            return TrainResult(models=[], engine_params=engine_params)
+
+        models = []
+        for i, algo in enumerate(self.make_algorithms(engine_params)):
+            log.info("training algorithm %d: %s", i, type(algo).__name__)
+            model = algo.train(ctx, pd)
+            _sanity(model, f"model[{i}]", ctx.skip_sanity_check)
+            models.append(model)
+        return TrainResult(models=models, engine_params=engine_params)
+
+    # -- eval (controller/Engine.scala:728-817) ----------------------------
+    def eval(self, ctx: Context, engine_params: EngineParams
+             ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        """Returns per-fold ``(eval_info, [(query, served prediction,
+        actual)])``. Trains every algorithm on every fold (the reference's
+        k × algos trainings), predicts with ``batch_predict``, and serves
+        the aligned per-algo predictions."""
+        datasource = self.make_datasource(engine_params)
+        folds = datasource.read_eval(ctx)
+        preparator = self.make_preparator(engine_params)
+        serving = self.make_serving(engine_params)
+        results = []
+        for fold_i, (td, eval_info, qa) in enumerate(folds):
+            pd = preparator.prepare(ctx, td)
+            queries = [serving.supplement(q) for q, _ in qa]
+            actuals = [a for _, a in qa]
+            per_algo: List[List[Any]] = []
+            for algo in self.make_algorithms(engine_params):
+                model = algo.train(ctx, pd)
+                per_algo.append(algo.batch_predict(model, queries))
+            served = [serving.serve(q, [preds[i] for preds in per_algo])
+                      for i, q in enumerate(queries)]
+            results.append((eval_info, list(zip(queries, served, actuals))))
+            log.info("eval fold %d: %d queries", fold_i, len(queries))
+        return results
+
+    def batch_eval(self, ctx: Context, params_list: Sequence[EngineParams]
+                   ) -> List[Tuple[EngineParams, list]]:
+        """Evaluate every params set (``BaseEngine.batchEval``,
+        ``core/BaseEngine.scala:82-91``)."""
+        return [(ep, self.eval(ctx, ep)) for ep in params_list]
+
+    # -- deploy-time model re-materialization (Engine.scala:198-267) -------
+    def prepare_deploy(self, ctx: Context, engine_params: EngineParams,
+                       stored_models: List[Any],
+                       engine_instance_id: str) -> List[Any]:
+        """Turn persisted model stand-ins back into live models:
+        manifest → algorithm's custom loader; None → retrain (the
+        reference's Unit-model path); otherwise the algorithm's
+        ``load_persistent_model`` moves blobs back to device."""
+        algos = self.make_algorithms(engine_params)
+        if len(stored_models) != len(algos):
+            raise ValueError(f"{len(stored_models)} stored models for "
+                             f"{len(algos)} algorithms")
+        needs_retrain = any(m is None for m in stored_models)
+        retrained: Optional[List[Any]] = None
+        if needs_retrain:
+            log.info("ephemeral model(s) present; retraining for deploy")
+            retrained = self.train(ctx, engine_params).models
+        out = []
+        for i, (algo, stored) in enumerate(zip(algos, stored_models)):
+            if stored is None:
+                assert retrained is not None
+                out.append(retrained[i])
+            else:
+                # blob or PersistentModelManifest alike: the algorithm's
+                # loader inverts whatever its make_persistent_model produced
+                out.append(algo.load_persistent_model(ctx, stored))
+        return out
+
+
+class SimpleEngine(Engine):
+    """Single-class engine with identity prep/first serving
+    (``controller/EngineParams.scala:130``)."""
+
+    def __init__(self, datasource_class: Type, algorithm_class: Type, **kw):
+        from .base import FirstServing, IdentityPreparator
+        super().__init__(
+            datasource_classes=datasource_class,
+            preparator_classes=IdentityPreparator,
+            algorithm_classes=algorithm_class,
+            serving_classes=FirstServing, **kw)
+
+
+class EngineFactory:
+    """Convention object templates export (``controller/EngineFactory.scala:31``):
+    subclass or provide a callable returning an :class:`Engine`."""
+
+    def apply(self) -> Engine:
+        raise NotImplementedError

@@ -1,0 +1,203 @@
+"""Evaluation & hyperparameter tuning.
+
+Capability parity with the reference's tuning stack:
+``Evaluation`` couples an engine with metrics
+(``controller/Evaluation.scala:34-125``); ``EngineParamsGenerator`` yields
+the search list (``EngineParamsGenerator.scala:35-41``); ``MetricEvaluator``
+scores every params set and picks the best by ``metric.compare``
+(``controller/MetricEvaluator.scala:218-262``, best at :246-249, JSON
+artifacts at :64-110,193-216).
+
+Improvement over the reference (SURVEY §7 hard part 4): pipeline-prefix
+memoization is built in — the reference recomputes DataSource/Preparator
+(and retrains unchanged algorithms) for every entry of the search grid
+unless templates opt into the experimental ``FastEvalEngine``
+(``controller/FastEvalEngine.scala:52-210``); here the evaluator memoizes
+(datasource params → folds) and (…+preparator params → prepared folds) and
+(…+algorithm params → trained models) keyed by the params JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .context import Context
+from .engine import Engine
+from .metric import Metric
+from .params import EngineParams, params_to_json
+
+log = logging.getLogger(__name__)
+
+
+class EngineParamsGenerator:
+    """Subclass and set ``engine_params_list``."""
+
+    engine_params_list: Sequence[EngineParams] = ()
+
+
+@dataclass
+class Evaluation:
+    """An engine + metric(s) to optimize (``controller/Evaluation.scala``)."""
+
+    engine: Engine
+    metric: Metric
+    other_metrics: Sequence[Metric] = ()
+
+    @property
+    def metrics(self) -> List[Metric]:
+        return [self.metric, *self.other_metrics]
+
+
+@dataclass
+class MetricScores:
+    engine_params: EngineParams
+    score: float
+    other_scores: List[float]
+    train_s: float = 0.0
+    eval_s: float = 0.0
+
+
+@dataclass
+class MetricEvaluatorResult:
+    """Outcome of a sweep (``MetricEvaluator.scala:64-110``)."""
+
+    best_score: float
+    best_engine_params: EngineParams
+    best_index: int
+    metric_header: str
+    other_metric_headers: List[str]
+    scores: List[MetricScores] = field(default_factory=list)
+
+    def to_one_liner(self) -> str:
+        return (f"[{self.metric_header}] best variant {self.best_index}: "
+                f"{self.best_score:.6f}")
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "bestScore": self.best_score,
+            "bestIndex": self.best_index,
+            "bestEngineParams": self.best_engine_params.to_json(),
+            "metricHeader": self.metric_header,
+            "otherMetricHeaders": self.other_metric_headers,
+            "metricScoresList": [
+                {"score": s.score, "otherScores": s.other_scores,
+                 "engineParams": s.engine_params.to_json(),
+                 "trainS": s.train_s, "evalS": s.eval_s}
+                for s in self.scores],
+        }, indent=2)
+
+    def to_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{i}</td><td>{s.score:.6f}</td>"
+            f"<td><pre>{json.dumps(s.engine_params.to_json(), indent=1)}"
+            f"</pre></td></tr>"
+            for i, s in enumerate(self.scores))
+        return (f"<html><body><h1>{self.metric_header}</h1>"
+                f"<p>{self.to_one_liner()}</p>"
+                f"<table border=1><tr><th>#</th><th>score</th>"
+                f"<th>params</th></tr>{rows}</table></body></html>")
+
+
+def _key(pair: Any) -> str:
+    """Cache key for a (name, params) slot pair."""
+    name, params = pair
+    return json.dumps(
+        [name, params_to_json(params) if params is not None else None],
+        sort_keys=True, default=str)
+
+
+class MetricEvaluator:
+    """Scores every engine-params set; memoizes shared pipeline prefixes."""
+
+    def __init__(self, evaluation: Evaluation):
+        self.evaluation = evaluation
+
+    def evaluate(self, ctx: Context,
+                 params_list: Sequence[EngineParams]) -> MetricEvaluatorResult:
+        engine = self.evaluation.engine
+        metric = self.evaluation.metric
+        fold_cache: Dict[str, list] = {}
+        prep_cache: Dict[str, list] = {}
+        model_cache: Dict[str, list] = {}
+        scores: List[MetricScores] = []
+
+        for idx, ep in enumerate(params_list):
+            t0 = time.monotonic()
+            ds_key = _key(ep.datasource)
+            if ds_key not in fold_cache:
+                fold_cache[ds_key] = engine.make_datasource(ep).read_eval(ctx)
+            folds = fold_cache[ds_key]
+            if not folds:
+                raise ValueError(
+                    "DataSource.read_eval returned no folds; evaluation "
+                    "requires read_eval to be implemented")
+
+            prep_key = ds_key + "|" + _key(ep.preparator)
+            if prep_key not in prep_cache:
+                preparator = engine.make_preparator(ep)
+                prep_cache[prep_key] = [
+                    preparator.prepare(ctx, td) for td, _, _ in folds]
+            prepared = prep_cache[prep_key]
+
+            serving = engine.make_serving(ep)
+            eval_data = []
+            t_train = 0.0
+            for fold_i, (pd, (td, ei, qa)) in enumerate(zip(prepared, folds)):
+                queries = [serving.supplement(q) for q, _ in qa]
+                actuals = [a for _, a in qa]
+                per_algo = []
+                for algo_pair, algo in zip(ep.algorithms,
+                                           engine.make_algorithms(ep)):
+                    m_key = prep_key + f"|f{fold_i}|" + _key(algo_pair)
+                    if m_key not in model_cache:
+                        tt = time.monotonic()
+                        model_cache[m_key] = algo.train(ctx, pd)
+                        t_train += time.monotonic() - tt
+                    per_algo.append(
+                        algo.batch_predict(model_cache[m_key], queries))
+                served = [serving.serve(q, [p[i] for p in per_algo])
+                          for i, q in enumerate(queries)]
+                eval_data.append((ei, list(zip(queries, served, actuals))))
+
+            score = metric.calculate(eval_data)
+            others = [m.calculate(eval_data)
+                      for m in self.evaluation.other_metrics]
+            scores.append(MetricScores(
+                engine_params=ep, score=score, other_scores=others,
+                train_s=t_train, eval_s=time.monotonic() - t0))
+            log.info("params %d/%d: %s = %f", idx + 1, len(params_list),
+                     metric.header, score)
+
+        best_index = 0
+        for i in range(1, len(scores)):
+            if metric.compare(scores[i].score, scores[best_index].score) > 0:
+                best_index = i
+        best = scores[best_index]
+        return MetricEvaluatorResult(
+            best_score=best.score,
+            best_engine_params=best.engine_params,
+            best_index=best_index,
+            metric_header=metric.header,
+            other_metric_headers=[m.header for m in
+                                  self.evaluation.other_metrics],
+            scores=scores)
+
+
+def save_best_variant_json(result: MetricEvaluatorResult, path: str,
+                           base_variant: Optional[dict] = None) -> None:
+    """Write the winning params as an engine-variant JSON
+    (``MetricEvaluator.saveEngineJson``, :193-216)."""
+    ep = result.best_engine_params.to_json()
+    variant = dict(base_variant or {})
+    variant.update({
+        "datasource": ep["dataSourceParams"],
+        "preparator": ep["preparatorParams"],
+        "algorithms": ep["algorithmsParams"],
+        "serving": ep["servingParams"],
+    })
+    with open(path, "w") as f:
+        json.dump(variant, f, indent=2)

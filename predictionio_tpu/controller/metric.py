@@ -1,0 +1,126 @@
+"""Metric library.
+
+Capability parity with ``controller/Metric.scala`` (base ``Metric`` with
+Ordering-based ``compare`` :39-57; ``AverageMetric`` :99,
+``OptionAverageMetric`` :124, ``StdevMetric`` :151, ``OptionStdevMetric``
+:179, ``SumMetric`` :205, ``ZeroMetric`` :234). Evaluation data is
+``[(eval_info, [(q, p, a)])]`` — the host-side analogue of the reference's
+``Seq[(EI, RDD[(Q,P,A)])]``; per-point scores aggregate with numpy (the
+``StatsCounter`` union role, :60-96).
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from typing import Any, Generic, List, Optional, Sequence, Tuple, TypeVar
+
+import numpy as np
+
+EI = TypeVar("EI")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+
+EvalData = Sequence[Tuple[EI, Sequence[Tuple[Q, P, A]]]]
+
+
+class Metric(abc.ABC, Generic[EI, Q, P, A]):
+    """Computes a scalar score from evaluation output; larger is better
+    unless ``compare`` is overridden."""
+
+    @abc.abstractmethod
+    def calculate(self, eval_data: EvalData) -> float:
+        ...
+
+    def compare(self, a: float, b: float) -> int:
+        """Ordering for model selection (>0 ⇒ a better)."""
+        return (a > b) - (a < b)
+
+    @property
+    def header(self) -> str:
+        return type(self).__name__
+
+    def __repr__(self) -> str:
+        return self.header
+
+
+class PointwiseMetric(Metric[EI, Q, P, A]):
+    """Base for metrics defined by a per-(q,p,a) score."""
+
+    def calculate_point(self, eval_info: EI, q: Q, p: P, a: A
+                        ) -> Optional[float]:
+        raise NotImplementedError
+
+    def _scores(self, eval_data: EvalData) -> np.ndarray:
+        vals: List[float] = []
+        for ei, qpas in eval_data:
+            for q, p, a in qpas:
+                s = self.calculate_point(ei, q, p, a)
+                if s is not None:
+                    vals.append(float(s))
+        return np.asarray(vals, dtype=np.float64)
+
+
+class AverageMetric(PointwiseMetric):
+    """Mean of per-point scores (``Metric.scala:99``). Subclasses returning
+    None from ``calculate_point`` get Option semantics (:124): None points
+    are excluded from the denominator."""
+
+    def calculate(self, eval_data: EvalData) -> float:
+        s = self._scores(eval_data)
+        return float(s.mean()) if s.size else float("nan")
+
+
+OptionAverageMetric = AverageMetric
+
+
+class StdevMetric(PointwiseMetric):
+    """Population stdev of per-point scores (``Metric.scala:151,179``)."""
+
+    def calculate(self, eval_data: EvalData) -> float:
+        s = self._scores(eval_data)
+        return float(s.std()) if s.size else float("nan")
+
+
+OptionStdevMetric = StdevMetric
+
+
+class SumMetric(PointwiseMetric):
+    """Sum of per-point scores (``Metric.scala:205``)."""
+
+    def calculate(self, eval_data: EvalData) -> float:
+        return float(self._scores(eval_data).sum())
+
+
+class ZeroMetric(Metric):
+    """Always 0 (``Metric.scala:234``) — placeholder for eval-only runs."""
+
+    def calculate(self, eval_data: EvalData) -> float:
+        return 0.0
+
+
+# -- ranking metrics (the quality targets in BASELINE.md) -------------------
+
+def precision_at_k(predicted: Sequence[Any], relevant: set, k: int) -> Optional[float]:
+    """Precision@K as the reference's recommendation template computes it
+    (``tests/pio_tests/engines/recommendation-engine/src/main/scala/
+    Evaluation.scala:32-51``): |top-k ∩ relevant| / min(k, |relevant|);
+    None (excluded) when there are no relevant items."""
+    if not relevant:
+        return None
+    topk = list(predicted)[:k]
+    hits = sum(1 for x in topk if x in relevant)
+    return hits / min(k, len(relevant))
+
+
+def ndcg_at_k(predicted: Sequence[Any], relevant: set, k: int) -> Optional[float]:
+    """Binary-relevance NDCG@K — the BASELINE.md target metric."""
+    if not relevant:
+        return None
+    topk = list(predicted)[:k]
+    dcg = sum(1.0 / math.log2(i + 2) for i, x in enumerate(topk)
+              if x in relevant)
+    ideal = sum(1.0 / math.log2(i + 2)
+                for i in range(min(k, len(relevant))))
+    return dcg / ideal if ideal > 0 else None

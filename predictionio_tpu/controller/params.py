@@ -1,0 +1,141 @@
+"""Params system: typed per-controller parameters from engine variant JSON.
+
+Capability parity with the reference's params machinery —
+``Params`` marker + ``EngineParams`` (name, params) pairs per controller
+(``core/.../controller/EngineParams.scala:35-128``), JSON extraction
+(``controller/Engine.scala:355-418``, ``workflow/JsonExtractor.scala:39-140``)
+and the reflective ``Doer`` instantiation (``core/AbstractDoer.scala``).
+
+Here params are plain dataclasses; ``instantiate`` replaces reflection with
+dataclass-aware construction (a controller class is built from its params
+object, or from nothing if it takes none).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+
+
+class Params:
+    """Optional marker base for controller params; any dataclass works."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    pass
+
+
+def params_to_json(params: Any) -> dict:
+    """Render a params object to a JSON dict (dataclass fields, or the dict
+    itself)."""
+    if params is None:
+        return {}
+    if dataclasses.is_dataclass(params) and not isinstance(params, type):
+        return dataclasses.asdict(params)
+    if isinstance(params, Mapping):
+        return dict(params)
+    raise TypeError(f"cannot serialize params of type {type(params)}")
+
+
+def params_from_json(params_cls: Optional[Type], obj: Mapping[str, Any]) -> Any:
+    """Build a params object from a JSON dict. With no declared class, the
+    dict passes through (schemaless params, like the reference's gson mode,
+    ``workflow/JsonExtractor.scala``)."""
+    if params_cls is None:
+        return dict(obj)
+    if dataclasses.is_dataclass(params_cls):
+        names = {f.name for f in dataclasses.fields(params_cls)}
+        unknown = set(obj) - names
+        if unknown:
+            raise ValueError(
+                f"unknown params for {params_cls.__name__}: {sorted(unknown)}")
+        return params_cls(**obj)
+    return params_cls(**obj)
+
+
+def instantiate(controller_cls: Type, params: Any):
+    """Construct a controller from its params — the ``Doer.apply`` role
+    (``core/AbstractDoer.scala:35``): prefer a 1-arg (params) constructor,
+    fall back to 0-arg."""
+    sig = inspect.signature(controller_cls.__init__)
+    n_required = sum(
+        1 for name, p in sig.parameters.items()
+        if name != "self" and p.default is inspect.Parameter.empty
+        and p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                       inspect.Parameter.POSITIONAL_ONLY))
+    if n_required >= 1:
+        return controller_cls(params)
+    if params not in (None, {}, EmptyParams()) and len(sig.parameters) > 1:
+        return controller_cls(params)
+    return controller_cls()
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Named params for every DASE slot
+    (``controller/EngineParams.scala:35-128``). ``algorithms`` is a list of
+    (name, params) so one engine can train several algorithms at once."""
+
+    datasource: Tuple[str, Any] = ("", None)
+    preparator: Tuple[str, Any] = ("", None)
+    algorithms: Sequence[Tuple[str, Any]] = ((("", None)),)
+    serving: Tuple[str, Any] = ("", None)
+
+    def copy(self, **changes) -> "EngineParams":
+        return dataclasses.replace(self, **changes)
+
+    # -- engine.json variant interop ---------------------------------------
+    def to_json(self) -> dict:
+        def one(pair):
+            name, p = pair
+            return {"name": name, "params": params_to_json(p)}
+
+        return {
+            "dataSourceParams": one(self.datasource),
+            "preparatorParams": one(self.preparator),
+            "algorithmsParams": [one(a) for a in self.algorithms],
+            "servingParams": one(self.serving),
+        }
+
+
+def engine_params_from_variant(
+        variant: Mapping[str, Any],
+        datasource_params_cls: Optional[Type] = None,
+        preparator_params_cls: Optional[Type] = None,
+        algorithm_params_classes: Optional[Dict[str, Type]] = None,
+        serving_params_cls: Optional[Type] = None) -> EngineParams:
+    """Extract :class:`EngineParams` from an ``engine.json``-shaped variant
+    (the reference's ``jValueToEngineParams``, ``controller/Engine.scala:355``).
+
+    Accepts both shapes the reference accepts: ``{"params": {...}}`` and
+    ``{"name": "...", "params": {...}}`` per slot; ``algorithms`` is a list
+    of named entries.
+    """
+
+    def one(key, cls) -> Tuple[str, Any]:
+        node = variant.get(key)
+        if node is None:
+            return ("", None)
+        name = node.get("name", "")
+        return (name, params_from_json(cls, node.get("params", {})))
+
+    algos: List[Tuple[str, Any]] = []
+    for node in variant.get("algorithms", []):
+        name = node.get("name", "")
+        cls = (algorithm_params_classes or {}).get(name)
+        algos.append((name, params_from_json(cls, node.get("params", {}))))
+
+    return EngineParams(
+        datasource=one("datasource", datasource_params_cls),
+        preparator=one("preparator", preparator_params_cls),
+        algorithms=tuple(algos) if algos else ((("", None)),),
+        serving=one("serving", serving_params_cls),
+    )
+
+
+def load_variant(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,22 @@
+"""TPU algorithm library — the MLlib-role layer."""
+
+from .als import (
+    ALSModel,
+    ALSParams,
+    RatingsCOO,
+    recommend_batch,
+    recommend_products,
+    train_als,
+)
+from .data import kfold_split, ratings_from_events
+
+__all__ = [
+    "ALSModel",
+    "ALSParams",
+    "RatingsCOO",
+    "kfold_split",
+    "ratings_from_events",
+    "recommend_batch",
+    "recommend_products",
+    "train_als",
+]

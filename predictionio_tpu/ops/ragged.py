@@ -1,0 +1,97 @@
+"""Ragged→dense packing for TPU-friendly layouts.
+
+Event logs are ragged and string-keyed (SURVEY §7 hard part 2): each user
+has a variable-length rating history. XLA wants static shapes, so the host
+packs COO ratings into padded per-row histories once, before the training
+loop — ``[n_rows, max_len]`` index + weight matrices where padding carries
+weight 0 and a sentinel index that still gathers safely. The device never
+sees ragged data; the train loop is pure static-shape array code.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: With no explicit cap, the dense [n_rows, max_len] matrices are bounded
+#: to this many entries; beyond it the longest histories are truncated to
+#: the smallest length covering 99.9% of rows (skew guard: one heavy item
+#: must not inflate every row — MovieLens-20M's top item has ~100k raters).
+AUTO_CAP_ENTRIES = 200_000_000
+
+
+@dataclass(frozen=True)
+class PaddedHistories:
+    """Per-row padded histories: ``indices[i, k]`` is the k-th counterpart
+    id for row i (0-padded), ``values[i, k]`` its rating (0-padded), and
+    ``counts[i]`` the true history length."""
+
+    indices: np.ndarray  # [n_rows, max_len] int32
+    values: np.ndarray   # [n_rows, max_len] float32
+    counts: np.ndarray   # [n_rows] int32
+
+    @property
+    def n_rows(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.indices.shape[1]
+
+
+def pack_histories(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                   n_rows: int, max_len: Optional[int] = None,
+                   pad_rows_to: int = 1) -> PaddedHistories:
+    """Pack COO triples into row-major padded histories.
+
+    ``max_len`` caps history length (longest-kept-first is NOT applied;
+    entries beyond the cap are dropped in input order — callers wanting
+    recency should pre-sort). ``pad_rows_to`` rounds the row count up so
+    the leading axis divides evenly across mesh shards.
+    """
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    counts = np.bincount(rows_s, minlength=n_rows).astype(np.int32)
+    if max_len is not None:
+        L = int(max_len)
+    else:
+        L = int(counts.max(initial=1))
+        if n_rows * L > AUTO_CAP_ENTRIES:
+            capped = int(np.quantile(counts, 0.999)) or 1
+            capped = max(capped, AUTO_CAP_ENTRIES // max(n_rows, 1))
+            if capped < L:
+                dropped = int(np.maximum(counts - capped, 0).sum())
+                log.warning(
+                    "pack_histories: capping history length %d → %d "
+                    "(99.9th pct; dense layout would be %d×%d); dropping "
+                    "%d/%d entries from the heaviest rows. Set max_len to "
+                    "override.", L, capped, n_rows, L, dropped, len(rows_s))
+                L = capped
+    L = max(L, 1)
+
+    n_pad = ((n_rows + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    indices = np.zeros((n_pad, L), dtype=np.int32)
+    values = np.zeros((n_pad, L), dtype=np.float32)
+
+    # position of each entry within its row
+    starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos_in_row = np.arange(len(rows_s)) - starts[rows_s]
+    keep = pos_in_row < L
+    indices[rows_s[keep], pos_in_row[keep]] = cols_s[keep]
+    values[rows_s[keep], pos_in_row[keep]] = vals_s[keep]
+    kept_counts = np.minimum(counts, L)
+    out_counts = np.zeros(n_pad, dtype=np.int32)
+    out_counts[:n_rows] = kept_counts
+    return PaddedHistories(indices=indices, values=values, counts=out_counts)
+
+
+def transpose_coo(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Swap the roles of rows and cols (users↔items)."""
+    return cols, rows, vals

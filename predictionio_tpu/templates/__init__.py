@@ -1,0 +1,6 @@
+"""Engine templates — the workloads the framework ships with, mirroring
+the reference's example engines (SURVEY §2.2)."""
+
+from . import recommendation
+
+__all__ = ["recommendation"]

@@ -1,0 +1,287 @@
+"""Recommendation engine template — the north-star workload.
+
+Capability parity with the reference's bundled recommendation engine
+(``tests/pio_tests/engines/recommendation-engine/src/main/scala/``):
+DataSource reads ``rate``/``buy`` events (``DataSource.scala:47-52``,
+k-fold readEval :83-105), the ALS algorithm trains factor models
+(``ALSAlgorithm.scala:51-93``) and serves top-N via factor dot products
+(:95-109), queries/results use the same JSON shapes the reference's
+engine server speaks:
+
+    POST /queries.json  {"user": "1", "num": 4}
+    → {"itemScores": [{"item": "22", "score": 4.07}, ...]}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    AverageMetric,
+    Context,
+    DataSource,
+    Engine,
+    EngineParams,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from ..controller.metric import ndcg_at_k, precision_at_k
+from ..models.als import (
+    ALSModel,
+    ALSParams,
+    RatingsCOO,
+    recommend_batch,
+    recommend_products,
+    train_als,
+)
+from ..models.data import kfold_split, ratings_from_events
+
+
+# -- query/result schema (reference Query.scala / PredictedResult) ----------
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def to_json(self) -> dict:
+        return {"itemScores": [{"item": s.item, "score": s.score}
+                               for s in self.item_scores]}
+
+
+# -- training data -----------------------------------------------------------
+
+@dataclass
+class TrainingData(SanityCheck):
+    ratings: RatingsCOO
+    user_ids: object  # BiMap
+    item_ids: object  # BiMap
+
+    def sanity_check(self):
+        if self.ratings.users.size == 0:
+            raise ValueError("TrainingData has no ratings; check that "
+                             "rate/buy events exist for the app")
+
+
+@dataclass(frozen=True)
+class DataSourceParams:
+    app_name: str = ""
+    channel_name: Optional[str] = None
+    eval_k: int = 0              # folds for read_eval (0 = no eval data)
+    eval_query_num: int = 10     # N per eval query
+    eval_rating_threshold: float = 2.0  # "relevant" cutoff for actuals
+    seed: int = 3
+
+
+@dataclass(frozen=True)
+class EvalInfo:
+    fold: int
+    rating_threshold: float
+
+
+@dataclass(frozen=True)
+class ActualResult:
+    """Ground truth for one eval query: the user's held-out rated items."""
+    ratings: Tuple[Tuple[str, float], ...]  # (item, rating)
+
+
+class RecommendationDataSource(DataSource):
+    def __init__(self, params: DataSourceParams = DataSourceParams()):
+        self.params = params
+
+    def _read_ratings(self, ctx: Context):
+        events = ctx.event_store.find(
+            self.params.app_name or ctx.app_name,
+            channel_name=self.params.channel_name,
+            entity_type="user", target_entity_type="item",
+            event_names=["rate", "buy"])
+        return ratings_from_events(events)
+
+    def read_training(self, ctx: Context) -> TrainingData:
+        ratings, user_ids, item_ids = self._read_ratings(ctx)
+        return TrainingData(ratings, user_ids, item_ids)
+
+    def read_eval(self, ctx: Context):
+        """K-fold split over rating entries (reference ``DataSource.scala:
+        83-105``): train on k-1 folds, hold out one; queries ask top-N for
+        each user present in the held-out fold, actuals are their held-out
+        items."""
+        p = self.params
+        if p.eval_k <= 1:
+            raise ValueError("eval_k must be >= 2 for read_eval")
+        ratings, user_ids, item_ids = self._read_ratings(ctx)
+        inv_u = user_ids.inverse
+        inv_i = item_ids.inverse
+        folds = []
+        for f, (train_mask, test_mask) in enumerate(
+                kfold_split(len(ratings.users), p.eval_k, p.seed)):
+            td = TrainingData(
+                RatingsCOO(ratings.users[train_mask],
+                           ratings.items[train_mask],
+                           ratings.ratings[train_mask],
+                           ratings.n_users, ratings.n_items),
+                user_ids, item_ids)
+            held: dict = {}
+            for u, i, r in zip(ratings.users[test_mask],
+                               ratings.items[test_mask],
+                               ratings.ratings[test_mask]):
+                held.setdefault(int(u), []).append((inv_i[int(i)], float(r)))
+            qa = [(Query(user=inv_u[u], num=p.eval_query_num),
+                   ActualResult(tuple(pairs)))
+                  for u, pairs in sorted(held.items())]
+            folds.append((td, EvalInfo(fold=f,
+                                       rating_threshold=p.eval_rating_threshold),
+                          qa))
+        return folds
+
+
+# -- algorithm ---------------------------------------------------------------
+
+class ALSAlgorithm(Algorithm):
+    """Explicit-feedback ALS (``ALSAlgorithm.scala:39-150``); set
+    ``implicit_prefs=True`` for the trainImplicit variants."""
+
+    query_class = Query
+
+    def __init__(self, params: ALSParams = ALSParams()):
+        self.params = params
+
+    def train(self, ctx: Context, td: TrainingData) -> ALSModel:
+        mesh = ctx.mesh
+        U, V = train_als(td.ratings, self.params, mesh=mesh)
+        return ALSModel(user_factors=U, item_factors=V,
+                        n_users=td.ratings.n_users,
+                        n_items=td.ratings.n_items,
+                        user_ids=td.user_ids, item_ids=td.item_ids,
+                        params=self.params)
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        uidx = model.user_ids.get(query.user) if model.user_ids else None
+        if uidx is None:
+            return PredictedResult()  # unknown user (reference returns empty)
+        ids, scores = recommend_products(model, int(uidx), query.num)
+        inv = model.item_ids.inverse
+        return PredictedResult(tuple(
+            ItemScore(item=inv[int(i)], score=float(s))
+            for i, s in zip(ids, scores)))
+
+    def batch_predict(self, model: ALSModel, queries: Sequence[Query]
+                      ) -> List[PredictedResult]:
+        """One batched device dispatch for all known users
+        (the reference's cartesian batchPredict, ``ALSAlgorithm.scala:
+        113-150``, without the shuffle)."""
+        known = [(qi, int(model.user_ids[q.user])) for qi, q in
+                 enumerate(queries) if model.user_ids
+                 and q.user in model.user_ids]
+        out: List[PredictedResult] = [PredictedResult()] * len(queries)
+        if not known:
+            return out
+        num = max(q.num for q in queries)
+        idx = np.array([u for _, u in known], dtype=np.int64)
+        ids, scores = recommend_batch(model, idx, num)
+        inv = model.item_ids.inverse
+        for row, (qi, _) in enumerate(known):
+            n = queries[qi].num
+            out[qi] = PredictedResult(tuple(
+                ItemScore(item=inv[int(i)], score=float(s))
+                for i, s in zip(ids[row, :n], scores[row, :n])))
+        return out
+
+
+class RecommendationServing(FirstServing):
+    pass
+
+
+def recommendation_engine() -> Engine:
+    """Engine factory (the template's ``EngineFactory`` object)."""
+    return Engine(
+        datasource_classes=RecommendationDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"als": ALSAlgorithm, "": ALSAlgorithm},
+        serving_classes=RecommendationServing,
+        datasource_params_class=DataSourceParams,
+        algorithm_params_classes={"als": ALSParams, "": ALSParams},
+    )
+
+
+# -- evaluation metrics (reference Evaluation.scala:32-89) -------------------
+
+class PrecisionAtK(AverageMetric):
+    """Precision@K with a relevance threshold (``Evaluation.scala:32-51``)."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 2.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k} (threshold={self.rating_threshold})"
+
+    def calculate_point(self, ei, q: Query, p: PredictedResult,
+                        a: ActualResult):
+        relevant = {item for item, r in a.ratings
+                    if r >= self.rating_threshold}
+        return precision_at_k([s.item for s in p.item_scores], relevant,
+                              self.k)
+
+
+class NDCGAtK(AverageMetric):
+    """Binary NDCG@K — the BASELINE.md quality target."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 2.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"NDCG@{self.k} (threshold={self.rating_threshold})"
+
+    def calculate_point(self, ei, q: Query, p: PredictedResult,
+                        a: ActualResult):
+        relevant = {item for item, r in a.ratings
+                    if r >= self.rating_threshold}
+        return ndcg_at_k([s.item for s in p.item_scores], relevant, self.k)
+
+
+class PositiveCount(AverageMetric):
+    """Average number of relevant actuals per query
+    (``Evaluation.scala:53-61``) — a sanity diagnostic, not a target."""
+
+    def __init__(self, rating_threshold: float = 2.0):
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"PositiveCount (threshold={self.rating_threshold})"
+
+    def calculate_point(self, ei, q, p, a: ActualResult):
+        return float(sum(1 for _, r in a.ratings
+                         if r >= self.rating_threshold))
+
+
+def query_from_json(obj: dict) -> Query:
+    return Query(user=str(obj["user"]), num=int(obj.get("num", 10)))
+
+
+def default_engine_params(app_name: str, **als_kw) -> EngineParams:
+    return EngineParams(
+        datasource=("", DataSourceParams(app_name=app_name)),
+        preparator=("", None),
+        algorithms=(("als", ALSParams(**als_kw)),),
+        serving=("", None))

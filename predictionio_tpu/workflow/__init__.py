@@ -1,0 +1,20 @@
+"""Workflow layer: train/eval/deploy drivers and model persistence."""
+
+from .core import (
+    get_latest_completed,
+    load_models_for_deploy,
+    run_evaluation,
+    run_train,
+)
+from .persistence import dumps_models, loads_models, to_device, to_host
+
+__all__ = [
+    "dumps_models",
+    "get_latest_completed",
+    "load_models_for_deploy",
+    "loads_models",
+    "run_evaluation",
+    "run_train",
+    "to_device",
+    "to_host",
+]

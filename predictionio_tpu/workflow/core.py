@@ -1,0 +1,135 @@
+"""Core workflow: train and evaluation drivers with metadata bookkeeping.
+
+Capability parity with ``workflow/CoreWorkflow.scala``: ``run_train``
+mirrors ``runTrain`` (:45-102 — EngineInstance INIT→COMPLETED, model blob
+insert at :76-81) and ``run_evaluation`` mirrors ``runEvaluation``
+(:104-164 — EvaluationInstance INIT→EVALCOMPLETED with one-liner/HTML/JSON
+results). The spark-submit process boundary (``tools/Runner.scala:185``)
+does not exist here: training runs in-process against the mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime, timezone
+from typing import Any, List, Optional, Sequence
+
+from ..controller.base import PersistentModelManifest
+from ..controller.context import Context
+from ..controller.engine import Engine
+from ..controller.evaluation import (
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from ..controller.params import EngineParams, params_to_json
+from ..data.storage.base import (
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    STATUS_COMPLETED,
+    STATUS_EVALCOMPLETED,
+    STATUS_INIT,
+)
+from . import persistence
+
+log = logging.getLogger(__name__)
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def run_train(ctx: Context, engine: Engine, engine_params: EngineParams,
+              engine_id: str = "default", engine_version: str = "1",
+              engine_variant: str = "engine.json",
+              engine_factory: str = "") -> str:
+    """Train and persist: returns the COMPLETED engine-instance id."""
+    import json as _json
+
+    storage = ctx.storage
+    instances = storage.engine_instances()
+    ep = engine_params
+    instance = EngineInstance(
+        id="", status=STATUS_INIT, start_time=_now(), end_time=_now(),
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant, engine_factory=engine_factory,
+        batch=ctx.batch,
+        data_source_params=_json.dumps(
+            {ep.datasource[0]: params_to_json(ep.datasource[1])}),
+        preparator_params=_json.dumps(
+            {ep.preparator[0]: params_to_json(ep.preparator[1])}),
+        algorithms_params=_json.dumps(
+            [{name: params_to_json(p)} for name, p in ep.algorithms]),
+        serving_params=_json.dumps(
+            {ep.serving[0]: params_to_json(ep.serving[1])}))
+    instance_id = instances.insert(instance)
+    log.info("engine instance %s: training started", instance_id)
+
+    result = engine.train(ctx, engine_params)
+    if ctx.stop_after_read or ctx.stop_after_prepare:
+        log.info("workflow stopped early (stop-after flag); instance %s "
+                 "left in INIT", instance_id)
+        return instance_id
+
+    algos = engine.make_algorithms(engine_params)
+    stored: List[Any] = []
+    for i, (algo, model) in enumerate(zip(algos, result.models)):
+        stored.append(algo.make_persistent_model(model, instance_id, i))
+    storage.models().insert(
+        Model(id=instance_id, models=persistence.dumps_models(stored)))
+
+    done = instances.get(instance_id)
+    assert done is not None
+    instances.update(done.copy(status=STATUS_COMPLETED, end_time=_now()))
+    log.info("engine instance %s: training completed", instance_id)
+    return instance_id
+
+
+def load_models_for_deploy(ctx: Context, engine: Engine,
+                           instance: EngineInstance,
+                           engine_params: EngineParams) -> List[Any]:
+    """Invert persisted blobs into live models (``CreateServer.scala:202-206``
+    + ``Engine.prepareDeploy`` :198-267)."""
+    blob = ctx.storage.models().get(instance.id)
+    if blob is None:
+        raise RuntimeError(f"no persisted models for instance {instance.id}")
+    stored = persistence.loads_models(blob.models)
+    return engine.prepare_deploy(ctx, engine_params, stored, instance.id)
+
+
+def run_evaluation(ctx: Context, evaluation: Evaluation,
+                   params_list: Sequence[EngineParams],
+                   evaluation_class: str = "",
+                   params_generator_class: str = "") -> MetricEvaluatorResult:
+    """Evaluate the search grid and record the winner."""
+    storage = ctx.storage
+    instances = storage.evaluation_instances()
+    instance_id = instances.insert(EvaluationInstance(
+        id="", status=STATUS_INIT, start_time=_now(), end_time=_now(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=params_generator_class,
+        batch=ctx.batch))
+    log.info("evaluation instance %s: started (%d params sets)",
+             instance_id, len(params_list))
+
+    evaluator = MetricEvaluator(evaluation)
+    result = evaluator.evaluate(ctx, params_list)
+
+    done = instances.get(instance_id)
+    assert done is not None
+    instances.update(done.copy(
+        status=STATUS_EVALCOMPLETED, end_time=_now(),
+        evaluator_results=result.to_one_liner(),
+        evaluator_results_html=result.to_html(),
+        evaluator_results_json=result.to_json()))
+    log.info("evaluation instance %s: %s", instance_id, result.to_one_liner())
+    return result
+
+
+def get_latest_completed(ctx: Context, engine_id: str = "default",
+                         engine_version: str = "1",
+                         engine_variant: str = "engine.json"
+                         ) -> Optional[EngineInstance]:
+    return ctx.storage.engine_instances().get_latest_completed(
+        engine_id, engine_version, engine_variant)

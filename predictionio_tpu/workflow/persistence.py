@@ -1,0 +1,43 @@
+"""Model persistence: device pytrees ⇄ durable blobs.
+
+The reference Kryo-serialized whole model Seqs into the MODELDATA store
+(``workflow/CreateServer.scala:62-76``, ``workflow/CoreWorkflow.scala:76-81``)
+and inverted them at deploy (``CreateServer.scala:202-206``). Here models
+are pytrees of ``jax.Array``s: ``to_host`` maps device arrays to numpy for
+pickling, ``to_device`` moves them back (re-sharding happens lazily when the
+serving/eval code puts them on a mesh). Custom persistence (the reference's
+``PersistentModel``) is signaled with a ``PersistentModelManifest`` instead.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List
+
+import jax
+import numpy as np
+
+
+def to_host(model: Any) -> Any:
+    """Replace every jax.Array leaf with numpy (pickle-safe)."""
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, model)
+
+
+def to_device(model: Any) -> Any:
+    """Identity by default: numpy leaves are device-put lazily by jit at
+    first use, which lets the serving path choose shardings."""
+    return model
+
+
+def dumps_models(models: List[Any]) -> bytes:
+    """Serialize the per-algorithm model list to one blob (the Kryo-blob
+    role)."""
+    buf = io.BytesIO()
+    pickle.dump([to_host(m) for m in models], buf, protocol=4)
+    return buf.getvalue()
+
+
+def loads_models(blob: bytes) -> List[Any]:
+    return pickle.loads(blob)

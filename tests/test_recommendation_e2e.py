@@ -1,0 +1,176 @@
+"""End-to-end slice (SURVEY §7): event store → recommendation template →
+train → persist → deploy-load → predict → k-fold eval with metrics.
+
+This is the minimum end-to-end target: every layer the north star touches.
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import Context, Evaluation
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.models.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    DataSourceParams,
+    NDCGAtK,
+    PositiveCount,
+    PrecisionAtK,
+    Query,
+    default_engine_params,
+    recommendation_engine,
+)
+from predictionio_tpu.workflow import (
+    get_latest_completed,
+    load_models_for_deploy,
+    run_evaluation,
+    run_train,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture(scope="module")
+def seeded_ctx():
+    """Storage with a structured ratings pattern: users come in two taste
+    groups; group A rates items 0-14 high, group B rates 15-29 high."""
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    app_id = storage.apps().insert(App(0, "mlapp"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(42)
+    events = []
+    for u in range(40):
+        group_items = range(0, 15) if u % 2 == 0 else range(15, 30)
+        other_items = range(15, 30) if u % 2 == 0 else range(0, 15)
+        liked = rng.choice(list(group_items), size=8, replace=False)
+        disliked = rng.choice(list(other_items), size=4, replace=False)
+        t = T0
+        for i in liked:
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(4, 6))}),
+                event_time=t))
+            t += timedelta(minutes=1)
+        for i in disliked:
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(1, 3))}),
+                event_time=t))
+            t += timedelta(minutes=1)
+        # some buy events (implied rating 4.0)
+        events.append(Event(
+            event="buy", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.choice(list(group_items))}",
+            event_time=t))
+    es.insert_batch(events, app_id)
+    return Context(app_name="mlapp", _storage=storage)
+
+
+def engine_and_params():
+    engine = recommendation_engine()
+    ep = default_engine_params("mlapp", rank=8, num_iterations=8, reg=0.05,
+                               seed=11)
+    return engine, ep
+
+
+class TestTrainDeployPredict:
+    def test_full_lifecycle(self, seeded_ctx):
+        ctx = seeded_ctx
+        engine, ep = engine_and_params()
+
+        instance_id = run_train(ctx, engine, ep, engine_id="reco",
+                                engine_factory="templates.recommendation")
+        assert instance_id
+
+        instance = get_latest_completed(ctx, engine_id="reco")
+        assert instance is not None
+        assert instance.id == instance_id
+
+        models = load_models_for_deploy(ctx, engine, instance, ep)
+        assert len(models) == 1
+        model = models[0]
+
+        serving = engine.make_serving(ep)
+        algo = engine.make_algorithms(ep)[0]
+        q = Query(user="u0", num=5)
+        result = serving.serve(q, [algo.predict(model, q)])
+        assert len(result.item_scores) == 5
+        # u0 is in group A (items 0-14); top recs should be group A items
+        top_items = [int(s.item[1:]) for s in result.item_scores]
+        in_group = sum(1 for i in top_items if i < 15)
+        assert in_group >= 4, f"expected group-A items, got {top_items}"
+        # scores sorted
+        scores = [s.score for s in result.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_empty_result(self, seeded_ctx):
+        ctx = seeded_ctx
+        engine, ep = engine_and_params()
+        result = engine.train(ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(result.models[0], Query(user="ghost", num=3))
+        assert pred.item_scores == ()
+
+    def test_batch_predict_matches_single(self, seeded_ctx):
+        ctx = seeded_ctx
+        engine, ep = engine_and_params()
+        model = engine.train(ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        queries = [Query(user="u1", num=3), Query(user="ghost", num=3),
+                   Query(user="u5", num=2)]
+        batch = algo.batch_predict(model, queries)
+        assert [s.item for s in batch[0].item_scores] == \
+               [s.item for s in algo.predict(model, queries[0]).item_scores]
+        assert batch[1].item_scores == ()
+        assert len(batch[2].item_scores) == 2
+
+    def test_json_result_shape(self, seeded_ctx):
+        ctx = seeded_ctx
+        engine, ep = engine_and_params()
+        model = engine.train(ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        j = algo.predict(model, Query(user="u2", num=2)).to_json()
+        assert set(j.keys()) == {"itemScores"}
+        assert set(j["itemScores"][0].keys()) == {"item", "score"}
+
+
+class TestEvaluationE2E:
+    def test_kfold_eval_with_metrics(self, seeded_ctx):
+        ctx = seeded_ctx
+        engine, _ = engine_and_params()
+        grid = []
+        for rank in (4, 8):
+            grid.append(default_engine_params("mlapp", rank=rank,
+                                              num_iterations=6, reg=0.05,
+                                              seed=11).copy(
+                datasource=("", DataSourceParams(app_name="mlapp", eval_k=3,
+                                                 eval_query_num=10))))
+        evaluation = Evaluation(
+            engine=engine, metric=PrecisionAtK(k=5, rating_threshold=4.0),
+            other_metrics=[NDCGAtK(k=5, rating_threshold=4.0),
+                           PositiveCount(rating_threshold=4.0)])
+        result = run_evaluation(ctx, evaluation, grid,
+                                evaluation_class="RecommendationEvaluation")
+        assert len(result.scores) == 2
+        assert 0.0 <= result.best_score <= 1.0
+        # taste groups are strongly separated: a working ALS should place
+        # held-out relevant items in top-5 well above chance (~0.09 random;
+        # top-5 legitimately includes already-rated train items, matching
+        # MLlib recommendProducts which does not filter seen items)
+        assert result.best_score > 0.15, result.to_one_liner()
+        # evaluation instance recorded
+        done = ctx.storage.evaluation_instances().get_completed()
+        assert len(done) == 1
+        assert "best variant" in done[0].evaluator_results
+        assert done[0].evaluator_results_json
