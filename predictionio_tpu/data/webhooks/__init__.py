@@ -1,0 +1,59 @@
+"""Webhook connectors: third-party payloads → framework events.
+
+Capability parity with the reference's webhook layer
+(``data/webhooks/{JsonConnector,FormConnector,ConnectorUtil}.scala`` and
+the registry ``data/api/WebhooksConnectors.scala:30-34``): a connector
+translates one provider's payload into the event-JSON wire format, and the
+Event Server routes ``/webhooks/<name>.json`` / ``.form`` through this
+registry.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Mapping
+
+from ..event import Event
+
+__all__ = ["ConnectorException", "JsonConnector", "FormConnector",
+           "json_connectors", "form_connectors", "to_event"]
+
+
+class ConnectorException(Exception):
+    """Payload could not be converted (``ConnectorException.scala``)."""
+
+
+class JsonConnector(abc.ABC):
+    """JSON-body webhook converter (``JsonConnector.scala``)."""
+
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping) -> dict:
+        """Return the event-JSON dict for one provider payload."""
+
+
+class FormConnector(abc.ABC):
+    """Form-encoded webhook converter (``FormConnector.scala``)."""
+
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, str]) -> dict:
+        ...
+
+
+def to_event(connector, data: Mapping) -> Event:
+    """Convert and parse in one step (``ConnectorUtil.toEvent``)."""
+    return Event.from_json(connector.to_event_json(data))
+
+
+def _builtin_json() -> Dict[str, JsonConnector]:
+    from .segmentio import SegmentIOConnector
+    return {"segmentio": SegmentIOConnector()}
+
+
+def _builtin_form() -> Dict[str, FormConnector]:
+    from .mailchimp import MailChimpConnector
+    return {"mailchimp": MailChimpConnector()}
+
+
+#: name → connector registries (``WebhooksConnectors.scala:30-34``).
+json_connectors: Dict[str, JsonConnector] = _builtin_json()
+form_connectors: Dict[str, FormConnector] = _builtin_form()

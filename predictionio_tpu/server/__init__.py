@@ -1,0 +1,3 @@
+"""HTTP servers: event ingestion, engine serving, admin, dashboard."""
+
+from .http import AppServer, HTTPApp, HTTPError, Request, Response  # noqa: F401

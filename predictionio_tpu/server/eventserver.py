@@ -1,0 +1,248 @@
+"""Event Server: REST ingestion over the event store.
+
+Capability parity with the reference Event Server
+(``data/api/EventServer.scala:61-560``): access-key auth via query param
+or Basic header (:92-130), channel resolution, allowed-events
+enforcement (:249,353), single/batch/filtered-query event routes with the
+reference's status-code semantics (batch cap 50 with per-event status
+array, :340-419), ``/stats.json`` behind ``--stats`` (:421-441), webhook
+routes ``/webhooks/<name>.json|form`` (:442-523), and plugin routes.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..data.event import Event, EventValidationError, parse_iso
+from ..data.storage.base import EventFilter, ANY
+from ..data.storage.registry import Storage, get_storage
+from ..data.webhooks import (
+    ConnectorException,
+    form_connectors,
+    json_connectors,
+    to_event,
+)
+from .http import AppServer, HTTPApp, HTTPError, Request, Response, json_response
+from .plugins import EventServerPlugins
+from .stats import StatsCollector
+
+log = logging.getLogger(__name__)
+
+MAX_EVENTS_PER_BATCH = 50  # EventServer.scala:66
+
+
+@dataclass
+class AuthData:
+    app_id: int
+    channel_id: Optional[int]
+    events: List[str]  # allowed event names; empty = all allowed
+
+
+def authenticate(storage: Storage, req: Request) -> AuthData:
+    """Resolve accessKey (query param, else Basic auth username) → app
+    (+channel), mirroring ``EventServer.scala:92-130``."""
+    key = req.query.get("accessKey")
+    if key is None:
+        auth = req.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(auth[len("Basic "):]).decode("utf-8")
+            except Exception:
+                raise HTTPError(401, "Invalid accessKey.")
+            key = decoded.strip().split(":")[0]
+        else:
+            raise HTTPError(401, "Missing accessKey.")
+    record = storage.access_keys().get(key)
+    if record is None:
+        raise HTTPError(401, "Invalid accessKey.")
+    channel_id: Optional[int] = None
+    channel_name = req.query.get("channel")
+    if channel_name is not None:
+        channels = {c.name: c.id for c in
+                    storage.channels().get_by_app_id(record.app_id)}
+        if channel_name not in channels:
+            raise HTTPError(401, f"Invalid channel '{channel_name}'.")
+        channel_id = channels[channel_name]
+    return AuthData(app_id=record.app_id, channel_id=channel_id,
+                    events=list(record.events))
+
+
+def _allowed(auth: AuthData, event_name: str) -> bool:
+    return not auth.events or event_name in auth.events
+
+
+def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
+              plugins: Optional[EventServerPlugins] = None) -> HTTPApp:
+    st = storage if storage is not None else get_storage()
+    collector = StatsCollector() if stats else None
+    plug = plugins or EventServerPlugins()
+    app = HTTPApp("eventserver")
+
+    def _auth(req: Request) -> AuthData:
+        return authenticate(st, req)
+
+    @app.route("GET", "/")
+    def index(req: Request) -> Response:
+        return json_response({"status": "alive"})
+
+    @app.route("GET", "/plugins.json")
+    def plugins_json(req: Request) -> Response:
+        return json_response({"plugins": plug.describe()})
+
+    @app.route("POST", "/events.json")
+    def post_event(req: Request) -> Response:
+        auth = _auth(req)
+        try:
+            event = Event.from_json(req.json())
+        except (EventValidationError, TypeError, KeyError, ValueError) as e:
+            raise HTTPError(400, str(e))
+        if not _allowed(auth, event.event):
+            return json_response(
+                {"message": f"{event.event} events are not allowed"}, 403)
+        plug.process_input(auth.app_id, auth.channel_id, event)
+        event_id = st.events().insert(event, auth.app_id, auth.channel_id)
+        if collector:
+            collector.bookkeeping(auth.app_id, 201, event)
+        return json_response({"eventId": event_id}, 201)
+
+    @app.route("GET", "/events.json")
+    def get_events(req: Request) -> Response:
+        auth = _auth(req)
+        q = req.query
+        reversed_ = q.get("reversed", "false").lower() == "true"
+        if reversed_ and not (q.get("entityType") and q.get("entityId")):
+            raise HTTPError(400, "the parameter reversed can only be used "
+                                 "with both entityType and entityId specified.")
+        try:
+            filt = EventFilter(
+                start_time=parse_iso(q["startTime"]) if "startTime" in q else None,
+                until_time=parse_iso(q["untilTime"]) if "untilTime" in q else None,
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=[q["event"]] if "event" in q else None,
+                target_entity_type=q.get("targetEntityType", ANY),
+                target_entity_id=q.get("targetEntityId", ANY),
+                limit=int(q.get("limit", 20)),
+                reversed=reversed_)
+        except (EventValidationError, ValueError) as e:
+            raise HTTPError(400, str(e))
+        events = list(st.events().find(auth.app_id, auth.channel_id, filt))
+        if not events:
+            return json_response({"message": "Not Found"}, 404)
+        return json_response([e.to_json() for e in events])
+
+    @app.route("POST", "/batch/events.json")
+    def post_batch(req: Request) -> Response:
+        auth = _auth(req)
+        payload = req.json()
+        if not isinstance(payload, list):
+            raise HTTPError(400, "batch request body must be a JSON array")
+        if len(payload) > MAX_EVENTS_PER_BATCH:
+            raise HTTPError(400, "Batch request must have less than or equal "
+                                 f"to {MAX_EVENTS_PER_BATCH} events")
+        results = []
+        for obj in payload:
+            try:
+                event = Event.from_json(obj)
+            except (EventValidationError, TypeError, KeyError, ValueError) as e:
+                results.append({"status": 400, "message": str(e)})
+                continue
+            if not _allowed(auth, event.event):
+                results.append({
+                    "status": 403,
+                    "message": f"{event.event} events are not allowed"})
+                continue
+            try:
+                plug.process_input(auth.app_id, auth.channel_id, event)
+                event_id = st.events().insert(event, auth.app_id,
+                                              auth.channel_id)
+            except Exception as e:  # per-event isolation, like the reference
+                results.append({"status": 500, "message": str(e)})
+                continue
+            if collector:
+                collector.bookkeeping(auth.app_id, 201, event)
+            results.append({"status": 201, "eventId": event_id})
+        return json_response(results)
+
+    @app.route("GET", "/stats.json")
+    def get_stats(req: Request) -> Response:
+        auth = _auth(req)
+        if collector is None:
+            return json_response(
+                {"message": "To see stats, launch Event Server with --stats "
+                            "argument."}, 404)
+        return json_response(collector.get(auth.app_id))
+
+    @app.route("GET", r"/events/(?P<event_id>[^/]+)\.json")
+    def get_event(req: Request) -> Response:
+        auth = _auth(req)
+        event = st.events().get(req.path_params["event_id"], auth.app_id,
+                                auth.channel_id)
+        if event is None:
+            return json_response({"message": "Not Found"}, 404)
+        return json_response(event.to_json())
+
+    @app.route("DELETE", r"/events/(?P<event_id>[^/]+)\.json")
+    def delete_event(req: Request) -> Response:
+        auth = _auth(req)
+        found = st.events().delete(req.path_params["event_id"], auth.app_id,
+                                   auth.channel_id)
+        if found:
+            return json_response({"message": "Found"})
+        return json_response({"message": "Not Found"}, 404)
+
+    def _webhook_post(req: Request, name: str, is_form: bool) -> Response:
+        auth = _auth(req)
+        registry = form_connectors if is_form else json_connectors
+        connector = registry.get(name)
+        if connector is None:
+            return json_response(
+                {"message": f"webhooks connection for {name} is not "
+                            "supported."}, 404)
+        try:
+            data = req.form() if is_form else req.json()
+            event = to_event(connector, data)
+        except (ConnectorException, EventValidationError, ValueError) as e:
+            raise HTTPError(400, str(e))
+        event_id = st.events().insert(event, auth.app_id, auth.channel_id)
+        if collector:
+            collector.bookkeeping(auth.app_id, 201, event)
+        return json_response({"eventId": event_id}, 201)
+
+    def _webhook_get(req: Request, name: str, is_form: bool) -> Response:
+        _auth(req)
+        registry = form_connectors if is_form else json_connectors
+        if name in registry:
+            return json_response({"message": "Ok"})
+        return json_response(
+            {"message": f"webhooks connection for {name} is not supported."},
+            404)
+
+    @app.route("POST", r"/webhooks/(?P<name>[^/]+)\.json")
+    def webhook_post_json(req: Request) -> Response:
+        return _webhook_post(req, req.path_params["name"], is_form=False)
+
+    @app.route("GET", r"/webhooks/(?P<name>[^/]+)\.json")
+    def webhook_get_json(req: Request) -> Response:
+        return _webhook_get(req, req.path_params["name"], is_form=False)
+
+    @app.route("POST", r"/webhooks/(?P<name>[^/]+)\.form")
+    def webhook_post_form(req: Request) -> Response:
+        return _webhook_post(req, req.path_params["name"], is_form=True)
+
+    @app.route("GET", r"/webhooks/(?P<name>[^/]+)\.form")
+    def webhook_get_form(req: Request) -> Response:
+        return _webhook_get(req, req.path_params["name"], is_form=True)
+
+    return app
+
+
+def create_event_server(storage: Optional[Storage] = None,
+                        host: str = "0.0.0.0", port: int = 7070,
+                        stats: bool = False) -> AppServer:
+    """Bind the Event Server (``EventServer.createEventServer``,
+    ``EventServer.scala:528-548``; default port 7070 per ``Run.main``)."""
+    return AppServer(build_app(storage, stats=stats), host, port)

@@ -1,0 +1,168 @@
+"""Minimal threaded HTTP app framework shared by the framework's servers.
+
+The reference runs three akka-http servers (Event Server
+``data/api/EventServer.scala``, engine server ``workflow/CreateServer.scala``,
+admin/dashboard ``tools/``). Here one stdlib-based micro-framework backs all
+of them: regex-routed handlers over ``ThreadingHTTPServer`` — no actor
+system, no external dependencies, good enough for host-side control planes
+(the TPU data plane never goes through HTTP).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["Request", "Response", "HTTPApp", "AppServer", "json_response"]
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Dict[str, str]
+    headers: Dict[str, str]
+    body: bytes
+    #: Named groups from the route pattern match.
+    path_params: Dict[str, str] = field(default_factory=dict)
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> Dict[str, str]:
+        """Parse an ``application/x-www-form-urlencoded`` body."""
+        parsed = parse_qs(self.body.decode("utf-8"), keep_blank_values=True)
+        return {k: v[0] for k, v in parsed.items()}
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None
+    content_type: str = "application/json"
+    headers: Dict[str, str] = field(default_factory=dict)
+
+    def encoded(self) -> bytes:
+        if self.body is None:
+            return b""
+        if isinstance(self.body, bytes):
+            return self.body
+        if isinstance(self.body, str):
+            return self.body.encode("utf-8")
+        return json.dumps(self.body).encode("utf-8")
+
+
+def json_response(body: Any, status: int = 200) -> Response:
+    return Response(status=status, body=body)
+
+
+Handler = Callable[[Request], Response]
+
+
+class HTTPApp:
+    """Routes ``(method, path-regex) → handler``; first match wins."""
+
+    def __init__(self, name: str = "app"):
+        self.name = name
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    def route(self, method: str, pattern: str) -> Callable[[Handler], Handler]:
+        compiled = re.compile(f"^{pattern}$")
+
+        def deco(fn: Handler) -> Handler:
+            self._routes.append((method.upper(), compiled, fn))
+            return fn
+        return deco
+
+    def handle(self, req: Request) -> Response:
+        path_matched = False
+        for method, pattern, fn in self._routes:
+            m = pattern.match(req.path)
+            if m:
+                path_matched = True
+                if method == req.method:
+                    req.path_params = m.groupdict()
+                    try:
+                        return fn(req)
+                    except HTTPError as e:
+                        return json_response({"message": e.message}, e.status)
+                    except Exception as e:  # noqa: BLE001 — server boundary
+                        return json_response({"message": str(e)}, 500)
+        if path_matched:
+            return json_response({"message": "Method Not Allowed"}, 405)
+        return json_response({"message": "Not Found"}, 404)
+
+
+class HTTPError(Exception):
+    """Raise inside a handler to produce a JSON error response."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Handler(BaseHTTPRequestHandler):
+    app: HTTPApp  # bound by AppServer
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _dispatch(self) -> None:
+        parsed = urlparse(self.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        req = Request(method=self.command, path=parsed.path, query=query,
+                      headers={k: v for k, v in self.headers.items()},
+                      body=body)
+        resp = self.app.handle(req)
+        payload = resp.encoded()
+        self.send_response(resp.status)
+        self.send_header("Content-Type", resp.content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in resp.headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    do_GET = do_POST = do_DELETE = do_PUT = _dispatch
+
+
+class AppServer:
+    """Owns a ``ThreadingHTTPServer`` for one :class:`HTTPApp`; start in a
+    daemon thread (tests, embedded) or serve on the main thread (CLI)."""
+
+    def __init__(self, app: HTTPApp, host: str = "0.0.0.0", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"app": app})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.app = app
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start_background(self) -> "AppServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name=f"{self.app.name}-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,135 @@
+"""Plugin hooks for the event and engine servers.
+
+Capability parity with the reference plugin system
+(``workflow/EngineServerPlugin.scala:24-41``,
+``data/api/EventServerPlugin.scala:21-34``, loaded via ``ServiceLoader``):
+input/output *blockers* run synchronously (raising aborts the request),
+input/output *sniffers* observe asynchronously. Discovery here is an
+explicit ``register`` call (or ``predictionio_tpu.plugins`` entry points)
+instead of classpath scanning.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..data.event import Event
+
+log = logging.getLogger(__name__)
+
+
+class EventServerPlugin(abc.ABC):
+    """Event-side hook (``data/api/EventServerPlugin.scala:21-34``)."""
+
+    plugin_name: str = ""
+    plugin_description: str = ""
+
+    @abc.abstractmethod
+    def process(self, app_id: int, channel_id: Optional[int],
+                event: Event) -> None:
+        ...
+
+    def handle_rest(self, app_id: int, channel_id: Optional[int],
+                    args: List[str]) -> Any:
+        return {}
+
+
+class EngineServerPlugin(abc.ABC):
+    """Engine-side hook (``workflow/EngineServerPlugin.scala:24-41``):
+    ``process`` sees (query, prediction) and may transform the prediction
+    (blockers) or merely observe (sniffers)."""
+
+    plugin_name: str = ""
+    plugin_description: str = ""
+
+    @abc.abstractmethod
+    def process(self, query: Any, prediction: Any) -> Any:
+        ...
+
+    def handle_rest(self, args: List[str]) -> Any:
+        return {}
+
+
+class _SnifferPump:
+    """Async fan-out to sniffers (the reference's plugin actors)."""
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="plugin-sniffers")
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            fn = self._q.get()
+            try:
+                fn()
+            except Exception:
+                log.exception("sniffer plugin failed")
+
+    def submit(self, fn) -> None:
+        self._ensure()
+        self._q.put(fn)
+
+
+class EventServerPlugins:
+    def __init__(self):
+        self.input_blockers: Dict[str, EventServerPlugin] = {}
+        self.input_sniffers: Dict[str, EventServerPlugin] = {}
+        self._pump = _SnifferPump()
+
+    def register(self, plugin: EventServerPlugin, *, blocker: bool) -> None:
+        target = self.input_blockers if blocker else self.input_sniffers
+        target[plugin.plugin_name or type(plugin).__name__] = plugin
+
+    def process_input(self, app_id: int, channel_id: Optional[int],
+                      event: Event) -> None:
+        for p in self.input_blockers.values():
+            p.process(app_id, channel_id, event)
+        for p in self.input_sniffers.values():
+            self._pump.submit(
+                lambda p=p: p.process(app_id, channel_id, event))
+
+    def describe(self) -> dict:
+        def one(plugins: Dict[str, EventServerPlugin]) -> dict:
+            return {name: {"name": p.plugin_name,
+                           "description": p.plugin_description,
+                           "class": type(p).__qualname__}
+                    for name, p in plugins.items()}
+        return {"inputblockers": one(self.input_blockers),
+                "inputsniffers": one(self.input_sniffers)}
+
+
+class EngineServerPlugins:
+    def __init__(self):
+        self.output_blockers: Dict[str, EngineServerPlugin] = {}
+        self.output_sniffers: Dict[str, EngineServerPlugin] = {}
+        self._pump = _SnifferPump()
+
+    def register(self, plugin: EngineServerPlugin, *, blocker: bool) -> None:
+        target = self.output_blockers if blocker else self.output_sniffers
+        target[plugin.plugin_name or type(plugin).__name__] = plugin
+
+    def process_output(self, query: Any, prediction: Any) -> Any:
+        for p in self.output_blockers.values():
+            prediction = p.process(query, prediction)
+        for p in self.output_sniffers.values():
+            self._pump.submit(lambda p=p: p.process(query, prediction))
+        return prediction
+
+    def describe(self) -> dict:
+        def one(plugins: Dict[str, EngineServerPlugin]) -> dict:
+            return {name: {"name": p.plugin_name,
+                           "description": p.plugin_description,
+                           "class": type(p).__qualname__}
+                    for name, p in plugins.items()}
+        return {"outputblockers": one(self.output_blockers),
+                "outputsniffers": one(self.output_sniffers)}

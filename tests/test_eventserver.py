@@ -1,0 +1,259 @@
+"""Event Server REST conformance tests.
+
+Mirrors the reference's ``EventServiceSpec`` and the integration harness's
+``eventserver_test.py`` scenarios (auth, single/batch insert with the
+partially-malformed batch semantics, filtered reads, stats, webhooks).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.server.eventserver import build_app, create_event_server
+from predictionio_tpu.server.http import Request
+
+
+def make_storage() -> Storage:
+    st = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY"})
+    app_id = st.apps().insert(App(id=0, name="testapp", description=None))
+    st.access_keys().insert(
+        AccessKey(key="KEY1", app_id=app_id, events=[]))
+    st.access_keys().insert(
+        AccessKey(key="KEYLIMITED", app_id=app_id, events=["rate"]))
+    st.channels().insert(Channel(id=0, name="chan1", app_id=app_id))
+    return st
+
+
+@pytest.fixture()
+def server():
+    st = make_storage()
+    srv = create_event_server(st, host="127.0.0.1", port=0, stats=True)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def call(srv, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+EVENT = {"event": "rate", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 4.5},
+         "eventTime": "2024-01-02T03:04:05.678Z"}
+
+
+def test_status_alive(server):
+    status, body = call(server, "GET", "/")
+    assert status == 200 and body == {"status": "alive"}
+
+
+def test_post_requires_auth(server):
+    assert call(server, "POST", "/events.json", EVENT)[0] == 401
+    assert call(server, "POST", "/events.json?accessKey=WRONG", EVENT)[0] == 401
+
+
+def test_basic_auth_header(server):
+    import base64
+    creds = base64.b64encode(b"KEY1:").decode()
+    status, body = call(server, "POST", "/events.json", EVENT,
+                        {"Authorization": f"Basic {creds}"})
+    assert status == 201 and "eventId" in body
+
+
+def test_post_get_delete_roundtrip(server):
+    status, body = call(server, "POST", "/events.json?accessKey=KEY1", EVENT)
+    assert status == 201
+    eid = body["eventId"]
+
+    status, got = call(server, "GET", f"/events/{eid}.json?accessKey=KEY1")
+    assert status == 200
+    assert got["event"] == "rate" and got["entityId"] == "u1"
+    assert got["properties"] == {"rating": 4.5}
+    assert got["eventTime"] == "2024-01-02T03:04:05.678Z"
+
+    status, _ = call(server, "DELETE", f"/events/{eid}.json?accessKey=KEY1")
+    assert status == 200
+    status, _ = call(server, "GET", f"/events/{eid}.json?accessKey=KEY1")
+    assert status == 404
+
+
+def test_allowed_events_enforced(server):
+    status, _ = call(server, "POST", "/events.json?accessKey=KEYLIMITED", EVENT)
+    assert status == 201
+    bad = dict(EVENT, event="buy")
+    status, body = call(server, "POST", "/events.json?accessKey=KEYLIMITED", bad)
+    assert status == 403 and "not allowed" in body["message"]
+
+
+def test_malformed_event_400(server):
+    status, _ = call(server, "POST", "/events.json?accessKey=KEY1",
+                     {"entityType": "user"})
+    assert status == 400
+
+
+def test_channel_resolution(server):
+    status, _ = call(server, "POST",
+                     "/events.json?accessKey=KEY1&channel=chan1", EVENT)
+    assert status == 201
+    # channel-scoped read sees it; default channel does not
+    status, body = call(server, "GET",
+                        "/events.json?accessKey=KEY1&channel=chan1")
+    assert status == 200 and len(body) == 1
+    status, _ = call(server, "GET", "/events.json?accessKey=KEY1")
+    assert status == 404
+    status, _ = call(server, "POST",
+                     "/events.json?accessKey=KEY1&channel=nope", EVENT)
+    assert status == 401
+
+
+def test_get_events_filters(server):
+    for i in range(5):
+        e = dict(EVENT, entityId=f"u{i}",
+                 eventTime=f"2024-01-0{i + 1}T00:00:00.000Z")
+        assert call(server, "POST", "/events.json?accessKey=KEY1", e)[0] == 201
+    status, body = call(server, "GET", "/events.json?accessKey=KEY1")
+    assert status == 200 and len(body) == 5
+    status, body = call(
+        server, "GET",
+        "/events.json?accessKey=KEY1&startTime=2024-01-03T00:00:00.000Z")
+    assert len(body) == 3
+    status, body = call(server, "GET",
+                        "/events.json?accessKey=KEY1&entityId=u2")
+    assert len(body) == 1 and body[0]["entityId"] == "u2"
+    status, body = call(server, "GET", "/events.json?accessKey=KEY1&limit=2")
+    assert len(body) == 2
+    # reversed requires entityType+entityId
+    status, _ = call(server, "GET",
+                     "/events.json?accessKey=KEY1&reversed=true")
+    assert status == 400
+
+
+def test_batch_semantics(server):
+    batch = [
+        EVENT,                                   # ok
+        {"entityType": "user"},                  # malformed → 400
+        {"event": "$delete", "entityType": "user",
+         "entityId": "u9"},                      # ok (special event)
+    ]
+    status, body = call(server, "POST", "/batch/events.json?accessKey=KEY1",
+                        batch)
+    assert status == 200
+    assert [r["status"] for r in body] == [201, 400, 201]
+    assert "eventId" in body[0] and "message" in body[1]
+
+    too_many = [EVENT] * 51
+    status, body = call(server, "POST", "/batch/events.json?accessKey=KEY1",
+                        too_many)
+    assert status == 400
+
+
+def test_batch_allowed_events(server):
+    batch = [dict(EVENT, event="buy"), EVENT]
+    status, body = call(server, "POST",
+                        "/batch/events.json?accessKey=KEYLIMITED", batch)
+    assert [r["status"] for r in body] == [403, 201]
+
+
+def test_stats(server):
+    call(server, "POST", "/events.json?accessKey=KEY1", EVENT)
+    status, body = call(server, "GET", "/stats.json?accessKey=KEY1")
+    assert status == 200
+    assert body["basic"][0]["value"] == 1
+    assert body["statusCode"][0] == {"key": 201, "value": 1}
+
+
+def test_stats_disabled_404():
+    st = make_storage()
+    srv = create_event_server(st, host="127.0.0.1", port=0, stats=False)
+    srv.start_background()
+    try:
+        status, body = call(srv, "GET", "/stats.json?accessKey=KEY1")
+        assert status == 404 and "--stats" in body["message"]
+    finally:
+        srv.shutdown()
+
+
+def test_webhook_segmentio(server):
+    payload = {"type": "track", "version": "2", "user_id": "u42",
+               "timestamp": "2024-05-06T07:08:09.000Z",
+               "event": "signup", "properties": {"plan": "pro"}}
+    status, body = call(server, "POST",
+                        "/webhooks/segmentio.json?accessKey=KEY1", payload)
+    assert status == 201
+    eid = body["eventId"]
+    _, got = call(server, "GET", f"/events/{eid}.json?accessKey=KEY1")
+    assert got["event"] == "track"
+    assert got["entityType"] == "user" and got["entityId"] == "u42"
+    assert got["properties"]["event"] == "signup"
+    assert got["properties"]["properties"] == {"plan": "pro"}
+    assert got["eventTime"] == "2024-05-06T07:08:09.000Z"
+
+    status, _ = call(server, "GET",
+                     "/webhooks/segmentio.json?accessKey=KEY1")
+    assert status == 200
+    status, _ = call(server, "GET", "/webhooks/nope.json?accessKey=KEY1")
+    assert status == 404
+
+
+def test_webhook_mailchimp_form(server):
+    import urllib.parse
+    form = {
+        "type": "subscribe", "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+        "data[ip_opt]": "10.20.10.30", "data[ip_signup]": "10.20.10.30",
+    }
+    data = urllib.parse.urlencode(form).encode()
+    url = (f"http://127.0.0.1:{server.port}"
+           "/webhooks/mailchimp.form?accessKey=KEY1")
+    req = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 201
+        eid = json.loads(resp.read())["eventId"]
+    _, got = call(server, "GET", f"/events/{eid}.json?accessKey=KEY1")
+    assert got["event"] == "subscribe"
+    assert got["entityId"] == "8a25ff1d98"
+    assert got["targetEntityType"] == "list"
+    assert got["properties"]["merges"]["FNAME"] == "MailChimp"
+    assert got["eventTime"] == "2009-03-26T21:35:57.000Z"
+
+
+def test_input_blocker_plugin():
+    from predictionio_tpu.server.plugins import (
+        EventServerPlugin,
+        EventServerPlugins,
+    )
+
+    class RejectAll(EventServerPlugin):
+        plugin_name = "rejectall"
+
+        def process(self, app_id, channel_id, event):
+            raise ValueError("blocked by plugin")
+
+    st = make_storage()
+    plugins = EventServerPlugins()
+    plugins.register(RejectAll(), blocker=True)
+    app = build_app(st, plugins=plugins)
+    resp = app.handle(Request(
+        method="POST", path="/events.json", query={"accessKey": "KEY1"},
+        headers={}, body=json.dumps(EVENT).encode()))
+    assert resp.status == 500
+
+    resp = app.handle(Request(method="GET", path="/plugins.json", query={},
+                              headers={}, body=b""))
+    assert "rejectall" in json.loads(resp.encoded())["plugins"]["inputblockers"]
