@@ -1,0 +1,258 @@
+"""Engine server: deployed-model query serving.
+
+Capability parity with the reference engine server
+(``workflow/CreateServer.scala:109-705``): ``POST /queries.json`` runs
+supplement → per-algorithm predict → serve (:484-633, serving called with
+the *original* query by design :506-513), the feedback loop posts
+``predict`` events with a generated ``prId`` back to the event store
+(:527-589), ``/reload`` rebinds to the latest COMPLETED engine instance
+(``MasterActor`` :342-371), ``/stop`` shuts down, ``GET /`` renders a
+status page with per-request bookkeeping (:415-417,597-604), and output
+plugins transform/observe every prediction (:591-595).
+
+The TPU-minded difference: models stay resident in HBM and ``predict`` is
+expected to be a thin host wrapper over jitted device code, so the serving
+hot path never recompiles.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from ..controller.context import Context
+from ..controller.engine import Engine
+from ..controller.params import EngineParams
+from ..data.event import Event, utcnow
+from ..data.storage.base import EngineInstance
+from ..utils.jsonutil import from_jsonable, to_jsonable
+from .http import AppServer, HTTPApp, HTTPError, Request, Response, json_response
+from .plugins import EngineServerPlugins
+
+log = logging.getLogger(__name__)
+
+
+def _gen_pr_id() -> str:
+    """64-char alphanumeric prediction id (``CreateServer.scala:535``)."""
+    return secrets.token_hex(32)
+
+
+@dataclass
+class ServerConfig:
+    """Knobs of the reference's ``ServerConfig``
+    (``CreateServer.scala:78-96``)."""
+
+    feedback: bool = False
+    #: App receiving feedback events (required when ``feedback``).
+    feedback_app_name: Optional[str] = None
+    accesskey: Optional[str] = None  # require ?accessKey= on control routes
+
+
+class QueryServer:
+    """One deployed engine: algorithms + live models + serving logic."""
+
+    def __init__(self, ctx: Context, engine: Engine,
+                 engine_params: EngineParams, models: List[Any],
+                 instance: EngineInstance,
+                 config: Optional[ServerConfig] = None,
+                 plugins: Optional[EngineServerPlugins] = None):
+        self.ctx = ctx
+        self.engine = engine
+        self.config = config or ServerConfig()
+        self.plugins = plugins or EngineServerPlugins()
+        self._lock = threading.RLock()
+        self._bind(engine_params, models, instance)
+        # bookkeeping (CreateServer.scala:415-417)
+        self.start_time = utcnow()
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+
+    def _bind(self, engine_params: EngineParams, models: List[Any],
+              instance: EngineInstance) -> None:
+        with self._lock:
+            self.engine_params = engine_params
+            self.models = models
+            self.instance = instance
+            self.algorithms = self.engine.make_algorithms(engine_params)
+            self.serving = self.engine.make_serving(engine_params)
+
+    # -- the per-query hot path (CreateServer.scala:484-633) ---------------
+    def query(self, query_json: Any) -> Any:
+        t0 = time.monotonic()
+        with self._lock:
+            algorithms, models, serving = \
+                self.algorithms, self.models, self.serving
+            instance_id = self.instance.id
+        query_cls = algorithms[0].query_class
+        try:
+            query = from_jsonable(query_cls, query_json)
+        except (TypeError, ValueError) as e:
+            raise HTTPError(400, str(e))
+        supplemented = serving.supplement(query)
+        predictions = [a.predict(m, supplemented)
+                       for a, m in zip(algorithms, models)]
+        # by design: serve sees the original query (CreateServer.scala:511)
+        prediction = serving.serve(query, predictions)
+        result = to_jsonable(prediction)
+
+        if self.config.feedback:
+            result = self._feedback(query, query_json, result, instance_id)
+        result = self.plugins.process_output(query_json, result)
+
+        dt = time.monotonic() - t0
+        with self._lock:
+            self.last_serving_sec = dt
+            self.avg_serving_sec = (
+                (self.avg_serving_sec * self.request_count + dt)
+                / (self.request_count + 1))
+            self.request_count += 1
+        return result
+
+    def _feedback(self, query: Any, query_json: Any, result: Any,
+                  instance_id: str) -> Any:
+        """Record the prediction as a ``predict`` event on entity type
+        ``pio_pr`` (``CreateServer.scala:527-589``); injects ``prId`` into
+        the response when the prediction carries one."""
+        pr_id = _gen_pr_id()
+        if isinstance(result, dict) and result.get("prId"):
+            pr_id = result["prId"]
+        properties = {"engineInstanceId": instance_id,
+                      "query": to_jsonable(query_json),
+                      "prediction": result}
+        event = Event(event="predict", entity_type="pio_pr", entity_id=pr_id,
+                      properties=properties,
+                      pr_id=(query_json or {}).get("prId")
+                      if isinstance(query_json, dict) else None)
+        app_name = self.config.feedback_app_name
+        try:
+            app = self.ctx.storage.apps().get_by_name(app_name or "")
+            if app is None:
+                raise RuntimeError(
+                    f"feedback app {app_name!r} not found")
+            self.ctx.storage.events().insert(event, app.id)
+        except Exception as e:  # feedback must never fail the query
+            log.error("feedback event failed: %s", e)
+        if isinstance(result, dict):
+            result = dict(result, prId=pr_id)
+        return result
+
+    def reload(self) -> str:
+        """Rebind to the latest COMPLETED instance
+        (``MasterActor.receive`` :342-371)."""
+        from ..workflow import core as wf
+
+        latest = self.ctx.storage.engine_instances().get_latest_completed(
+            self.instance.engine_id, self.instance.engine_version,
+            self.instance.engine_variant)
+        if latest is None:
+            raise HTTPError(404, "no COMPLETED engine instance to reload")
+        engine_params = self.engine_params
+        models = wf.load_models_for_deploy(self.ctx, self.engine, latest,
+                                           engine_params)
+        self._bind(engine_params, models, latest)
+        log.info("reloaded engine instance %s", latest.id)
+        return latest.id
+
+
+def build_app(server: QueryServer) -> HTTPApp:
+    app = HTTPApp("engineserver")
+    cfg = server.config
+
+    def _auth(req: Request) -> None:
+        if cfg.accesskey and req.query.get("accessKey") != cfg.accesskey:
+            raise HTTPError(401, "Invalid accessKey.")
+
+    @app.route("GET", "/")
+    def index(req: Request) -> Response:
+        inst = server.instance
+        body = f"""<html><head><title>{html.escape(inst.engine_id)} \
+- predictionio_tpu engine server</title></head><body>
+<h1>Engine: {html.escape(inst.engine_id)} v{html.escape(inst.engine_version)}</h1>
+<ul>
+<li>engine instance: {html.escape(inst.id)}</li>
+<li>variant: {html.escape(inst.engine_variant)}</li>
+<li>started: {server.start_time.isoformat()}</li>
+<li>requests served: {server.request_count}</li>
+<li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
+<li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
+</ul></body></html>"""
+        return Response(body=body, content_type="text/html")
+
+    @app.route("GET", "/status.json")
+    def status(req: Request) -> Response:
+        return json_response({
+            "engineId": server.instance.engine_id,
+            "engineVersion": server.instance.engine_version,
+            "engineInstanceId": server.instance.id,
+            "requestCount": server.request_count,
+            "avgServingSec": server.avg_serving_sec,
+            "lastServingSec": server.last_serving_sec,
+        })
+
+    @app.route("POST", "/queries.json")
+    def queries(req: Request) -> Response:
+        try:
+            query_json = req.json()
+        except (ValueError, UnicodeDecodeError) as e:
+            raise HTTPError(400, str(e))
+        return json_response(server.query(query_json))
+
+    @app.route("POST", "/reload")
+    def reload(req: Request) -> Response:
+        _auth(req)
+        instance_id = server.reload()
+        return json_response({"message": "Reloading...",
+                              "engineInstanceId": instance_id})
+
+    @app.route("POST", "/stop")
+    def stop(req: Request) -> Response:
+        _auth(req)
+        threading.Thread(target=lambda: app_server_ref[0].shutdown(),
+                         daemon=True).start()
+        return json_response({"message": "Shutting down..."})
+
+    @app.route("GET", "/plugins.json")
+    def plugins_json(req: Request) -> Response:
+        return json_response({"plugins": server.plugins.describe()})
+
+    app_server_ref: List[AppServer] = []
+    app._server_ref = app_server_ref  # type: ignore[attr-defined]
+    return app
+
+
+def create_engine_server(server: QueryServer, host: str = "0.0.0.0",
+                         port: int = 8000) -> AppServer:
+    """Bind the engine server (reference default port 8000,
+    ``CreateServer.scala:78``)."""
+    app = build_app(server)
+    srv = AppServer(app, host, port)
+    app._server_ref.append(srv)  # type: ignore[attr-defined]
+    return srv
+
+
+def deploy(ctx: Context, engine: Engine, engine_params: EngineParams,
+           engine_id: str = "default", engine_version: str = "1",
+           engine_variant: str = "engine.json",
+           config: Optional[ServerConfig] = None,
+           host: str = "0.0.0.0", port: int = 8000) -> AppServer:
+    """The ``pio deploy`` flow (``commands/Engine.scala:207`` →
+    ``CreateServer``): find the latest COMPLETED instance, re-materialize
+    its models, bind the HTTP server."""
+    from ..workflow import core as wf
+
+    instance = ctx.storage.engine_instances().get_latest_completed(
+        engine_id, engine_version, engine_variant)
+    if instance is None:
+        raise RuntimeError(
+            f"No COMPLETED engine instance for {engine_id} {engine_version} "
+            f"{engine_variant}; run train first.")
+    models = wf.load_models_for_deploy(ctx, engine, instance, engine_params)
+    server = QueryServer(ctx, engine, engine_params, models, instance, config)
+    return create_engine_server(server, host, port)

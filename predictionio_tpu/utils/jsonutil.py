@@ -1,0 +1,54 @@
+"""JSON ⇄ typed-object conversion at the REST boundary.
+
+The role of the reference's ``JsonExtractor``
+(``workflow/JsonExtractor.scala:39-140``): turn wire JSON into the
+template's typed query class and predictions back into wire JSON. The
+reference needed dual json4s/gson modes for Scala/Java interop; here
+dataclasses (+ numpy/jax scalars) cover the surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Type
+
+import numpy as np
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Render dataclasses / numpy / jax values as JSON-compatible data."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if hasattr(obj, "to_json"):  # custom wire format wins over dataclass
+        return obj.to_json()
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: to_jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, Mapping):
+        return {str(k): to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_jsonable(x) for x in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "tolist"):  # jax.Array without importing jax here
+        return obj.tolist()
+    return str(obj)
+
+
+def from_jsonable(cls: Optional[Type], obj: Any) -> Any:
+    """Parse wire JSON into ``cls`` when it is a dataclass; pass through
+    otherwise. Unknown keys are rejected (mirrors the reference's strict
+    query mapping, which 400s on mismatch)."""
+    if cls is None or not dataclasses.is_dataclass(cls):
+        return obj
+    if not isinstance(obj, Mapping):
+        raise ValueError(f"expected JSON object for {cls.__name__}, "
+                         f"got {type(obj).__name__}")
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(obj) - names
+    if unknown:
+        raise ValueError(f"unknown field(s) for {cls.__name__}: "
+                         f"{sorted(unknown)}")
+    return cls(**obj)

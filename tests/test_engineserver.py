@@ -1,0 +1,170 @@
+"""Engine server + batch predict tests: train → deploy → HTTP queries →
+feedback/reload/stop, and the JSON-lines batch-predict flow."""
+
+import json
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import Context
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.server.engineserver import ServerConfig, deploy
+from predictionio_tpu.templates.recommendation import (
+    default_engine_params,
+    recommendation_engine,
+)
+from predictionio_tpu.workflow import run_train
+from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture(scope="module")
+def trained_ctx():
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, "srvapp"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(7)
+    events = []
+    t = T0
+    for u in range(20):
+        items = rng.choice(20, size=6, replace=False)
+        for i in items:
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                event_time=t))
+            t += timedelta(seconds=30)
+    es.insert_batch(events, app_id)
+    ctx = Context(app_name="srvapp", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("srvapp", rank=4, num_iterations=4, seed=3)
+    run_train(ctx, engine, ep, engine_id="srv", engine_version="1")
+    return ctx, engine, ep
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ctype
+                                 else raw.decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def served(trained_ctx):
+    ctx, engine, ep = trained_ctx
+    srv = deploy(ctx, engine, ep, engine_id="srv", engine_version="1",
+                 config=ServerConfig(feedback=True, feedback_app_name="srvapp"),
+                 host="127.0.0.1", port=0)
+    srv.start_background()
+    yield ctx, srv
+    srv.shutdown()
+
+
+class TestEngineServer:
+    def test_queries(self, served):
+        ctx, srv = served
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 5})
+        assert status == 200
+        assert len(body["itemScores"]) == 5
+        scores = [s["score"] for s in body["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_bad_query_400(self, served):
+        ctx, srv = served
+        status, _ = call(srv.port, "POST", "/queries.json",
+                         {"nonsense": True})
+        assert status == 400
+
+    def test_status_page_and_json(self, served):
+        ctx, srv = served
+        call(srv.port, "POST", "/queries.json", {"user": "u1", "num": 3})
+        status, html = call(srv.port, "GET", "/")
+        assert status == 200 and "requests served" in html
+        status, body = call(srv.port, "GET", "/status.json")
+        assert status == 200 and body["requestCount"] >= 1
+        assert body["engineId"] == "srv"
+
+    def test_feedback_event_written(self, served):
+        ctx, srv = served
+        before = len(list(ctx.event_store.find("srvapp",
+                                               event_names=["predict"])))
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u2", "num": 2})
+        assert status == 200
+        assert "prId" in body  # injected by feedback loop
+        predicts = list(ctx.event_store.find("srvapp",
+                                             event_names=["predict"]))
+        assert len(predicts) == before + 1
+        ev = predicts[-1]
+        assert ev.entity_type == "pio_pr"
+        assert ev.properties["query"] == {"user": "u2", "num": 2}
+        assert ev.properties["prediction"]["itemScores"]
+
+    def test_reload(self, served):
+        ctx, srv = served
+        status, body = call(srv.port, "POST", "/reload")
+        assert status == 200
+        assert body["engineInstanceId"]
+
+    def test_stop(self, trained_ctx):
+        ctx, engine, ep = trained_ctx
+        srv = deploy(ctx, engine, ep, engine_id="srv", engine_version="1",
+                     host="127.0.0.1", port=0)
+        srv.start_background()
+        status, body = call(srv.port, "POST", "/stop")
+        assert status == 200
+        import time
+        for _ in range(50):
+            try:
+                call(srv.port, "GET", "/status.json")
+                time.sleep(0.05)
+            except (ConnectionError, OSError):
+                break
+
+    def test_accesskey_guard(self, trained_ctx):
+        ctx, engine, ep = trained_ctx
+        srv = deploy(ctx, engine, ep, engine_id="srv", engine_version="1",
+                     config=ServerConfig(accesskey="SECRET"),
+                     host="127.0.0.1", port=0)
+        srv.start_background()
+        try:
+            assert call(srv.port, "POST", "/reload")[0] == 401
+            assert call(srv.port, "POST",
+                        "/reload?accessKey=SECRET")[0] == 200
+            # queries are not key-guarded (parity with reference default)
+            assert call(srv.port, "POST", "/queries.json",
+                        {"user": "u1", "num": 1})[0] == 200
+        finally:
+            srv.shutdown()
+
+
+class TestBatchPredict:
+    def test_jsonl_roundtrip(self, trained_ctx, tmp_path):
+        ctx, engine, ep = trained_ctx
+        inp = tmp_path / "queries.jsonl"
+        out = tmp_path / "predictions.jsonl"
+        queries = [{"user": f"u{i}", "num": 3} for i in range(5)]
+        inp.write_text("\n".join(json.dumps(q) for q in queries) + "\n\n")
+        n = run_batch_predict(ctx, engine, ep, str(inp), str(out),
+                              engine_id="srv", engine_version="1")
+        assert n == 5
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert len(lines) == 5
+        for q, line in zip(queries, lines):
+            assert line["query"] == q
+            assert len(line["prediction"]["itemScores"]) == 3
