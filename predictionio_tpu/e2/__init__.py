@@ -1,0 +1,18 @@
+"""e2 — reusable algorithm library beside the engine templates.
+
+Capability parity with the reference's ``e2/`` sbt module (SURVEY C28:
+``e2/src/main/scala/org/apache/predictionio/e2``), re-designed for TPU:
+string-keyed RDD combinators become integer-indexed vocabularies
+(:class:`~predictionio_tpu.data.bimap.BiMap`) plus dense arrays scored
+with jit-compiled jnp ops, so batch scoring runs on the MXU instead of a
+per-record Scala closure.
+"""
+
+from .naive_bayes import (  # noqa: F401
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    train_naive_bayes,
+)
+from .markov_chain import MarkovChainModel, train_markov_chain  # noqa: F401
+from .vectorizer import BinaryVectorizer  # noqa: F401
+from .cross_validation import split_data  # noqa: F401

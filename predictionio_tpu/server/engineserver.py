@@ -65,6 +65,15 @@ class QueryServer:
         self.ctx = ctx
         self.engine = engine
         self.config = config or ServerConfig()
+        if self.config.feedback:
+            # fail fast at deploy rather than logging per query
+            app_name = self.config.feedback_app_name
+            if not app_name:
+                raise ValueError(
+                    "feedback=True requires feedback_app_name")
+            if ctx.storage.apps().get_by_name(app_name) is None:
+                raise ValueError(
+                    f"feedback app {app_name!r} does not exist")
         self.plugins = plugins or EngineServerPlugins()
         self._lock = threading.RLock()
         self._bind(engine_params, models, instance)

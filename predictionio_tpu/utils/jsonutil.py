@@ -46,9 +46,36 @@ def from_jsonable(cls: Optional[Type], obj: Any) -> Any:
     if not isinstance(obj, Mapping):
         raise ValueError(f"expected JSON object for {cls.__name__}, "
                          f"got {type(obj).__name__}")
-    names = {f.name for f in dataclasses.fields(cls)}
-    unknown = set(obj) - names
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(obj) - set(fields)
     if unknown:
         raise ValueError(f"unknown field(s) for {cls.__name__}: "
                          f"{sorted(unknown)}")
-    return cls(**obj)
+    kwargs = {}
+    for name, value in obj.items():
+        ftype = _dataclass_type(fields[name].type, cls)
+        kwargs[name] = (from_jsonable(ftype, value)
+                        if ftype is not None else value)
+    return cls(**kwargs)
+
+
+def _dataclass_type(annotation: Any, owner: Type) -> Optional[Type]:
+    """Resolve a field annotation to a dataclass type (handles string
+    annotations and Optional[X]); None when the field isn't one."""
+    import sys
+    import typing
+
+    if isinstance(annotation, str):
+        mod = sys.modules.get(owner.__module__)
+        try:
+            annotation = eval(annotation, vars(mod) if mod else {})  # noqa: S307
+        except Exception:
+            return None
+    origin = typing.get_origin(annotation)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(annotation) if a is not type(None)]
+        if len(args) == 1:
+            annotation = args[0]
+    if isinstance(annotation, type) and dataclasses.is_dataclass(annotation):
+        return annotation
+    return None

@@ -21,7 +21,7 @@ from ..data.storage.base import EngineInstance
 from ..utils.jsonutil import from_jsonable, to_jsonable
 
 
-def batch_predict_lines(ctx: Context, engine: Engine,
+def batch_predict_lines(engine: Engine,
                         engine_params: EngineParams, models: List[Any],
                         query_lines: Iterable[str],
                         batch_size: int = 1024) -> Iterator[str]:
@@ -74,7 +74,7 @@ def run_batch_predict(ctx: Context, engine: Engine,
     n = 0
     with open(input_path, "r", encoding="utf-8") as fin, \
             open(output_path, "w", encoding="utf-8") as fout:
-        for line in batch_predict_lines(ctx, engine, engine_params, models,
+        for line in batch_predict_lines(engine, engine_params, models,
                                         fin, batch_size=batch_size):
             fout.write(line + "\n")
             n += 1

@@ -1,0 +1,215 @@
+"""e2 library tests, value-matched to the reference's e2 test suite
+(``e2/src/test/scala/org/apache/predictionio/e2/engine/*Test.scala``,
+``…/evaluation/CrossValidationTest.scala``)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    MarkovChainModel,
+    split_data,
+    train_markov_chain,
+    train_naive_bayes,
+)
+
+TOL = 1e-4
+
+BANANA, ORANGE, OTHER = "Banana", "Orange", "Other Fruit"
+LONG, NOT_LONG = "Long", "Not Long"
+SWEET, NOT_SWEET = "Sweet", "Not Sweet"
+YELLOW, NOT_YELLOW = "Yellow", "Not Yellow"
+
+FRUIT_POINTS = [
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [NOT_LONG, NOT_SWEET, NOT_YELLOW]),
+    LabeledPoint(ORANGE, [NOT_LONG, SWEET, NOT_YELLOW]),
+    LabeledPoint(ORANGE, [NOT_LONG, NOT_SWEET, NOT_YELLOW]),
+    LabeledPoint(OTHER, [LONG, SWEET, NOT_YELLOW]),
+    LabeledPoint(OTHER, [NOT_LONG, SWEET, NOT_YELLOW]),
+    LabeledPoint(OTHER, [LONG, SWEET, YELLOW]),
+    LabeledPoint(OTHER, [NOT_LONG, NOT_SWEET, NOT_YELLOW]),
+]
+
+
+@pytest.fixture(scope="module")
+def fruit_model() -> CategoricalNaiveBayesModel:
+    return train_naive_bayes(FRUIT_POINTS)
+
+
+class TestCategoricalNaiveBayes:
+    # CategoricalNaiveBayesTest.scala "have log priors and log likelihoods"
+    def test_priors(self, fruit_model):
+        assert fruit_model.prior(BANANA) == pytest.approx(-.7885, abs=TOL)
+        assert fruit_model.prior(ORANGE) == pytest.approx(-1.7047, abs=TOL)
+        assert fruit_model.prior(OTHER) == pytest.approx(-1.0116, abs=TOL)
+
+    def test_likelihoods(self, fruit_model):
+        m = fruit_model
+        assert m.likelihood(BANANA, 0, LONG) == pytest.approx(-.2231, abs=TOL)
+        assert m.likelihood(BANANA, 0, NOT_LONG) == pytest.approx(
+            -1.6094, abs=TOL)
+        assert m.likelihood(BANANA, 1, SWEET) == pytest.approx(-.2231, abs=TOL)
+        assert m.likelihood(BANANA, 2, YELLOW) == pytest.approx(
+            -.2231, abs=TOL)
+        # value never observed under a label → absent, not merely small
+        assert m.likelihood(ORANGE, 0, LONG) is None
+        assert m.likelihood(ORANGE, 0, NOT_LONG) == pytest.approx(0.0, abs=TOL)
+        assert m.likelihood(ORANGE, 1, SWEET) == pytest.approx(-.6931, abs=TOL)
+        assert m.likelihood(ORANGE, 2, NOT_YELLOW) == pytest.approx(
+            0.0, abs=TOL)
+        assert m.likelihood(ORANGE, 2, YELLOW) is None
+        assert m.likelihood(OTHER, 1, SWEET) == pytest.approx(-.2877, abs=TOL)
+        assert m.likelihood(OTHER, 2, NOT_YELLOW) == pytest.approx(
+            -.2877, abs=TOL)
+
+    # "be the log score of the given point"
+    def test_log_score(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, [LONG, NOT_SWEET, NOT_YELLOW]))
+        assert score == pytest.approx(-4.2304, abs=TOL)
+
+    # "be negative infinity for a point with a non-existing feature"
+    def test_log_score_unknown_feature(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, [LONG, NOT_SWEET, "Not Exist"]))
+        assert score == float("-inf")
+
+    # "be none for a point with a non-existing label"
+    def test_log_score_unknown_label(self, fruit_model):
+        assert fruit_model.log_score(
+            LabeledPoint("Not Exist", [LONG, NOT_SWEET, YELLOW])) is None
+
+    # "use the provided default likelihood function"
+    def test_default_likelihood(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, [LONG, NOT_SWEET, "Not Exist"]),
+            default_likelihood=lambda ls: math.log(1e-9))
+        assert score is not None and score != float("-inf")
+        assert score == pytest.approx(
+            fruit_model.prior(BANANA)
+            + fruit_model.likelihood(BANANA, 0, LONG)
+            + fruit_model.likelihood(BANANA, 1, NOT_SWEET)
+            + math.log(1e-9), abs=TOL)
+
+    def test_predict(self, fruit_model):
+        assert fruit_model.predict([LONG, SWEET, YELLOW]) == BANANA
+
+    def test_predict_batch_matches_pointwise(self, fruit_model):
+        batch = [p.features for p in FRUIT_POINTS]
+        got = fruit_model.predict_batch(batch)
+        want = [fruit_model.predict(f) for f in batch]
+        assert got == want
+
+    def test_pickle_after_predict_batch(self, fruit_model):
+        import pickle
+
+        fruit_model.predict_batch([[LONG, SWEET, YELLOW]])
+        clone = pickle.loads(pickle.dumps(fruit_model))
+        assert clone.predict_batch([[LONG, SWEET, YELLOW]]) == [BANANA]
+
+
+class TestMarkovChain:
+    # MarkovChainTest.scala fixtures
+    def test_two_by_two(self):
+        model = train_markov_chain(
+            rows=[0, 0, 1, 1], cols=[0, 1, 0, 1],
+            tallies=[3, 7, 10, 10], n_states=2, top_n=2)
+        assert model.n == 2
+        assert model.row(0) == [(0, pytest.approx(0.3)),
+                                (1, pytest.approx(0.7))]
+        assert model.row(1) == [(0, pytest.approx(0.5)),
+                                (1, pytest.approx(0.5))]
+
+    def test_top_n_only_normalized_by_full_total(self):
+        rows = [0, 0, 1, 1, 1, 1, 1, 2, 2, 2, 3, 3, 3, 4, 4, 4]
+        cols = [1, 2, 0, 1, 2, 3, 4, 1, 2, 4, 0, 3, 4, 1, 3, 4]
+        tallies = [12, 8, 3, 3, 9, 2, 8, 10, 8, 10, 2, 3, 4, 7, 8, 10]
+        model = train_markov_chain(rows, cols, tallies, n_states=5, top_n=2)
+        assert model.row(0) == [(1, pytest.approx(.6)),
+                                (2, pytest.approx(.4))]
+        assert model.row(1) == [(2, pytest.approx(9 / 25)),
+                                (4, pytest.approx(8 / 25))]
+        # tie at 10: keep lower column index (1 before 4)
+        assert model.row(2) == [(1, pytest.approx(10 / 28)),
+                                (4, pytest.approx(10 / 28))]
+        assert model.row(3) == [(3, pytest.approx(3 / 9)),
+                                (4, pytest.approx(4 / 9))]
+        assert model.row(4) == [(3, pytest.approx(8 / 25)),
+                                (4, pytest.approx(.4))]
+
+    def test_predict(self):
+        model = train_markov_chain(
+            rows=[0, 0, 1, 1], cols=[0, 1, 0, 1],
+            tallies=[3, 7, 10, 10], n_states=2, top_n=2)
+        nxt = model.predict([0.4, 0.6])
+        np.testing.assert_allclose(nxt, [0.42, 0.58], atol=1e-6)
+
+    def test_pickle_after_predict(self):
+        import pickle
+
+        model = train_markov_chain(
+            rows=[0, 0, 1, 1], cols=[0, 1, 0, 1],
+            tallies=[3, 7, 10, 10], n_states=2, top_n=2)
+        model.predict([0.4, 0.6])  # populates the jit cache
+        clone = pickle.loads(pickle.dumps(model))
+        np.testing.assert_allclose(clone.predict([0.4, 0.6]),
+                                   [0.42, 0.58], atol=1e-6)
+
+
+class TestBinaryVectorizer:
+    # BinaryVectorizerTest.scala semantics
+    def test_from_pairs_and_to_binary(self):
+        vz = BinaryVectorizer.from_pairs(
+            [("food", "orange"), ("food", "banana"), ("mood", "happy")])
+        assert vz.num_features == 3
+        np.testing.assert_array_equal(
+            vz.to_binary([("food", "banana"), ("mood", "happy")]),
+            [0.0, 1.0, 1.0])
+        # unknown pairs ignored
+        np.testing.assert_array_equal(
+            vz.to_binary([("food", "kiwi"), ("height", "tall")]),
+            [0.0, 0.0, 0.0])
+
+    def test_from_maps_filters_properties(self):
+        vz = BinaryVectorizer.from_maps(
+            [{"food": "orange", "height": "tall"},
+             {"food": "banana", "mood": "happy"}],
+            properties={"food", "mood"})
+        assert vz.num_features == 3  # height excluded
+        assert set(vz.properties) == {
+            ("food", "orange"), ("food", "banana"), ("mood", "happy")}
+
+    def test_to_matrix(self):
+        vz = BinaryVectorizer.from_pairs([("a", "1"), ("b", "2")])
+        m = vz.to_matrix([[("a", "1")], [("b", "2"), ("a", "1")], []])
+        np.testing.assert_array_equal(
+            m, [[1, 0], [1, 1], [0, 0]])
+
+
+class TestCrossValidation:
+    # CrossValidationTest.scala: fold i's test points are idx % k == i
+    def test_split_data(self):
+        data = list(range(10))
+        folds = split_data(
+            eval_k=3, dataset=data, evaluator_info="info",
+            training_data_creator=list,
+            query_creator=lambda d: ("q", d),
+            actual_creator=lambda d: ("a", d))
+        assert len(folds) == 3
+        for fold_idx, (td, ei, qa) in enumerate(folds):
+            assert ei == "info"
+            test_points = [d for i, d in enumerate(data)
+                           if i % 3 == fold_idx]
+            assert [q for q, _ in qa] == [("q", d) for d in test_points]
+            assert [a for _, a in qa] == [("a", d) for d in test_points]
+            assert td == [d for i, d in enumerate(data)
+                          if i % 3 != fold_idx]
+            assert len(td) + len(qa) == len(data)

@@ -129,12 +129,15 @@ class TestEngineServer:
         status, body = call(srv.port, "POST", "/stop")
         assert status == 200
         import time
+        stopped = False
         for _ in range(50):
             try:
                 call(srv.port, "GET", "/status.json")
                 time.sleep(0.05)
             except (ConnectionError, OSError):
+                stopped = True
                 break
+        assert stopped, "server still answering after /stop"
 
     def test_accesskey_guard(self, trained_ctx):
         ctx, engine, ep = trained_ctx

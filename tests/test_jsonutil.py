@@ -1,0 +1,50 @@
+"""JSON ⇄ dataclass conversion, incl. nested dataclasses (the reference
+``JsonExtractor`` handled nested case classes)."""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import pytest
+
+from predictionio_tpu.utils.jsonutil import from_jsonable, to_jsonable
+
+
+@dataclass
+class Filter:
+    categories: List[str]
+    max_price: Optional[float] = None
+
+
+@dataclass
+class Query:
+    user: str
+    num: int = 10
+    filter: Optional[Filter] = None
+
+
+def test_roundtrip_flat():
+    q = from_jsonable(Query, {"user": "u1", "num": 3})
+    assert q == Query(user="u1", num=3)
+    assert to_jsonable(q) == {"user": "u1", "num": 3, "filter": None}
+
+
+def test_nested_dataclass_parsed():
+    q = from_jsonable(Query, {"user": "u1",
+                              "filter": {"categories": ["a", "b"]}})
+    assert isinstance(q.filter, Filter)
+    assert q.filter.categories == ["a", "b"]
+    assert to_jsonable(q)["filter"] == {"categories": ["a", "b"],
+                                        "max_price": None}
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValueError, match="unknown field"):
+        from_jsonable(Query, {"user": "u1", "bogus": 1})
+    with pytest.raises(ValueError, match="unknown field"):
+        from_jsonable(Query, {"user": "u1",
+                              "filter": {"categories": [], "nope": 2}})
+
+
+def test_non_mapping_rejected():
+    with pytest.raises(ValueError, match="expected JSON object"):
+        from_jsonable(Query, [1, 2])
