@@ -1,0 +1,297 @@
+"""Classification models: multinomial naive Bayes + random forest.
+
+The role MLlib's ``NaiveBayes`` and ``RandomForest`` play for the
+classification template (reference
+``examples/scala-parallel-classification/add-algorithm/src/main/scala/
+{NaiveBayesAlgorithm,RandomForestAlgorithm}.scala``).
+
+TPU-first design:
+- Naive Bayes: MLlib-compatible multinomial fit (additive ``lambda``
+  smoothing over feature-value sums) producing a ``[C]`` log-prior vector
+  and ``[C, F]`` log-likelihood matrix; batch predict is one jitted
+  matmul + argmax (MXU work), not a per-point loop.
+- Random forest: trees are grown host-side (tree induction is branchy,
+  data-dependent control flow — exactly what XLA can't tile), but the
+  fitted forest is ENCODED AS DENSE ARRAYS (feature / threshold /
+  left / right / leaf-class per node, padded across trees) so inference
+  is ``max_depth`` fused gathers under ``lax.fori_loop`` — fixed shapes,
+  no host round-trips, batched over queries and trees at once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# multinomial naive Bayes (MLlib NaiveBayes.train(data, lambda) parity)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NaiveBayesModel:
+    log_priors: np.ndarray       # [C]
+    log_likelihoods: np.ndarray  # [C, F]
+    classes: np.ndarray          # [C] original class labels (float/int)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_scorer", None)
+        return state
+
+    def predict(self, features: Sequence[float]) -> float:
+        x = np.asarray(features, dtype=np.float64)
+        scores = self.log_priors + self.log_likelihoods @ x
+        return float(self.classes[int(np.argmax(scores))])
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        """[B, F] → [B] labels via one jitted matmul."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_scorer"):
+            lp = jnp.asarray(self.log_priors, dtype=jnp.float32)
+            ll = jnp.asarray(self.log_likelihoods, dtype=jnp.float32)
+            self._scorer = jax.jit(
+                lambda x: jnp.argmax(x @ ll.T + lp, axis=1))
+        idx = np.asarray(self._scorer(
+            np.asarray(features, dtype=np.float32)))
+        return self.classes[idx]
+
+
+def train_naive_bayes_multinomial(features: np.ndarray, labels: np.ndarray,
+                                  lam: float = 1.0) -> NaiveBayesModel:
+    """MLlib multinomial NB: ``pi_c = log((N_c + λ)/(N + λC))``,
+    ``theta_cf = log((Σ x_f|c + λ)/(Σ x|c + λF))``. Features must be
+    non-negative (counts/one-hot)."""
+    features = np.asarray(features, dtype=np.float64)
+    labels = np.asarray(labels)
+    if features.ndim != 2 or len(features) != len(labels):
+        raise ValueError("features must be [N, F] aligned with labels")
+    if (features < 0).any():
+        raise ValueError("multinomial NB requires non-negative features")
+    classes, class_idx = np.unique(labels, return_inverse=True)
+    C, F = len(classes), features.shape[1]
+    counts = np.bincount(class_idx, minlength=C).astype(np.float64)
+    sums = np.zeros((C, F), dtype=np.float64)
+    np.add.at(sums, class_idx, features)
+    log_priors = np.log(counts + lam) - np.log(len(labels) + lam * C)
+    log_likelihoods = (np.log(sums + lam)
+                       - np.log(sums.sum(axis=1, keepdims=True) + lam * F))
+    return NaiveBayesModel(log_priors, log_likelihoods, classes)
+
+
+# ---------------------------------------------------------------------------
+# random forest (MLlib RandomForest.trainClassifier parity)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RandomForestParams:
+    num_classes: int = 2
+    num_trees: int = 10
+    feature_subset_strategy: str = "auto"  # auto|all|sqrt|log2|onethird
+    impurity: str = "gini"
+    max_depth: int = 5
+    max_bins: int = 32
+    seed: int = 0
+
+
+class RandomForestModel:
+    """Forest encoded as dense per-node arrays, padded across trees.
+
+    ``feature[t, n] < 0`` marks a leaf whose class is ``leaf[t, n]``;
+    internal nodes route to ``left/right[t, n]`` on
+    ``x[feature] <= threshold``.
+    """
+
+    def __init__(self, feature: np.ndarray, threshold: np.ndarray,
+                 left: np.ndarray, right: np.ndarray, leaf: np.ndarray,
+                 classes: np.ndarray, max_depth: int):
+        self.feature = feature      # [T, N] int32 (−1 = leaf)
+        self.threshold = threshold  # [T, N] float32
+        self.left = left            # [T, N] int32
+        self.right = right          # [T, N] int32
+        self.leaf = leaf            # [T, N] int32 (class index)
+        self.classes = classes
+        self.max_depth = max_depth
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_traverse", None)
+        return state
+
+    def predict(self, features: Sequence[float]) -> float:
+        return float(self.predict_batch(
+            np.asarray(features, dtype=np.float32)[None, :])[0])
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        """[B, F] → [B] labels: fixed-depth vectorized traversal of all
+        trees at once, majority vote."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        if not hasattr(self, "_traverse"):
+            feat = jnp.asarray(self.feature)
+            thr = jnp.asarray(self.threshold)
+            lft = jnp.asarray(self.left)
+            rgt = jnp.asarray(self.right)
+            leaf = jnp.asarray(self.leaf)
+            n_classes = len(self.classes)
+            depth = self.max_depth + 1
+
+            @jax.jit
+            def traverse(x):  # [B, F] → [B] class index
+                B = x.shape[0]
+                T = feat.shape[0]
+                node = jnp.zeros((B, T), dtype=jnp.int32)
+
+                def step(_, node):
+                    f = jnp.take_along_axis(feat[None], node[..., None],
+                                            axis=2)[..., 0]   # [B, T]
+                    t = jnp.take_along_axis(thr[None], node[..., None],
+                                            axis=2)[..., 0]
+                    l = jnp.take_along_axis(lft[None], node[..., None],
+                                            axis=2)[..., 0]
+                    r = jnp.take_along_axis(rgt[None], node[..., None],
+                                            axis=2)[..., 0]
+                    xv = jnp.take_along_axis(
+                        x, jnp.maximum(f, 0), axis=1)         # [B, T]
+                    nxt = jnp.where(xv <= t, l, r)
+                    return jnp.where(f < 0, node, nxt)
+
+                node = lax.fori_loop(0, depth, step, node)
+                cls = jnp.take_along_axis(leaf[None], node[..., None],
+                                          axis=2)[..., 0]     # [B, T]
+                votes = jax.nn.one_hot(cls, n_classes).sum(axis=1)
+                return jnp.argmax(votes, axis=1)
+
+            self._traverse = traverse
+        idx = np.asarray(self._traverse(
+            np.asarray(features, dtype=np.float32)))
+        return self.classes[idx]
+
+
+def _n_subset_features(strategy: str, n_features: int) -> int:
+    if strategy in ("auto", "sqrt"):
+        return max(1, int(np.sqrt(n_features)))
+    if strategy == "log2":
+        return max(1, int(np.log2(n_features)))
+    if strategy == "onethird":
+        return max(1, n_features // 3)
+    return n_features  # "all"
+
+
+def _gini(counts: np.ndarray) -> float:
+    n = counts.sum()
+    if n == 0:
+        return 0.0
+    p = counts / n
+    return float(1.0 - (p * p).sum())
+
+
+def _entropy(counts: np.ndarray) -> float:
+    n = counts.sum()
+    if n == 0:
+        return 0.0
+    p = counts[counts > 0] / n
+    return float(-(p * np.log2(p)).sum())
+
+
+def train_random_forest(features: np.ndarray, labels: np.ndarray,
+                        params: RandomForestParams) -> RandomForestModel:
+    """Bootstrap + per-node feature subsetting + binned threshold search
+    (MLlib ``RandomForest.trainClassifier`` semantics at template scale)."""
+    X = np.asarray(features, dtype=np.float32)
+    y_raw = np.asarray(labels)
+    classes, y = np.unique(y_raw, return_inverse=True)
+    if len(classes) > params.num_classes:
+        raise ValueError(
+            f"found {len(classes)} distinct labels but num_classes="
+            f"{params.num_classes} (MLlib trainClassifier validates this)")
+    n, F = X.shape
+    C = len(classes)
+    impurity_fn = _gini if params.impurity == "gini" else _entropy
+    rng = np.random.default_rng(params.seed)
+    k_feats = _n_subset_features(params.feature_subset_strategy, F)
+
+    trees = []
+    for _ in range(params.num_trees):
+        sample = rng.integers(0, n, n)  # bootstrap
+        nodes = {"feature": [], "threshold": [], "left": [], "right": [],
+                 "leaf": []}
+
+        def new_node():
+            for v in nodes.values():
+                v.append(0)
+            nodes["feature"][-1] = -1
+            return len(nodes["feature"]) - 1
+
+        def grow(idx: np.ndarray, depth: int) -> int:
+            me = new_node()
+            counts = np.bincount(y[idx], minlength=C).astype(np.float64)
+            majority = int(np.argmax(counts))
+            nodes["leaf"][me] = majority
+            if depth >= params.max_depth or len(np.unique(y[idx])) <= 1 \
+                    or len(idx) < 2:
+                return me
+            parent_imp = impurity_fn(counts)
+            best = (0.0, None, None)  # (gain, feature, threshold)
+            for f in rng.choice(F, size=k_feats, replace=False):
+                vals = X[idx, f]
+                uniq = np.unique(vals)
+                if len(uniq) <= 1:
+                    continue
+                if len(uniq) > params.max_bins:
+                    qs = np.quantile(vals, np.linspace(0, 1,
+                                                       params.max_bins + 1)
+                                     [1:-1])
+                    cand = np.unique(qs)
+                else:
+                    cand = (uniq[:-1] + uniq[1:]) / 2
+                for t in cand:
+                    mask = vals <= t
+                    nl = mask.sum()
+                    if nl == 0 or nl == len(idx):
+                        continue
+                    cl = np.bincount(y[idx[mask]], minlength=C)
+                    cr = counts - cl
+                    gain = parent_imp - (
+                        nl / len(idx) * impurity_fn(cl.astype(np.float64))
+                        + (1 - nl / len(idx))
+                        * impurity_fn(cr.astype(np.float64)))
+                    if gain > best[0]:
+                        best = (gain, int(f), float(t))
+            if best[1] is None:
+                return me
+            _, f, t = best
+            mask = X[idx, f] <= t
+            li = grow(idx[mask], depth + 1)
+            ri = grow(idx[~mask], depth + 1)
+            nodes["feature"][me] = f
+            nodes["threshold"][me] = t
+            nodes["left"][me] = li
+            nodes["right"][me] = ri
+            return me
+
+        grow(sample, 0)
+        trees.append(nodes)
+
+    max_nodes = max(len(t["feature"]) for t in trees)
+    T = len(trees)
+    feature = np.full((T, max_nodes), -1, dtype=np.int32)
+    threshold = np.zeros((T, max_nodes), dtype=np.float32)
+    left = np.zeros((T, max_nodes), dtype=np.int32)
+    right = np.zeros((T, max_nodes), dtype=np.int32)
+    leaf = np.zeros((T, max_nodes), dtype=np.int32)
+    for ti, t in enumerate(trees):
+        m = len(t["feature"])
+        feature[ti, :m] = t["feature"]
+        threshold[ti, :m] = t["threshold"]
+        left[ti, :m] = t["left"]
+        right[ti, :m] = t["right"]
+        leaf[ti, :m] = t["leaf"]
+    return RandomForestModel(feature, threshold, left, right, leaf,
+                             classes, params.max_depth)

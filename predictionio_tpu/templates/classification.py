@@ -1,0 +1,207 @@
+"""Classification engine template (two algorithms, P2L pattern).
+
+Capability parity with the reference
+``examples/scala-parallel-classification/add-algorithm/``: the DataSource
+aggregates ``user`` entity properties requiring ``plan`` (the label) and
+``attr0/attr1/attr2`` (features) (``DataSource.scala:45-71``); algorithms
+are MLlib-style multinomial naive Bayes with ``lambda`` smoothing
+(``NaiveBayesAlgorithm.scala:30-58``) and a random forest
+(``RandomForestAlgorithm.scala:35-70``); queries carry the three
+attributes and predictions return the label
+(``Engine.scala`` Query/PredictedResult).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    AverageMetric,
+    Context,
+    DataSource,
+    Engine,
+    EngineParams,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from ..e2.cross_validation import split_data
+from ..models.classify import (
+    NaiveBayesModel,
+    RandomForestModel,
+    RandomForestParams,
+    train_naive_bayes_multinomial,
+    train_random_forest,
+)
+
+
+@dataclass(frozen=True)
+class Query:
+    attr0: float
+    attr1: float
+    attr2: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    label: float
+
+    def to_json(self) -> dict:
+        return {"label": self.label}
+
+
+@dataclass(frozen=True)
+class ActualResult:
+    label: float
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    features: np.ndarray  # [N, 3]
+    labels: np.ndarray    # [N]
+
+    def sanity_check(self):
+        if len(self.features) == 0:
+            raise ValueError("TrainingData is empty; are user entities "
+                             "missing plan/attr0/attr1/attr2 properties?")
+
+
+@dataclass(frozen=True)
+class DataSourceParams:
+    app_name: str = ""
+    eval_k: Optional[int] = None
+
+
+_REQUIRED = ("plan", "attr0", "attr1", "attr2")
+
+
+class ClassificationDataSource(DataSource):
+    def __init__(self, params: DataSourceParams = DataSourceParams()):
+        self.params = params
+
+    def _read_points(self, ctx: Context) -> Tuple[np.ndarray, np.ndarray]:
+        props = ctx.event_store.aggregate_properties(
+            self.params.app_name or ctx.app_name, entity_type="user",
+            required=list(_REQUIRED))
+        feats, labels = [], []
+        for entity_id, pm in sorted(props.items()):
+            labels.append(float(pm.get("plan")))
+            feats.append([float(pm.get("attr0")), float(pm.get("attr1")),
+                          float(pm.get("attr2"))])
+        return (np.asarray(feats, dtype=np.float64).reshape(-1, 3),
+                np.asarray(labels, dtype=np.float64))
+
+    def read_training(self, ctx: Context) -> TrainingData:
+        X, y = self._read_points(ctx)
+        return TrainingData(X, y)
+
+    def read_eval(self, ctx: Context):
+        """k-fold split, fold i tests points with index % k == i
+        (``DataSource.scala:112-123`` via CrossValidation semantics)."""
+        if not self.params.eval_k:
+            raise ValueError("DataSourceParams.eval_k must be set for eval")
+        X, y = self._read_points(ctx)
+        points = list(zip(X, y))
+        return split_data(
+            self.params.eval_k, points, evaluator_info=None,
+            training_data_creator=lambda pts: TrainingData(
+                np.asarray([p[0] for p in pts]).reshape(-1, 3),
+                np.asarray([p[1] for p in pts])),
+            query_creator=lambda p: Query(*map(float, p[0])),
+            actual_creator=lambda p: ActualResult(float(p[1])))
+
+
+@dataclass(frozen=True)
+class NaiveBayesParams:
+    lambda_: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """``NaiveBayesAlgorithm.scala:30-58``."""
+
+    query_class = Query
+
+    def __init__(self, params: NaiveBayesParams = NaiveBayesParams()):
+        self.params = params
+
+    def train(self, ctx: Context, data: TrainingData) -> NaiveBayesModel:
+        if len(data.features) == 0:
+            raise ValueError("labeledPoints cannot be empty")
+        return train_naive_bayes_multinomial(data.features, data.labels,
+                                             lam=self.params.lambda_)
+
+    def predict(self, model: NaiveBayesModel, query: Query
+                ) -> PredictedResult:
+        return PredictedResult(model.predict(
+            [query.attr0, query.attr1, query.attr2]))
+
+    def batch_predict(self, model: NaiveBayesModel,
+                      queries: Sequence[Query]) -> List[PredictedResult]:
+        X = np.asarray([[q.attr0, q.attr1, q.attr2] for q in queries])
+        return [PredictedResult(float(l))
+                for l in model.predict_batch(X)]
+
+
+class RandomForestAlgorithm(Algorithm):
+    """``RandomForestAlgorithm.scala:35-70``."""
+
+    query_class = Query
+
+    def __init__(self, params: RandomForestParams = RandomForestParams()):
+        self.params = params
+
+    def train(self, ctx: Context, data: TrainingData) -> RandomForestModel:
+        if len(data.features) == 0:
+            raise ValueError("labeledPoints cannot be empty")
+        return train_random_forest(data.features, data.labels, self.params)
+
+    def predict(self, model: RandomForestModel, query: Query
+                ) -> PredictedResult:
+        return PredictedResult(model.predict(
+            [query.attr0, query.attr1, query.attr2]))
+
+    def batch_predict(self, model: RandomForestModel,
+                      queries: Sequence[Query]) -> List[PredictedResult]:
+        X = np.asarray([[q.attr0, q.attr1, q.attr2] for q in queries])
+        return [PredictedResult(float(l))
+                for l in model.predict_batch(X)]
+
+
+class Accuracy(AverageMetric):
+    """Fraction of exact label matches (the template's eval metric)."""
+
+    header = "Accuracy"
+
+    def calculate_point(self, ei, q: Query, p: PredictedResult,
+                        a: ActualResult) -> float:
+        return 1.0 if p.label == a.label else 0.0
+
+
+def classification_engine() -> Engine:
+    """``Engine.scala`` factory: naive Bayes + random forest slots."""
+    return Engine(
+        datasource_classes=ClassificationDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"naive": NaiveBayesAlgorithm,
+                           "randomforest": RandomForestAlgorithm,
+                           "": NaiveBayesAlgorithm},
+        serving_classes=FirstServing,
+        datasource_params_class=DataSourceParams,
+        algorithm_params_classes={"naive": NaiveBayesParams,
+                                  "randomforest": RandomForestParams,
+                                  "": NaiveBayesParams},
+    )
+
+
+def default_engine_params(app_name: str, algo: str = "naive",
+                          **algo_kw) -> EngineParams:
+    params_cls = {"naive": NaiveBayesParams,
+                  "randomforest": RandomForestParams}[algo]
+    return EngineParams(
+        datasource=("", DataSourceParams(app_name=app_name)),
+        algorithms=[(algo, params_cls(**algo_kw))],
+    )

@@ -47,16 +47,36 @@ def from_jsonable(cls: Optional[Type], obj: Any) -> Any:
         raise ValueError(f"expected JSON object for {cls.__name__}, "
                          f"got {type(obj).__name__}")
     fields = {f.name: f for f in dataclasses.fields(cls)}
-    unknown = set(obj) - set(fields)
-    if unknown:
-        raise ValueError(f"unknown field(s) for {cls.__name__}: "
-                         f"{sorted(unknown)}")
+    # the reference's wire format is camelCase (e.g. whiteList) while the
+    # dataclasses are snake_case; accept both spellings on input
+    normalized = {}
+    for key, value in obj.items():
+        name = key if key in fields else _snake_case(key)
+        if name not in fields and f"{name}_" in fields:
+            name = f"{name}_"  # python-keyword fields, e.g. lambda → lambda_
+        if name not in fields:
+            raise ValueError(f"unknown field(s) for {cls.__name__}: "
+                             f"[{key!r}]")
+        if name in normalized:
+            raise ValueError(f"duplicate field for {cls.__name__}: {key!r}")
+        normalized[name] = value
     kwargs = {}
-    for name, value in obj.items():
+    for name, value in normalized.items():
         ftype = _dataclass_type(fields[name].type, cls)
         kwargs[name] = (from_jsonable(ftype, value)
                         if ftype is not None else value)
     return cls(**kwargs)
+
+
+def _snake_case(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 def _dataclass_type(annotation: Any, owner: Type) -> Optional[Type]:

@@ -48,3 +48,22 @@ def test_unknown_field_rejected():
 def test_non_mapping_rejected():
     with pytest.raises(ValueError, match="expected JSON object"):
         from_jsonable(Query, [1, 2])
+
+
+def test_camelcase_wire_format_accepted():
+    """The reference's wire format is camelCase; snake_case dataclasses
+    must accept it (e.g. similarproduct whiteList/categoryBlackList)."""
+    from predictionio_tpu.templates.similarproduct import Query as SPQuery
+
+    q = from_jsonable(SPQuery, {"items": ["i0"], "num": 3,
+                                "whiteList": ["i1"],
+                                "categoryBlackList": ["c0"]})
+    assert q.white_list == ("i1",)
+    assert q.category_black_list == ("c0",)
+
+
+def test_python_keyword_field_alias():
+    from predictionio_tpu.templates.classification import NaiveBayesParams
+
+    p = from_jsonable(NaiveBayesParams, {"lambda": 2.0})
+    assert p.lambda_ == 2.0

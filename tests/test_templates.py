@@ -1,0 +1,430 @@
+"""Classification, similar-product, and e-commerce template tests
+(SURVEY §2.2 parity: the behaviors the reference templates exercise)."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import Context
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.data.storage.registry import set_storage
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+def make_ctx(app_name: str, events) -> Context:
+    storage = Storage(env=MEM_ENV)
+    app_id = storage.apps().insert(App(0, app_name))
+    storage.events().init(app_id)
+    storage.events().insert_batch(list(events), app_id)
+    return Context(app_name=app_name, _storage=storage)
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def classification_events():
+    """Users whose plan is determined by attr0 vs attr2 dominance."""
+    rng = np.random.default_rng(7)
+    events = []
+    for u in range(60):
+        plan = float(u % 2)
+        if plan == 0.0:
+            attrs = [rng.integers(5, 10), rng.integers(0, 3),
+                     rng.integers(0, 3)]
+        else:
+            attrs = [rng.integers(0, 3), rng.integers(0, 3),
+                     rng.integers(5, 10)]
+        events.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{u}",
+            properties=DataMap({"plan": plan,
+                                "attr0": float(attrs[0]),
+                                "attr1": float(attrs[1]),
+                                "attr2": float(attrs[2])}),
+            event_time=T0 + timedelta(minutes=u)))
+    return events
+
+
+@pytest.fixture(scope="module")
+def cls_ctx():
+    return make_ctx("clsapp", classification_events())
+
+
+class TestClassificationTemplate:
+    def test_naive_bayes_lifecycle(self, cls_ctx):
+        from predictionio_tpu.templates.classification import (
+            Query, classification_engine, default_engine_params)
+
+        engine = classification_engine()
+        ep = default_engine_params("clsapp", algo="naive")
+        result = engine.train(cls_ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        # strongly attr0-dominant → plan 0; attr2-dominant → plan 1
+        assert algo.predict(result.models[0],
+                            Query(8.0, 1.0, 0.0)).label == 0.0
+        assert algo.predict(result.models[0],
+                            Query(0.0, 1.0, 8.0)).label == 1.0
+
+    def test_random_forest_lifecycle(self, cls_ctx):
+        from predictionio_tpu.templates.classification import (
+            Query, classification_engine, default_engine_params)
+
+        engine = classification_engine()
+        ep = default_engine_params("clsapp", algo="randomforest",
+                                   num_classes=2, num_trees=8, max_depth=4,
+                                   seed=3)
+        result = engine.train(cls_ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        assert algo.predict(result.models[0],
+                            Query(8.0, 1.0, 0.0)).label == 0.0
+        assert algo.predict(result.models[0],
+                            Query(0.0, 1.0, 8.0)).label == 1.0
+
+    def test_batch_predict_matches_single(self, cls_ctx):
+        from predictionio_tpu.templates.classification import (
+            Query, classification_engine, default_engine_params)
+
+        engine = classification_engine()
+        ep = default_engine_params("clsapp", algo="randomforest",
+                                   num_classes=2, num_trees=5, seed=1)
+        model = engine.train(cls_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        queries = [Query(8.0, 1.0, 0.0), Query(0.0, 0.0, 7.0),
+                   Query(6.0, 2.0, 1.0)]
+        batch = algo.batch_predict(model, queries)
+        single = [algo.predict(model, q) for q in queries]
+        assert [b.label for b in batch] == [s.label for s in single]
+
+    def test_eval_kfold_accuracy(self, cls_ctx):
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.templates.classification import (
+            Accuracy, DataSourceParams, NaiveBayesParams,
+            classification_engine)
+        from predictionio_tpu.controller.params import EngineParams
+        from predictionio_tpu.workflow import run_evaluation
+
+        engine = classification_engine()
+        ep = EngineParams(
+            datasource=("", DataSourceParams(app_name="clsapp", eval_k=3)),
+            algorithms=[("naive", NaiveBayesParams())])
+        evaluation = Evaluation(engine=engine, metric=Accuracy())
+        result = run_evaluation(cls_ctx, evaluation, [ep])
+        assert result.best_score > 0.8  # separable by construction
+
+    def test_model_pickles(self, cls_ctx):
+        import pickle
+
+        from predictionio_tpu.templates.classification import (
+            Query, classification_engine, default_engine_params)
+
+        engine = classification_engine()
+        ep = default_engine_params("clsapp", algo="naive")
+        model = engine.train(cls_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        algo.batch_predict(model, [Query(8.0, 1.0, 0.0)])  # warm jit cache
+        clone = pickle.loads(pickle.dumps(model))
+        assert algo.predict(clone, Query(8.0, 1.0, 0.0)).label == 0.0
+
+
+# ---------------------------------------------------------------------------
+# similar product
+# ---------------------------------------------------------------------------
+
+def similarproduct_events():
+    """Two disjoint view communities + like/dislike signals; items carry
+    categories c0 (items 0-9) / c1 (items 10-19)."""
+    rng = np.random.default_rng(11)
+    events = []
+    for u in range(30):
+        events.append(Event(event="$set", entity_type="user",
+                            entity_id=f"u{u}", event_time=T0))
+    for i in range(20):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap(
+                {"categories": ["c0" if i < 10 else "c1"]}),
+            event_time=T0))
+    t = T0
+    for u in range(30):
+        pool = range(0, 10) if u % 2 == 0 else range(10, 20)
+        for i in rng.choice(list(pool), size=6, replace=False):
+            events.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                event_time=t))
+            t += timedelta(seconds=30)
+        # like one in-pool item; dislike one out-of-pool item
+        events.append(Event(
+            event="like", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.choice(list(pool))}", event_time=t))
+        other = range(10, 20) if u % 2 == 0 else range(0, 10)
+        events.append(Event(
+            event="dislike", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.choice(list(other))}", event_time=t))
+        t += timedelta(seconds=30)
+    return events
+
+
+@pytest.fixture(scope="module")
+def sp_ctx():
+    return make_ctx("spapp", similarproduct_events())
+
+
+class TestSimilarProductTemplate:
+    def _train(self, ctx, algo_name, params=None):
+        from predictionio_tpu.controller.params import EngineParams
+        from predictionio_tpu.models.als import ALSParams
+        from predictionio_tpu.templates.similarproduct import (
+            CooccurrenceParams, DataSourceParams, similarproduct_engine)
+
+        engine = similarproduct_engine()
+        if params is None:
+            params = (CooccurrenceParams() if algo_name == "cooccurrence"
+                      else ALSParams(rank=8, num_iterations=10,
+                                     implicit_prefs=True, alpha=1.0, seed=5))
+        ep = EngineParams(
+            datasource=("", DataSourceParams(app_name="spapp")),
+            algorithms=[(algo_name, params)])
+        result = engine.train(ctx, ep)
+        return engine, ep, result.models[0]
+
+    def test_als_similar_items_stay_in_community(self, sp_ctx):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, ep, model = self._train(sp_ctx, "als")
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(items=["i0"], num=5))
+        assert pred.item_scores
+        top = [int(s.item[1:]) for s in pred.item_scores]
+        assert "i0" not in [s.item for s in pred.item_scores]
+        in_comm = sum(1 for i in top if i < 10)
+        assert in_comm >= 3, f"community leak: {top}"
+
+    def test_cooccurrence_counts(self, sp_ctx):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, ep, model = self._train(sp_ctx, "cooccurrence")
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(items=["i0"], num=5))
+        assert pred.item_scores
+        # co-occurrence can only surface same-community items
+        assert all(int(s.item[1:]) < 10 for s in pred.item_scores)
+        scores = [s.score for s in pred.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_like_algorithm(self, sp_ctx):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, ep, model = self._train(sp_ctx, "likealgo")
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(items=["i1"], num=5))
+        assert pred.item_scores  # ±1 signal still yields neighbors
+
+    def test_filters(self, sp_ctx):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, ep, model = self._train(sp_ctx, "cooccurrence")
+        algo = engine.make_algorithms(ep)[0]
+        white = algo.predict(model, Query(
+            items=["i0"], num=10, white_list=["i2", "i4"]))
+        assert {s.item for s in white.item_scores} <= {"i2", "i4"}
+        black = algo.predict(model, Query(
+            items=["i0"], num=10, black_list=["i2"]))
+        assert "i2" not in {s.item for s in black.item_scores}
+        cat = algo.predict(model, Query(
+            items=["i0"], num=10, categories=["c1"]))
+        assert all(int(s.item[1:]) >= 10 for s in cat.item_scores) \
+            or not cat.item_scores
+        catbl = algo.predict(model, Query(
+            items=["i0"], num=10, category_black_list=["c0"]))
+        assert all(int(s.item[1:]) >= 10 for s in catbl.item_scores) \
+            or not catbl.item_scores
+
+    def test_serving_standardizes_and_combines(self, sp_ctx):
+        from predictionio_tpu.templates.similarproduct import (
+            ItemScore, PredictedResult, Query, SimilarProductServing)
+
+        serving = SimilarProductServing()
+        a = PredictedResult((ItemScore("i1", 100.0), ItemScore("i2", 50.0)))
+        b = PredictedResult((ItemScore("i1", 0.9), ItemScore("i3", 0.1)))
+        out = serving.serve(Query(items=["i9"], num=3), [a, b])
+        items = [s.item for s in out.item_scores]
+        assert items[0] == "i1"  # ranked first by both algorithms
+        assert set(items) <= {"i1", "i2", "i3"}
+        # raw magnitudes must not dominate: z-scores are scale-free
+        assert out.item_scores[0].score == pytest.approx(
+            0.7071067 + 0.7071067, rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# e-commerce
+# ---------------------------------------------------------------------------
+
+def ecommerce_events():
+    rng = np.random.default_rng(23)
+    events = []
+    for u in range(20):
+        events.append(Event(event="$set", entity_type="user",
+                            entity_id=f"u{u}", event_time=T0))
+    for i in range(12):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": ["c0" if i < 6 else "c1"]}),
+            event_time=T0))
+    t = T0
+    for u in range(20):
+        pool = range(0, 6) if u % 2 == 0 else range(6, 12)
+        for i in rng.choice(list(pool), size=4, replace=False):
+            events.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                event_time=t))
+            t += timedelta(seconds=10)
+    # i3 is the most-bought item
+    for u in range(10):
+        events.append(Event(
+            event="buy", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id="i3",
+            event_time=t))
+    events.append(Event(
+        event="buy", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i7", event_time=t))
+    return events
+
+
+@pytest.fixture(scope="module")
+def ec_ctx():
+    ctx = make_ctx("ecapp", ecommerce_events())
+    set_storage(ctx.storage)  # serving-time lookups go through the global
+    yield ctx
+    set_storage(None)
+
+
+def ec_engine_and_params(**kw):
+    from predictionio_tpu.templates.ecommerce import (
+        default_engine_params, ecommerce_engine)
+
+    engine = ecommerce_engine()
+    ep = default_engine_params("ecapp", rank=8, num_iterations=10, seed=9,
+                               **kw)
+    return engine, ep
+
+
+class TestECommerceTemplate:
+    def test_known_user(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params()
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(user="u0", num=4))
+        assert pred.item_scores
+        top = [int(s.item[1:]) for s in pred.item_scores]
+        assert sum(1 for i in top if i < 6) >= 2, f"taste leak: {top}"
+
+    def test_unknown_user_falls_back_to_popular(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params()
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(user="stranger", num=3))
+        assert pred.item_scores
+        assert pred.item_scores[0].item == "i3"  # most-bought
+
+    def test_unknown_user_with_recent_views_gets_similar(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params()
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        # give a fresh user a recent view on a c1 item
+        app_id, _ = ec_ctx.event_store.resolve("ecapp")
+        ec_ctx.storage.events().insert(Event(
+            event="view", entity_type="user", entity_id="newbie",
+            target_entity_type="item", target_entity_id="i7",
+            event_time=T0 + timedelta(days=1)), app_id)
+        pred = algo.predict(model, Query(user="newbie", num=4))
+        assert pred.item_scores
+        top = [int(s.item[1:]) for s in pred.item_scores]
+        assert sum(1 for i in top if i >= 6) >= 2, f"similar leak: {top}"
+
+    def test_unseen_only_blacklists_seen(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params(unseen_only=True)
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        seen = {e.target_entity_id for e in ec_ctx.event_store.find(
+            "ecapp", entity_type="user", entity_id="u0",
+            event_names=["view", "buy"])}
+        pred = algo.predict(model, Query(user="u0", num=6))
+        assert not ({s.item for s in pred.item_scores} & seen)
+
+    def test_unavailable_items_constraint(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params()
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        app_id, _ = ec_ctx.event_store.resolve("ecapp")
+        ec_ctx.storage.events().insert(Event(
+            event="$set", entity_type="constraint",
+            entity_id="unavailableItems",
+            properties=DataMap({"items": ["i3"]}),
+            event_time=T0 + timedelta(days=2)), app_id)
+        try:
+            pred = algo.predict(model, Query(user="stranger", num=3))
+            assert "i3" not in {s.item for s in pred.item_scores}
+        finally:
+            ec_ctx.storage.events().insert(Event(
+                event="$set", entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": []}),
+                event_time=T0 + timedelta(days=3)), app_id)
+
+    def test_weighted_items_adjust_score(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params()
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        app_id, _ = ec_ctx.event_store.resolve("ecapp")
+        # huge weight on i5 should pull it to the top for popularity path
+        ec_ctx.storage.events().insert(Event(
+            event="$set", entity_type="constraint",
+            entity_id="weightedItems",
+            properties=DataMap({"weights": [
+                {"items": ["i7"], "weight": 1000.0}]}),
+            event_time=T0 + timedelta(days=4)), app_id)
+        try:
+            pred = algo.predict(model, Query(user="stranger", num=2))
+            assert pred.item_scores[0].item == "i7"
+        finally:
+            ec_ctx.storage.events().insert(Event(
+                event="$set", entity_type="constraint",
+                entity_id="weightedItems",
+                properties=DataMap({"weights": []}),
+                event_time=T0 + timedelta(days=5)), app_id)
+
+    def test_category_filter(self, ec_ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        engine, ep = ec_engine_and_params()
+        model = engine.train(ec_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(user="u0", num=6,
+                                         categories=["c1"]))
+        assert all(int(s.item[1:]) >= 6 for s in pred.item_scores)
