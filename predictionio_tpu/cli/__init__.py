@@ -1,0 +1,641 @@
+"""``ptpu`` console — the framework's CLI.
+
+Capability parity with the reference ``pio`` console
+(``tools/src/main/scala/org/apache/predictionio/tools/console/
+Console.scala:80-650`` subcommands; command objects under
+``tools/.../commands/``): app/accesskey/channel management, build (a
+no-op venv check here — no sbt), train, eval, deploy, undeploy,
+batchpredict, eventserver, adminserver, dashboard, status, export,
+import, version, template stubs.
+
+Where the reference shells out to ``spark-submit`` (``Runner.scala:185``),
+this console runs the workflow in-process against the JAX mesh — there is
+no separate driver JVM to launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+from typing import Any, List, Optional
+
+from .. import __version__
+from ..data.storage.base import AccessKey, App, Channel
+from ..data.storage.registry import Storage, get_storage
+
+
+def _out(msg: str) -> None:
+    print(msg)
+
+
+def _err(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# engine.json loading (the reference's engine variant,
+# WorkflowUtils.getEngine + jValueToEngineParams)
+# ---------------------------------------------------------------------------
+
+def load_variant(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def load_engine_factory(spec: str):
+    """Resolve ``module.path:callable`` (the reflective ``EngineFactory``
+    lookup, ``WorkflowUtils.scala:53-88``)."""
+    if ":" not in spec:
+        raise SystemExit(f"engineFactory must look like "
+                         f"'package.module:factory', got {spec!r}")
+    mod_name, attr = spec.split(":", 1)
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        raise SystemExit(f"Cannot import engine factory module "
+                         f"{mod_name!r}: {e}")
+    try:
+        factory = getattr(mod, attr)
+    except AttributeError:
+        raise SystemExit(f"Module {mod_name!r} has no attribute {attr!r}")
+    return factory
+
+
+def engine_from_variant(variant: dict):
+    factory = load_engine_factory(variant.get("engineFactory", ""))
+    engine = factory() if callable(factory) else factory
+    engine_params = engine.params_from_variant(variant)
+    return engine, engine_params
+
+
+# ---------------------------------------------------------------------------
+# subcommand implementations (tools/.../commands/*.scala)
+# ---------------------------------------------------------------------------
+
+def cmd_app(args, storage: Storage) -> int:
+    apps = storage.apps()
+    keys = storage.access_keys()
+    chans = storage.channels()
+    sub = args.app_command
+    if sub == "new":
+        if apps.get_by_name(args.name) is not None:
+            _err(f"App {args.name} already exists. Aborting.")
+            return 1
+        app_id = apps.insert(App(id=args.id or 0, name=args.name,
+                                 description=args.description))
+        if app_id is None:
+            _err(f"Unable to create app {args.name} (ID conflict?). "
+                 f"Aborting.")
+            return 1
+        storage.events().init(app_id)
+        key = keys.insert(AccessKey(key=args.access_key or "",
+                                    app_id=app_id, events=()))
+        if key is None:
+            _err(f"Unable to create access key (duplicate?). Aborting.")
+            return 1
+        _out(f"Initialized Event Store for this app ID: {app_id}.")
+        _out(f"Created new app:")
+        _out(f"      Name: {args.name}")
+        _out(f"        ID: {app_id}")
+        _out(f"Access Key: {key}")
+        return 0
+    if sub == "list":
+        _out(f"{'Name':20} |   ID | Access Key")
+        for a in sorted(apps.get_all(), key=lambda a: a.name):
+            for k in keys.get_by_app_id(a.id) or [None]:
+                key = k.key if k else ""
+                allowed = (",".join(k.events) if k and k.events
+                           else "(all)")
+                _out(f"{a.name:20} | {a.id:4} | {key} | {allowed}")
+        _out(f"Finished listing {len(apps.get_all())} app(s).")
+        return 0
+    if sub == "show":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            _err(f"App {args.name} does not exist. Aborting.")
+            return 1
+        _out(f"    App Name: {a.name}")
+        _out(f"      App ID: {a.id}")
+        _out(f" Description: {a.description or ''}")
+        for k in keys.get_by_app_id(a.id):
+            allowed = ",".join(k.events) if k.events else "(all)"
+            _out(f"  Access Key: {k.key} | {allowed}")
+        for c in chans.get_by_app_id(a.id):
+            _out(f"     Channel: {c.name} (ID {c.id})")
+        return 0
+    if sub == "delete":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            _err(f"App {args.name} does not exist. Aborting.")
+            return 1
+        if not args.force and not _confirm(
+                f"Delete app {args.name} and ALL its data?"):
+            return 1
+        for c in chans.get_by_app_id(a.id):
+            storage.events().remove(a.id, c.id)
+            chans.delete(c.id)
+        storage.events().remove(a.id)
+        for k in keys.get_by_app_id(a.id):
+            keys.delete(k.key)
+        apps.delete(a.id)
+        _out(f"Deleted app {args.name}.")
+        return 0
+    if sub == "data-delete":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            _err(f"App {args.name} does not exist. Aborting.")
+            return 1
+        if not args.force and not _confirm(
+                f"Delete ALL data of app {args.name}?"):
+            return 1
+        channel_id = None
+        if args.channel:
+            ch = _find_channel(storage, a, args.channel)
+            if ch is None:
+                _err(f"Channel {args.channel} does not exist. Aborting.")
+                return 1
+            channel_id = ch.id
+        storage.events().remove(a.id, channel_id)
+        storage.events().init(a.id, channel_id)
+        _out(f"Removed Event Store for the app ID: {a.id}")
+        return 0
+    if sub == "channel-new":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            _err(f"App {args.name} does not exist. Aborting.")
+            return 1
+        if not Channel.is_valid_name(args.channel):
+            _err(f"Channel name {args.channel} is invalid (1-16 "
+                 f"alphanumeric/dash characters). Aborting.")
+            return 1
+        if any(c.name == args.channel for c in chans.get_by_app_id(a.id)):
+            _err(f"Channel {args.channel} already exists. Aborting.")
+            return 1
+        cid = chans.insert(Channel(id=0, name=args.channel, app_id=a.id))
+        storage.events().init(a.id, cid)
+        _out(f"Created channel {args.channel} (ID {cid}) for app "
+             f"{args.name}.")
+        return 0
+    if sub == "channel-delete":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            _err(f"App {args.name} does not exist. Aborting.")
+            return 1
+        ch = _find_channel(storage, a, args.channel)
+        if ch is None:
+            _err(f"Channel {args.channel} does not exist. Aborting.")
+            return 1
+        if not args.force and not _confirm(
+                f"Delete channel {args.channel} and its data?"):
+            return 1
+        storage.events().remove(a.id, ch.id)
+        chans.delete(ch.id)
+        _out(f"Deleted channel {args.channel}.")
+        return 0
+    _err(f"Unknown app subcommand {sub!r}")
+    return 1
+
+
+def cmd_accesskey(args, storage: Storage) -> int:
+    keys = storage.access_keys()
+    apps = storage.apps()
+    sub = args.ak_command
+    if sub == "new":
+        a = apps.get_by_name(args.app)
+        if a is None:
+            _err(f"App {args.app} does not exist. Aborting.")
+            return 1
+        key = keys.insert(AccessKey(key=args.key or "", app_id=a.id,
+                                    events=tuple(args.events or ())))
+        if key is None:
+            _err("Unable to create access key (duplicate?). Aborting.")
+            return 1
+        _out(f"Created new access key: {key}")
+        return 0
+    if sub == "list":
+        rows = keys.get_all()
+        if args.app:
+            a = apps.get_by_name(args.app)
+            if a is None:
+                _err(f"App {args.app} does not exist. Aborting.")
+                return 1
+            rows = keys.get_by_app_id(a.id)
+        for k in rows:
+            allowed = ",".join(k.events) if k.events else "(all)"
+            _out(f"{k.key} | app {k.app_id} | {allowed}")
+        _out(f"Finished listing {len(rows)} access key(s).")
+        return 0
+    if sub == "delete":
+        keys.delete(args.key)
+        _out(f"Deleted access key {args.key}.")
+        return 0
+    _err(f"Unknown accesskey subcommand {sub!r}")
+    return 1
+
+
+def _make_ctx(storage: Storage, app_name: str = ""):
+    from ..controller.context import Context
+    return Context(app_name=app_name, _storage=storage)
+
+
+def cmd_train(args, storage: Storage) -> int:
+    from ..workflow import run_train
+
+    variant = load_variant(args.engine_json)
+    engine, engine_params = engine_from_variant(variant)
+    ctx = _make_ctx(storage)
+    instance_id = run_train(
+        ctx, engine, engine_params,
+        engine_id=args.engine_id or variant.get("id", "default"),
+        engine_version=args.engine_version or variant.get("version", "1"),
+        engine_variant=args.engine_json,
+        engine_factory=variant.get("engineFactory", ""))
+    _out(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args, storage: Storage) -> int:
+    from ..workflow import run_evaluation
+
+    evaluation = load_engine_factory(args.evaluation)
+    if callable(evaluation) and not hasattr(evaluation, "engine"):
+        evaluation = evaluation()
+    params_list = None
+    if args.engine_params_generator:
+        gen = load_engine_factory(args.engine_params_generator)
+        if callable(gen) and not hasattr(gen, "engine_params_list"):
+            gen = gen()
+        params_list = list(gen.engine_params_list)
+    elif getattr(evaluation, "engine_params_list", None):
+        params_list = list(evaluation.engine_params_list)
+    if not params_list:
+        _err("No engine params to evaluate; provide an engine params "
+             "generator.")
+        return 1
+    ctx = _make_ctx(storage)
+    result = run_evaluation(
+        ctx, evaluation, params_list,
+        evaluation_class=args.evaluation,
+        params_generator_class=args.engine_params_generator or "")
+    _out(result.to_one_liner())
+    return 0
+
+
+def cmd_deploy(args, storage: Storage) -> int:
+    from ..server.engineserver import ServerConfig, deploy
+
+    variant = load_variant(args.engine_json)
+    engine, engine_params = engine_from_variant(variant)
+    ctx = _make_ctx(storage)
+    config = ServerConfig(
+        feedback=args.feedback,
+        feedback_app_name=args.feedback_app_name or None,
+        accesskey=args.accesskey or None)
+    server = deploy(
+        ctx, engine, engine_params,
+        engine_id=args.engine_id or variant.get("id", "default"),
+        engine_version=args.engine_version or variant.get("version", "1"),
+        engine_variant=args.engine_json,
+        config=config, host=args.ip, port=args.port)
+    _out(f"Engine is deployed and running. Engine API is live at "
+         f"http://{args.ip}:{server.port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("Shutting down.")
+    return 0
+
+
+def cmd_undeploy(args, storage: Storage) -> int:
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    if args.accesskey:
+        url += f"?accessKey={args.accesskey}"
+    try:
+        req = urllib.request.Request(url, method="POST", data=b"")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+        _out(f"Undeployed engine server at {args.ip}:{args.port}.")
+        return 0
+    except Exception as e:
+        _err(f"Cannot undeploy {url}: {e}")
+        return 1
+
+
+def cmd_batchpredict(args, storage: Storage) -> int:
+    from ..workflow.batch_predict import run_batch_predict
+
+    variant = load_variant(args.engine_json)
+    engine, engine_params = engine_from_variant(variant)
+    ctx = _make_ctx(storage)
+    n = run_batch_predict(
+        ctx, engine, engine_params,
+        input_path=args.input, output_path=args.output,
+        engine_id=args.engine_id or variant.get("id", "default"),
+        engine_version=args.engine_version or variant.get("version", "1"),
+        engine_variant=args.engine_json)
+    _out(f"Wrote {n} prediction(s) to {args.output}.")
+    return 0
+
+
+def cmd_eventserver(args, storage: Storage) -> int:
+    from ..server.eventserver import build_app
+    from ..server.http import AppServer
+
+    server = AppServer(build_app(storage, stats=args.stats),
+                       host=args.ip, port=args.port)
+    _out(f"Event Server is listening at http://{args.ip}:{server.port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("Shutting down.")
+    return 0
+
+
+def cmd_adminserver(args, storage: Storage) -> int:
+    from ..server.adminserver import create_admin_server
+
+    server = create_admin_server(storage, host=args.ip, port=args.port)
+    _out(f"Admin server is listening at http://{args.ip}:{server.port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("Shutting down.")
+    return 0
+
+
+def cmd_dashboard(args, storage: Storage) -> int:
+    from ..server.dashboard import create_dashboard
+
+    server = create_dashboard(storage, host=args.ip, port=args.port)
+    _out(f"Dashboard is listening at http://{args.ip}:{server.port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("Shutting down.")
+    return 0
+
+
+def cmd_status(args, storage: Storage) -> int:
+    """``pio status`` (``commands/Management.scala:99``): environment +
+    storage smoke check."""
+    _out(f"PredictionIO-TPU {__version__}")
+    try:
+        import jax
+        _out(f"JAX {jax.__version__}; devices: "
+             f"{[str(d) for d in jax.devices()]}")
+    except Exception as e:  # noqa: BLE001 — report, don't crash status
+        _err(f"JAX initialization failed: {e}")
+        return 1
+    try:
+        storage.verify_all_data_objects()
+        _out("Storage: all data objects verified.")
+    except Exception as e:  # noqa: BLE001
+        _err(f"Storage check failed: {e}")
+        return 1
+    _out("(sleeping 0 seconds) Your system is all ready to go.")
+    return 0
+
+
+def cmd_export(args, storage: Storage) -> int:
+    """``pio export`` (``tools/export/EventsToFile.scala``): events →
+    JSON-lines file."""
+    from ..data.storage.base import EventFilter
+
+    a = storage.apps().get_by_name(args.app) if args.app else \
+        storage.apps().get(args.appid)
+    if a is None:
+        _err("App does not exist. Aborting.")
+        return 1
+    channel_id = None
+    if args.channel:
+        ch = _find_channel(storage, a, args.channel)
+        if ch is None:
+            _err(f"Channel {args.channel} does not exist. Aborting.")
+            return 1
+        channel_id = ch.id
+    n = 0
+    with open(args.output, "w", encoding="utf-8") as f:
+        for e in storage.events().find(a.id, channel_id, EventFilter()):
+            f.write(json.dumps(e.to_json()) + "\n")
+            n += 1
+    _out(f"Exported {n} event(s) to {args.output}.")
+    return 0
+
+
+def cmd_import(args, storage: Storage) -> int:
+    """``pio import`` (``tools/imprt/FileToEvents.scala``): JSON-lines →
+    event store."""
+    from ..data.event import Event
+
+    a = storage.apps().get_by_name(args.app) if args.app else \
+        storage.apps().get(args.appid)
+    if a is None:
+        _err("App does not exist. Aborting.")
+        return 1
+    channel_id = None
+    if args.channel:
+        ch = _find_channel(storage, a, args.channel)
+        if ch is None:
+            _err(f"Channel {args.channel} does not exist. Aborting.")
+            return 1
+        channel_id = ch.id
+    events = []
+    with open(args.input, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(Event.from_json(json.loads(line)))
+    storage.events().insert_batch(events, a.id, channel_id)
+    _out(f"Imported {len(events)} event(s).")
+    return 0
+
+
+def cmd_build(args, storage: Storage) -> int:
+    """No sbt here: 'build' verifies the engine variant is loadable
+    (``commands/Engine.scala:66-139`` becomes an import check)."""
+    variant = load_variant(args.engine_json)
+    engine, engine_params = engine_from_variant(variant)
+    n_algos = len(engine_params.algorithms)
+    _out(f"Engine factory {variant.get('engineFactory')} loads OK "
+         f"({n_algos} algorithm(s) configured).")
+    _out("Build finished successfully.")
+    return 0
+
+
+def cmd_template(args, storage: Storage) -> int:
+    _out("Bundled engine templates (predictionio_tpu.templates):")
+    _out("  recommendation  — ALS top-N (module: "
+         "predictionio_tpu.templates.recommendation:recommendation_engine)")
+    _out("  classification  — naive Bayes / random forest (…"
+         "classification:classification_engine)")
+    _out("  similarproduct  — ALS cosine / cooccurrence / like (…"
+         "similarproduct:similarproduct_engine)")
+    _out("  ecommerce       — ALS + popularity + filters (…"
+         "ecommerce:ecommerce_engine)")
+    return 0
+
+
+def _find_channel(storage: Storage, app: App, name: str):
+    """Resolve a channel by name within an app; None when absent."""
+    return next((c for c in storage.channels().get_by_app_id(app.id)
+                 if c.name == name), None)
+
+
+def _confirm(prompt: str) -> bool:
+    try:
+        return input(f"{prompt} (y/N) ").strip().lower() == "y"
+    except EOFError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ptpu",
+        description="PredictionIO-TPU console (the reference's `pio`)")
+    p.add_argument("--version", action="version",
+                   version=f"%(prog)s {__version__}")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add_engine_flags(sp):
+        sp.add_argument("--engine-json", default="engine.json")
+        sp.add_argument("--engine-id", default="")
+        sp.add_argument("--engine-version", default="")
+
+    sp = sub.add_parser("app", help="manage apps")
+    app_sub = sp.add_subparsers(dest="app_command", required=True)
+    s = app_sub.add_parser("new")
+    s.add_argument("name")
+    s.add_argument("--id", type=int, default=0)
+    s.add_argument("--description")
+    s.add_argument("--access-key", default="")
+    app_sub.add_parser("list")
+    s = app_sub.add_parser("show")
+    s.add_argument("name")
+    s = app_sub.add_parser("delete")
+    s.add_argument("name")
+    s.add_argument("-f", "--force", action="store_true")
+    s = app_sub.add_parser("data-delete")
+    s.add_argument("name")
+    s.add_argument("--channel", default="")
+    s.add_argument("-f", "--force", action="store_true")
+    s = app_sub.add_parser("channel-new")
+    s.add_argument("name")
+    s.add_argument("channel")
+    s = app_sub.add_parser("channel-delete")
+    s.add_argument("name")
+    s.add_argument("channel")
+    s.add_argument("-f", "--force", action="store_true")
+
+    sp = sub.add_parser("accesskey", help="manage access keys")
+    ak_sub = sp.add_subparsers(dest="ak_command", required=True)
+    s = ak_sub.add_parser("new")
+    s.add_argument("app")
+    s.add_argument("events", nargs="*")
+    s.add_argument("--key", default="")
+    s = ak_sub.add_parser("list")
+    s.add_argument("--app", default="")
+    s = ak_sub.add_parser("delete")
+    s.add_argument("key")
+
+    s = sub.add_parser("build", help="verify the engine variant loads")
+    add_engine_flags(s)
+
+    s = sub.add_parser("train", help="train an engine")
+    add_engine_flags(s)
+
+    s = sub.add_parser("eval", help="run an evaluation")
+    s.add_argument("evaluation",
+                   help="module.path:evaluation_object")
+    s.add_argument("engine_params_generator", nargs="?", default="",
+                   help="module.path:params_generator (optional)")
+
+    s = sub.add_parser("deploy", help="deploy the latest trained engine")
+    add_engine_flags(s)
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--feedback", action="store_true")
+    s.add_argument("--feedback-app-name", default="")
+    s.add_argument("--accesskey", default="")
+
+    s = sub.add_parser("undeploy", help="stop a deployed engine")
+    s.add_argument("--ip", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--accesskey", default="",
+                   help="access key if the server was deployed with one")
+
+    s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
+    add_engine_flags(s)
+    s.add_argument("--input", required=True)
+    s.add_argument("--output", required=True)
+
+    s = sub.add_parser("eventserver", help="start the Event Server")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=7070)
+    s.add_argument("--stats", action="store_true")
+
+    s = sub.add_parser("adminserver", help="start the admin API")
+    s.add_argument("--ip", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=7071)
+
+    s = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    s.add_argument("--ip", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=9000)
+
+    sub.add_parser("status", help="check environment and storage")
+
+    s = sub.add_parser("export", help="export events to a JSON-lines file")
+    s.add_argument("--appid", type=int, default=0)
+    s.add_argument("--app", default="")
+    s.add_argument("--channel", default="")
+    s.add_argument("--output", required=True)
+
+    s = sub.add_parser("import", help="import events from JSON lines")
+    s.add_argument("--appid", type=int, default=0)
+    s.add_argument("--app", default="")
+    s.add_argument("--channel", default="")
+    s.add_argument("--input", required=True)
+
+    sub.add_parser("template", help="list bundled engine templates")
+    sub.add_parser("version", help="print version")
+    return p
+
+
+COMMANDS = {
+    "app": cmd_app,
+    "accesskey": cmd_accesskey,
+    "build": cmd_build,
+    "train": cmd_train,
+    "eval": cmd_eval,
+    "deploy": cmd_deploy,
+    "undeploy": cmd_undeploy,
+    "batchpredict": cmd_batchpredict,
+    "eventserver": cmd_eventserver,
+    "adminserver": cmd_adminserver,
+    "dashboard": cmd_dashboard,
+    "status": cmd_status,
+    "export": cmd_export,
+    "import": cmd_import,
+    "template": cmd_template,
+}
+
+
+def main(argv: Optional[List[str]] = None,
+         storage: Optional[Storage] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        _out(__version__)
+        return 0
+    st = storage if storage is not None else get_storage()
+    return COMMANDS[args.command](args, st)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""``python -m predictionio_tpu.cli`` — the ``bin/pio`` entry point."""
+
+import sys
+
+from . import main
+
+sys.exit(main())

@@ -1,0 +1,96 @@
+"""Dashboard: web UI over evaluation history.
+
+Capability parity with ``tools/dashboard/Dashboard.scala:47-160``:
+``GET /`` renders an HTML index of completed evaluation instances
+(newest first) with links to per-instance
+``/engine_instances/{id}/evaluator_results.{txt,html,json}``; the JSON
+variant is also exposed CORS-enabled as ``local_evaluator_results.json``.
+"""
+
+from __future__ import annotations
+
+import html as _html
+from typing import Optional
+
+from ..data.event import utcnow
+from ..data.storage.registry import Storage, get_storage
+from .http import AppServer, HTTPApp, Request, Response, json_response
+
+
+def build_app(storage: Optional[Storage] = None) -> HTTPApp:
+    app = HTTPApp("dashboard")
+    start_time = utcnow()
+
+    def st() -> Storage:
+        return storage if storage is not None else get_storage()
+
+    @app.route("GET", "/")
+    def index(req: Request) -> Response:
+        rows = []
+        for i in st().evaluation_instances().get_completed():
+            esc = _html.escape
+            rows.append(
+                f"<tr><td>{esc(i.id)}</td>"
+                f"<td>{esc(str(i.start_time))}</td>"
+                f"<td>{esc(str(i.end_time))}</td>"
+                f"<td>{esc(i.evaluation_class)}</td>"
+                f"<td>{esc(i.evaluator_results)}</td>"
+                f"<td><a href='/engine_instances/{esc(i.id)}/"
+                f"evaluator_results.html'>HTML</a> "
+                f"<a href='/engine_instances/{esc(i.id)}/"
+                f"evaluator_results.json'>JSON</a> "
+                f"<a href='/engine_instances/{esc(i.id)}/"
+                f"evaluator_results.txt'>TXT</a></td></tr>")
+        body = (
+            "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
+            f"<body><h1>Evaluation history</h1>"
+            f"<p>Dashboard up since {start_time}</p>"
+            "<table border='1'><tr><th>ID</th><th>Start</th><th>End</th>"
+            "<th>Evaluation</th><th>Result</th><th>Details</th></tr>"
+            + "".join(rows) + "</table></body></html>")
+        return Response(status=200, body=body,
+                        content_type="text/html; charset=utf-8")
+
+    def _instance(req: Request):
+        return st().evaluation_instances().get(req.path_params["iid"])
+
+    @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
+                      r"evaluator_results\.txt")
+    def results_txt(req: Request) -> Response:
+        i = _instance(req)
+        if i is None:
+            return json_response({"message": "Not Found"}, 404)
+        return Response(status=200, body=i.evaluator_results,
+                        content_type="text/plain; charset=utf-8")
+
+    @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
+                      r"evaluator_results\.html")
+    def results_html(req: Request) -> Response:
+        i = _instance(req)
+        if i is None:
+            return json_response({"message": "Not Found"}, 404)
+        return Response(status=200, body=i.evaluator_results_html,
+                        content_type="text/html; charset=utf-8")
+
+    @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
+                      r"evaluator_results\.json")
+    def results_json(req: Request) -> Response:
+        i = _instance(req)
+        if i is None:
+            return json_response({"message": "Not Found"}, 404)
+        return Response(status=200, body=i.evaluator_results_json,
+                        content_type="application/json")
+
+    @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
+                      r"local_evaluator_results\.json")
+    def results_json_cors(req: Request) -> Response:
+        resp = results_json(req)
+        resp.headers["Access-Control-Allow-Origin"] = "*"
+        return resp
+
+    return app
+
+
+def create_dashboard(storage: Optional[Storage] = None,
+                     host: str = "127.0.0.1", port: int = 9000) -> AppServer:
+    return AppServer(build_app(storage), host=host, port=port)

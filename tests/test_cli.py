@@ -1,0 +1,257 @@
+"""CLI console, admin API, and dashboard tests
+(SURVEY C23/C24/C25 parity)."""
+
+import json
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cli import main
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import Storage
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+@pytest.fixture()
+def storage():
+    return Storage(env=MEM_ENV)
+
+
+def run(storage, *argv) -> int:
+    return main(list(argv), storage=storage)
+
+
+class TestAppCommands:
+    def test_app_lifecycle(self, storage, capsys):
+        assert run(storage, "app", "new", "myapp",
+                   "--description", "demo") == 0
+        out = capsys.readouterr().out
+        assert "Access Key:" in out
+        # duplicate rejected
+        assert run(storage, "app", "new", "myapp") == 1
+        assert run(storage, "app", "list") == 0
+        assert "myapp" in capsys.readouterr().out
+        assert run(storage, "app", "show", "myapp") == 0
+        assert run(storage, "app", "delete", "myapp", "-f") == 0
+        assert storage.apps().get_by_name("myapp") is None
+
+    def test_channels(self, storage):
+        run(storage, "app", "new", "chapp")
+        assert run(storage, "app", "channel-new", "chapp", "mobile") == 0
+        assert any(c.name == "mobile" for c in storage.channels()
+                   .get_by_app_id(storage.apps().get_by_name("chapp").id))
+        # invalid channel name
+        assert run(storage, "app", "channel-new", "chapp",
+                   "bad name!") == 1
+        assert run(storage, "app", "channel-delete", "chapp", "mobile",
+                   "-f") == 0
+
+    def test_accesskey_commands(self, storage, capsys):
+        run(storage, "app", "new", "akapp")
+        assert run(storage, "accesskey", "new", "akapp", "view", "buy",
+                   "--key", "SECRET") == 0
+        assert run(storage, "accesskey", "list", "--app", "akapp") == 0
+        out = capsys.readouterr().out
+        assert "SECRET" in out and "view,buy" in out
+        assert run(storage, "accesskey", "delete", "SECRET") == 0
+
+    def test_data_delete(self, storage):
+        run(storage, "app", "new", "dapp")
+        app_id = storage.apps().get_by_name("dapp").id
+        storage.events().insert(Event(
+            event="view", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            event_time=T0), app_id)
+        assert run(storage, "app", "data-delete", "dapp", "-f") == 0
+        from predictionio_tpu.data.storage.base import EventFilter
+        assert list(storage.events().find(app_id, None, EventFilter())) == []
+
+
+class TestStatusVersionTemplate:
+    def test_status(self, storage, capsys):
+        assert run(storage, "status") == 0
+        assert "ready to go" in capsys.readouterr().out
+
+    def test_version(self, storage, capsys):
+        assert run(storage, "version") == 0
+
+    def test_template_list(self, storage, capsys):
+        assert run(storage, "template") == 0
+        assert "recommendation" in capsys.readouterr().out
+
+
+def seed_ratings(storage, app_name="cliapp"):
+    run(storage, "app", "new", app_name)
+    app_id = storage.apps().get_by_name(app_name).id
+    rng = np.random.default_rng(2)
+    events = []
+    t = T0
+    for u in range(20):
+        pool = range(0, 8) if u % 2 == 0 else range(8, 16)
+        for i in rng.choice(list(pool), size=5, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": 5.0}), event_time=t))
+            t += timedelta(minutes=1)
+    storage.events().insert_batch(events, app_id)
+    return app_id
+
+
+def write_variant(tmp_path, app_name="cliapp"):
+    variant = {
+        "id": "cli-engine",
+        "version": "1",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation:"
+            "recommendation_engine",
+        "datasource": {"params": {"app_name": app_name}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 8, "num_iterations": 5,
+                                   "seed": 4}}],
+    }
+    path = tmp_path / "engine.json"
+    path.write_text(json.dumps(variant))
+    return str(path)
+
+
+class TestTrainBatchPredict:
+    def test_build_train_batchpredict(self, storage, tmp_path, capsys):
+        seed_ratings(storage)
+        ej = write_variant(tmp_path)
+        assert run(storage, "build", "--engine-json", ej) == 0
+        assert run(storage, "train", "--engine-json", ej) == 0
+        out = capsys.readouterr().out
+        assert "Training completed" in out
+        qfile = tmp_path / "queries.jsonl"
+        qfile.write_text('{"user": "u0", "num": 3}\n'
+                         '{"user": "u1", "num": 2}\n')
+        ofile = tmp_path / "out.jsonl"
+        assert run(storage, "batchpredict", "--engine-json", ej,
+                   "--input", str(qfile), "--output", str(ofile)) == 0
+        lines = [json.loads(l) for l in
+                 ofile.read_text().strip().splitlines()]
+        assert len(lines) == 2
+        assert len(lines[0]["prediction"]["itemScores"]) == 3
+
+    def test_export_import_roundtrip(self, storage, tmp_path):
+        app_id = seed_ratings(storage, "exapp")
+        out = tmp_path / "events.jsonl"
+        assert run(storage, "export", "--app", "exapp",
+                   "--output", str(out)) == 0
+        n_lines = len(out.read_text().strip().splitlines())
+        assert n_lines == 100  # 20 users × 5 ratings
+        run(storage, "app", "new", "imapp")
+        assert run(storage, "import", "--app", "imapp",
+                   "--input", str(out)) == 0
+        from predictionio_tpu.data.storage.base import EventFilter
+        im_id = storage.apps().get_by_name("imapp").id
+        got = list(storage.events().find(im_id, None, EventFilter()))
+        assert len(got) == n_lines
+
+
+class TestAdminServer:
+    def test_admin_routes(self, storage):
+        from predictionio_tpu.server.adminserver import build_app
+        from predictionio_tpu.server.http import Request
+
+        app = build_app(storage)
+
+        def call(method, path, body=None):
+            req = Request(method=method, path=path, query={}, headers={},
+                          body=json.dumps(body).encode() if body else b"")
+            resp = app.handle(req)
+            return resp.status, (json.loads(resp.encoded())
+                                 if resp.encoded() else None)
+
+        status, body = call("GET", "/")
+        assert status == 200 and body["status"] == "alive"
+        status, body = call("POST", "/cmd/app", {"name": "adminapp"})
+        assert body["status"] == 1 and body["key"]
+        status, body = call("POST", "/cmd/app", {"name": "adminapp"})
+        assert body["status"] == 0  # duplicate
+        status, body = call("GET", "/cmd/app")
+        assert any(a["name"] == "adminapp" for a in body["apps"])
+        status, body = call("DELETE", "/cmd/app/adminapp/data")
+        assert body["status"] == 1
+        status, body = call("DELETE", "/cmd/app/adminapp")
+        assert body["status"] == 1
+        assert storage.apps().get_by_name("adminapp") is None
+        status, body = call("DELETE", "/cmd/app/ghost")
+        assert status == 404
+
+
+class TestDashboard:
+    def test_dashboard_routes(self, storage):
+        from predictionio_tpu.data.storage.base import (
+            STATUS_EVALCOMPLETED, EvaluationInstance)
+        from predictionio_tpu.server.dashboard import build_app
+        from predictionio_tpu.server.http import Request
+
+        iid = storage.evaluation_instances().insert(EvaluationInstance(
+            id="", status=STATUS_EVALCOMPLETED, start_time=T0, end_time=T0,
+            evaluation_class="my.Eval",
+            evaluator_results="Precision@10: 0.5",
+            evaluator_results_html="<html>ok</html>",
+            evaluator_results_json='{"metric": 0.5}'))
+        app = build_app(storage)
+
+        def call(path):
+            return app.handle(Request(method="GET", path=path, query={},
+                                      headers={}, body=b""))
+
+        index = call("/")
+        assert index.status == 200
+        assert "my.Eval" in index.encoded().decode()
+        txt = call(f"/engine_instances/{iid}/evaluator_results.txt")
+        assert txt.encoded().decode() == "Precision@10: 0.5"
+        html = call(f"/engine_instances/{iid}/evaluator_results.html")
+        assert "ok" in html.encoded().decode()
+        js = call(f"/engine_instances/{iid}/evaluator_results.json")
+        assert json.loads(js.encoded())["metric"] == 0.5
+        cors = call(f"/engine_instances/{iid}/local_evaluator_results.json")
+        assert cors.headers.get("Access-Control-Allow-Origin") == "*"
+        assert call("/engine_instances/nope/evaluator_results.txt")\
+            .status == 404
+
+
+class TestEvalCommand:
+    def test_eval(self, storage, tmp_path, capsys, monkeypatch):
+        seed_ratings(storage, "evapp")
+        mod = tmp_path / "cli_eval_mod.py"
+        mod.write_text('''
+from predictionio_tpu.controller import Evaluation
+from predictionio_tpu.controller.params import EngineParams
+from predictionio_tpu.models.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    DataSourceParams, PrecisionAtK, recommendation_engine)
+
+evaluation = Evaluation(engine=recommendation_engine(),
+                        metric=PrecisionAtK(k=3, rating_threshold=2.0))
+engine_params_list = [
+    EngineParams(
+        datasource=("", DataSourceParams(app_name="evapp", eval_k=2)),
+        algorithms=[("als", ALSParams(rank=r, num_iterations=4, seed=1))])
+    for r in (4, 8)]
+
+
+class Gen:
+    engine_params_list = engine_params_list
+
+
+gen = Gen()
+''')
+        monkeypatch.syspath_prepend(str(tmp_path))
+        assert run(storage, "eval", "cli_eval_mod:evaluation",
+                   "cli_eval_mod:gen") == 0
+        out = capsys.readouterr().out
+        assert "Precision@3" in out or "0." in out
