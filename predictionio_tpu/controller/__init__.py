@@ -11,8 +11,14 @@ from .base import (
     SanityCheck,
     Serving,
 )
+from .cleaning import EventWindow, SelfCleaningDataSource
 from .context import Context, default_context
 from .engine import Engine, EngineFactory, SimpleEngine, TrainResult
+from .fast_eval import FastEvalEngine, FastEvalEngineWorkflow
+from .persistent import (
+    LocalFileSystemPersistentModel,
+    PersistentModel,
+)
 from .evaluation import (
     EngineParamsGenerator,
     Evaluation,
@@ -43,6 +49,12 @@ from .params import (
 )
 
 __all__ = [
+    "PersistentModel",
+    "LocalFileSystemPersistentModel",
+    "FastEvalEngineWorkflow",
+    "FastEvalEngine",
+    "SelfCleaningDataSource",
+    "EventWindow",
     "Algorithm",
     "AverageMetric",
     "AverageServing",

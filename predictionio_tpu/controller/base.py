@@ -111,13 +111,26 @@ class Algorithm(abc.ABC, Generic[PD, M, Q, P]):
           stored;
         - ``None``: nothing persists; deploy retrains (reference ``Unit``
           model semantics, ``controller/Engine.scala:210-232``).
+
+        Models implementing
+        :class:`~predictionio_tpu.controller.persistent.PersistentModel`
+        save themselves and persist as a manifest automatically
+        (``Engine.makeSerializableModels`` :284).
         """
         from ..workflow.persistence import to_host
+        from .persistent import PersistentModel, manifest_for
+        if isinstance(model, PersistentModel):
+            manifest = manifest_for(model, engine_instance_id, algo_index)
+            if manifest is not None:
+                return manifest
         return to_host(model)
 
     def load_persistent_model(self, ctx: Context, stored: Any) -> M:
         """Invert :meth:`make_persistent_model` at deploy time."""
         from ..workflow.persistence import to_device
+        from .persistent import load_from_manifest
+        if isinstance(stored, PersistentModelManifest) and stored.class_name:
+            return load_from_manifest(stored)
         return to_device(stored)
 
     #: Optional dataclass type for typed query parsing at the REST boundary
@@ -162,11 +175,21 @@ class AverageServing(Serving):
 class PersistentModelManifest:
     """Marker stored in place of a model blob when the algorithm persists
     its own model (``workflow/PersistentModelManifest``); records how to
-    find it again."""
+    find it again. ``class_name`` (``module:QualName``) names a
+    :class:`~predictionio_tpu.controller.persistent.PersistentModel`
+    whose ``load`` inverts the save; ``location``/``extra`` cover ad-hoc
+    layouts handled by a custom ``load_persistent_model`` override."""
 
-    def __init__(self, location: str, extra: Optional[dict] = None):
+    def __init__(self, class_name: str = "", engine_instance_id: str = "",
+                 algo_index: int = 0, location: str = "",
+                 extra: Optional[dict] = None):
+        self.class_name = class_name
+        self.engine_instance_id = engine_instance_id
+        self.algo_index = algo_index
         self.location = location
         self.extra = extra or {}
 
     def __repr__(self):
-        return f"PersistentModelManifest({self.location!r})"
+        return (f"PersistentModelManifest({self.class_name!r}, "
+                f"{self.engine_instance_id!r}, {self.algo_index}, "
+                f"{self.location!r})")

@@ -1,0 +1,163 @@
+"""FastEvalEngine: prefix-memoized hyperparameter evaluation.
+
+Capability parity with ``controller/FastEvalEngine.scala`` (prefix case
+classes :52-85, ``getDataSourceResult`` :87-110, ``getPreparatorResult``
+:112-130, ``computeAlgorithmsResult`` :132-210, serving+cache plumbing
+to :346): when a sweep varies only algorithm params, the DataSource read
+and Preparator output are computed once and shared across every variant;
+when it varies only serving params, even the per-algorithm
+train + batch-predict results are shared.
+
+Cache keys are the JSON rendering of the (name, params) prefix — the
+role the reference's case-class equality plays.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Sequence, Tuple
+
+from .context import Context
+from .engine import Engine
+from .params import EngineParams, params_to_json
+
+log = logging.getLogger(__name__)
+
+
+def _key(*pairs) -> str:
+    """Stable hashable rendering of a params prefix."""
+    return json.dumps([[name, params_to_json(p)] for name, p in pairs],
+                      sort_keys=True, default=str)
+
+
+class FastEvalEngineWorkflow:
+    """Memoizing evaluator over one engine + one context
+    (``FastEvalEngineWorkflow`` object)."""
+
+    def __init__(self, engine: Engine, ctx: Context):
+        self.engine = engine
+        self.ctx = ctx
+        self.datasource_cache: Dict[str, list] = {}
+        self.preparator_cache: Dict[str, list] = {}
+        self.algorithms_cache: Dict[str, list] = {}
+        self.serving_cache: Dict[str, list] = {}
+        #: cache-miss counters, keyed like the caches (observability +
+        #: what FastEvalEngineTest asserts on)
+        self.miss_counts: Dict[str, int] = {
+            "datasource": 0, "preparator": 0, "algorithms": 0, "serving": 0}
+
+    # -- per-prefix computations (FastEvalEngine.scala:87-210) -------------
+    def datasource_result(self, ep: EngineParams) -> list:
+        key = _key(ep.datasource)
+        if key not in self.datasource_cache:
+            self.miss_counts["datasource"] += 1
+            ds = self.engine.make_datasource(ep)
+            self.datasource_cache[key] = list(ds.read_eval(self.ctx))
+        return self.datasource_cache[key]
+
+    def preparator_result(self, ep: EngineParams) -> list:
+        key = _key(ep.datasource, ep.preparator)
+        if key not in self.preparator_cache:
+            self.miss_counts["preparator"] += 1
+            prep = self.engine.make_preparator(ep)
+            folds = self.datasource_result(ep)
+            self.preparator_cache[key] = [
+                prep.prepare(self.ctx, td) for td, _, _ in folds]
+        return self.preparator_cache[key]
+
+    def algorithms_result(self, ep: EngineParams) -> list:
+        """Per fold: (supplemented queries, per-query per-algo predictions).
+
+        ``Engine.eval`` supplements queries before prediction
+        (``engine.py`` eval loop; ``controller/Engine.scala:767``), so the
+        same happens here — and when the Serving class overrides
+        ``supplement``, the serving params join the cache key (predictions
+        then depend on them; the reference's FastEvalEngine skips
+        supplement entirely, which silently diverges from Engine.eval)."""
+        from .base import Serving
+
+        serving = self.engine.make_serving(ep)
+        supplement_overridden = (
+            type(serving).supplement is not Serving.supplement)
+        pairs = [ep.datasource, ep.preparator, *ep.algorithms]
+        if supplement_overridden:
+            pairs.append(ep.serving)
+        key = _key(*pairs)
+        if key not in self.algorithms_cache:
+            self.miss_counts["algorithms"] += 1
+            folds = self.datasource_result(ep)
+            prepared = self.preparator_result(ep)
+            algos = self.engine.make_algorithms(ep)
+            per_fold = []
+            for (td, ei, qa), pd in zip(folds, prepared):
+                queries = [serving.supplement(q) for q, _ in qa]
+                per_algo = [a.batch_predict(a.train(self.ctx, pd), queries)
+                            for a in algos]
+                per_fold.append((queries,
+                                 [[preds[i] for preds in per_algo]
+                                  for i in range(len(queries))]))
+            self.algorithms_cache[key] = per_fold
+        return self.algorithms_cache[key]
+
+    def serving_result(self, ep: EngineParams) -> list:
+        """Final eval shape: per fold ``(eval_info, [(q, served, a)])``."""
+        key = _key(ep.datasource, ep.preparator, *ep.algorithms, ep.serving)
+        if key not in self.serving_cache:
+            self.miss_counts["serving"] += 1
+            folds = self.datasource_result(ep)
+            algo_results = self.algorithms_result(ep)
+            serving = self.engine.make_serving(ep)
+            out = []
+            for (td, ei, qa), (queries, fold_preds) in zip(folds,
+                                                           algo_results):
+                served = [serving.serve(q, preds)
+                          for q, preds in zip(queries, fold_preds)]
+                out.append((ei, [(q, s, a) for q, s, (_, a)
+                                 in zip(queries, served, qa)]))
+            self.serving_cache[key] = out
+        return self.serving_cache[key]
+
+
+class FastEvalEngine(Engine):
+    """Drop-in Engine whose ``eval``/``batch_eval`` memoize pipeline
+    prefixes across engine-params variants. Build from an existing engine:
+    ``FastEvalEngine.from_engine(engine)``."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._workflows: Dict[int, FastEvalEngineWorkflow] = {}
+
+    @classmethod
+    def from_engine(cls, engine: Engine) -> "FastEvalEngine":
+        fe = cls.__new__(cls)
+        fe.__dict__.update(engine.__dict__)
+        fe._workflows = {}
+        return fe
+
+    def _workflow(self, ctx: Context) -> FastEvalEngineWorkflow:
+        import weakref
+
+        wf = self._workflows.get(id(ctx))
+        if wf is None:
+            wf = FastEvalEngineWorkflow(self, ctx)
+            key = id(ctx)
+            try:
+                # evict the cache (and its strong ctx reference) when the
+                # context dies — a sweep's data shouldn't outlive it
+                weakref.finalize(ctx, self._workflows.pop, key, None)
+            except TypeError:
+                pass  # non-weakrefable ctx: caller owns the lifetime
+            self._workflows[key] = wf
+        return wf
+
+    def eval(self, ctx: Context, engine_params: EngineParams) -> list:
+        return self._workflow(ctx).serving_result(engine_params)
+
+    def batch_eval(self, ctx: Context,
+                   params_list: Sequence[EngineParams]
+                   ) -> List[Tuple[EngineParams, list]]:
+        wf = self._workflow(ctx)
+        out = [(ep, wf.serving_result(ep)) for ep in params_list]
+        log.info("FastEvalEngine misses: %s", wf.miss_counts)
+        return out

@@ -1,0 +1,111 @@
+"""Custom model persistence: the PersistentModel protocol.
+
+Capability parity with ``controller/PersistentModel.scala`` (+
+``PersistentModelLoader``, ``LocalFileSystemPersistentModel``): a model
+class that manages its own durable form. ``save`` runs at train time; at
+deploy, the stored :class:`PersistentModelManifest` names the class,
+whose ``load`` classmethod re-materializes the model
+(``controller/Engine.scala:241-250``,
+``workflow/WorkflowUtils.scala:350``).
+
+Checkpoint layout: one ``<instanceId>-<algoIndex>.pkl`` per
+(instance, algorithm) under ``$PIO_HOME/models/`` (or ``./.ptpu/models``)
+— the role the reference's HDFS paths / LocalFS played. Device arrays
+are converted to numpy before pickling so checkpoints stay portable
+across mesh shapes.
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+import os
+import pickle
+from typing import Any, Optional
+
+from .base import PersistentModelManifest
+
+
+def models_dir() -> str:
+    root = os.environ.get("PIO_HOME") or os.path.join(".", ".ptpu")
+    path = os.path.join(root, "models")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def model_path(engine_instance_id: str, algo_index: int = 0) -> str:
+    """Per-(instance, algorithm) checkpoint path (the reference's
+    ``(engineInstanceId, ax, algoName)`` id scheme,
+    ``controller/Engine.scala:246,298``)."""
+    return os.path.join(models_dir(),
+                        f"{engine_instance_id}-{algo_index}")
+
+
+class PersistentModel(abc.ABC):
+    """Self-persisting model (``PersistentModel.scala``). Algorithms whose
+    ``train`` returns one of these get manifest-based persistence
+    automatically (see ``Algorithm.make_persistent_model``)."""
+
+    @abc.abstractmethod
+    def save(self, engine_instance_id: str, algo_index: int = 0) -> bool:
+        """Persist. Return False to fall back to blob pickling."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, engine_instance_id: str,
+             algo_index: int = 0) -> "PersistentModel":
+        """Invert :meth:`save`."""
+
+
+class LocalFileSystemPersistentModel(PersistentModel):
+    """Pickle-to-local-disk base class
+    (``controller/LocalFileSystemPersistentModel.scala``). Subclass and
+    it just works; override ``save``/``load`` for custom layouts."""
+
+    def save(self, engine_instance_id: str, algo_index: int = 0) -> bool:
+        import copy
+
+        from ..workflow.persistence import to_host
+
+        path = model_path(engine_instance_id, algo_index) + ".pkl"
+        # an instance is a single pytree leaf, so map to_host over its
+        # attributes — that's where the device arrays live
+        clone = copy.copy(self)
+        clone.__dict__ = {k: to_host(v) for k, v in self.__dict__.items()}
+        with open(path, "wb") as f:
+            pickle.dump(clone, f, protocol=4)
+        return True
+
+    @classmethod
+    def load(cls, engine_instance_id: str, algo_index: int = 0):
+        path = model_path(engine_instance_id, algo_index) + ".pkl"
+        with open(path, "rb") as f:
+            model = pickle.load(f)
+        if not isinstance(model, cls):
+            raise TypeError(f"checkpoint at {path} holds "
+                            f"{type(model).__name__}, expected "
+                            f"{cls.__name__}")
+        return model
+
+
+def manifest_for(model: PersistentModel, engine_instance_id: str,
+                 algo_index: int) -> Optional[PersistentModelManifest]:
+    """Run ``save``; on success return the manifest to store in place of
+    the model (``Engine.makeSerializableModels`` :284-…)."""
+    if model.save(engine_instance_id, algo_index):
+        cls = type(model)
+        return PersistentModelManifest(
+            class_name=f"{cls.__module__}:{cls.__qualname__}",
+            engine_instance_id=engine_instance_id,
+            algo_index=algo_index)
+    return None
+
+
+def load_from_manifest(manifest: PersistentModelManifest) -> Any:
+    """Resolve the manifest's class and call its loader
+    (``SparkWorkflowUtils.getPersistentModel`` role)."""
+    mod_name, qualname = manifest.class_name.split(":", 1)
+    obj: Any = importlib.import_module(mod_name)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj.load(manifest.engine_instance_id, manifest.algo_index)

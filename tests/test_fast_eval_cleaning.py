@@ -1,0 +1,298 @@
+"""FastEvalEngine prefix memoization (mirrors FastEvalEngineTest's
+cache-hit counting), SelfCleaningDataSource compaction, and
+PersistentModel custom persistence."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    Context,
+    DataSource,
+    Engine,
+    EventWindow,
+    FastEvalEngine,
+    FirstServing,
+    IdentityPreparator,
+    LocalFileSystemPersistentModel,
+    PersistentModelManifest,
+    SelfCleaningDataSource,
+    Serving,
+)
+from predictionio_tpu.controller.params import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+# ---------------------------------------------------------------------------
+# FastEvalEngine — instrumented fixture engine (the reference's Engine0
+# family, core/src/test/.../SampleEngine.scala)
+# ---------------------------------------------------------------------------
+
+CALLS = {"read_eval": 0, "prepare": 0, "train": 0, "serve": 0}
+
+
+class CountingDataSource(DataSource):
+    def __init__(self, params=None):
+        self.params = params or {}
+
+    def read_training(self, ctx):
+        return [1, 2, 3]
+
+    def read_eval(self, ctx):
+        CALLS["read_eval"] += 1
+        # two folds; outputs encode params so results are checkable
+        return [([1, 2], {"fold": 0}, [(10, 100), (20, 200)]),
+                ([3, 4], {"fold": 1}, [(30, 300)])]
+
+
+class CountingPreparator(IdentityPreparator):
+    def __init__(self, params=None):
+        self.params = params or {}
+
+    def prepare(self, ctx, td):
+        CALLS["prepare"] += 1
+        return td
+
+
+class ParamAlgo(Algorithm):
+    """Prediction = query * factor (encodes its params, Engine0 style)."""
+
+    def __init__(self, params=None):
+        self.factor = (params or {}).get("factor", 1)
+
+    def train(self, ctx, pd):
+        CALLS["train"] += 1
+        return {"factor": self.factor}
+
+    def predict(self, model, q):
+        return q * model["factor"]
+
+
+class CountingServing(Serving):
+    def __init__(self, params=None):
+        self.params = params or {}
+
+    def serve(self, q, ps):
+        CALLS["serve"] += 1
+        return ps[0]
+
+
+def fixture_engine() -> Engine:
+    return Engine(
+        datasource_classes=CountingDataSource,
+        preparator_classes=CountingPreparator,
+        algorithm_classes={"algo": ParamAlgo, "": ParamAlgo},
+        serving_classes=CountingServing,
+    )
+
+
+def ep(factor: int, serving_params=None) -> EngineParams:
+    return EngineParams(
+        datasource=("", {}),
+        preparator=("", {}),
+        algorithms=[("algo", {"factor": factor})],
+        serving=("", serving_params or {}))
+
+
+class TestFastEvalEngine:
+    def setup_method(self):
+        for k in CALLS:
+            CALLS[k] = 0
+
+    def test_algorithm_sweep_shares_prefix(self):
+        ctx = Context(app_name="x", _storage=Storage(env=MEM_ENV))
+        fe = FastEvalEngine.from_engine(fixture_engine())
+        params = [ep(1), ep(2), ep(3)]
+        results = fe.batch_eval(ctx, params)
+        # datasource read + prepare ran ONCE for the whole sweep
+        assert CALLS["read_eval"] == 1
+        assert CALLS["prepare"] == 2   # once per fold, shared across sweep
+        assert CALLS["train"] == 3 * 2  # per variant per fold — no sharing
+        # results encode the right factor per variant
+        for (p, folds), factor in zip(results, (1, 2, 3)):
+            (ei0, qpa0), _ = folds
+            assert [pred for _, pred, _ in qpa0] == [10 * factor,
+                                                     20 * factor]
+
+    def test_identical_params_fully_cached(self):
+        ctx = Context(app_name="x", _storage=Storage(env=MEM_ENV))
+        fe = FastEvalEngine.from_engine(fixture_engine())
+        fe.batch_eval(ctx, [ep(2), ep(2), ep(2)])
+        assert CALLS["read_eval"] == 1
+        assert CALLS["train"] == 1 * 2  # one variant × two folds
+
+    def test_serving_only_sweep_reuses_predictions(self):
+        ctx = Context(app_name="x", _storage=Storage(env=MEM_ENV))
+        fe = FastEvalEngine.from_engine(fixture_engine())
+        fe.batch_eval(ctx, [ep(2, {"s": 1}), ep(2, {"s": 2})])
+        assert CALLS["train"] == 2      # one variant's algo prefix, 2 folds
+        assert CALLS["serve"] == 3 * 2  # 3 queries × 2 serving variants
+
+    def test_plain_engine_recomputes(self):
+        ctx = Context(app_name="x", _storage=Storage(env=MEM_ENV))
+        engine = fixture_engine()
+        engine.batch_eval(ctx, [ep(1), ep(2)])
+        assert CALLS["read_eval"] == 2  # no memoization on the base engine
+
+
+# ---------------------------------------------------------------------------
+# SelfCleaningDataSource
+# ---------------------------------------------------------------------------
+
+class CleaningDS(SelfCleaningDataSource):
+    def __init__(self, window):
+        self._window = window
+        self.app_name = "cleanapp"
+
+    @property
+    def event_window(self):
+        return self._window
+
+
+def _ev(event, eid, t, props=None, **kw):
+    return Event(event=event, entity_type="user", entity_id=eid,
+                 properties=DataMap(props or {}), event_time=t, **kw)
+
+
+class TestSelfCleaningDataSource:
+    def test_window_filter_keeps_set_events(self):
+        now = T0 + timedelta(days=10)
+        ds = CleaningDS(EventWindow(duration="2 days"))
+        events = [
+            _ev("view", "u1", T0),                       # old, dropped
+            _ev("$set", "u1", T0, {"a": 1}),             # old but $set: kept
+            _ev("view", "u2", now - timedelta(hours=1)),  # recent: kept
+        ]
+        out = ds.filter_window(events, now=now)
+        assert [e.event for e in out] == ["$set", "view"]
+
+    def test_compress_properties(self):
+        ds = CleaningDS(EventWindow(compress_properties=True))
+        events = [
+            _ev("$set", "u1", T0, {"a": 1, "b": 2}),
+            _ev("$set", "u1", T0 + timedelta(minutes=1), {"b": 3}),
+            _ev("$unset", "u1", T0 + timedelta(minutes=2), {"a": 0}),
+            _ev("view", "u1", T0 + timedelta(minutes=3)),
+            _ev("$set", "u2", T0, {"z": 9}),
+        ]
+        out = ds.clean_events(events)
+        sets = {e.entity_id: e for e in out if e.event == "$set"}
+        assert sets["u1"].properties.to_dict() == {"b": 3}  # a unset, b=3
+        assert sets["u2"].properties.to_dict() == {"z": 9}
+        assert sum(1 for e in out if e.event == "view") == 1
+
+    def test_remove_duplicates_keeps_earliest(self):
+        ds = CleaningDS(EventWindow(remove_duplicates=True))
+        events = [
+            _ev("view", "u1", T0 + timedelta(minutes=5), event_id="late"),
+            _ev("view", "u1", T0, event_id="early"),
+            _ev("view", "u2", T0),  # different entity: not a duplicate
+        ]
+        out = ds.clean_events(events)
+        ids = {e.event_id for e in out}
+        assert "early" in ids and "late" not in ids
+        assert len(out) == 2
+
+    def test_clean_persisted_events_rewrites_store(self):
+        storage = Storage(env=MEM_ENV)
+        app_id = storage.apps().insert(App(0, "cleanapp"))
+        storage.events().init(app_id)
+        events = [
+            _ev("$set", "u1", T0, {"a": 1}),
+            _ev("$set", "u1", T0 + timedelta(minutes=1), {"a": 2}),
+            _ev("view", "u1", T0 + timedelta(minutes=2)),
+            _ev("view", "u1", T0 + timedelta(minutes=2)),  # duplicate
+        ]
+        storage.events().insert_batch(events, app_id)
+        ctx = Context(app_name="cleanapp", _storage=storage)
+        ds = CleaningDS(EventWindow(remove_duplicates=True,
+                                    compress_properties=True))
+        removed = ds.clean_persisted_events(ctx)
+        assert removed >= 2
+        remaining = list(ctx.event_store.find("cleanapp"))
+        sets = [e for e in remaining if e.event == "$set"]
+        views = [e for e in remaining if e.event == "view"]
+        assert len(sets) == 1 and sets[0].properties.to_dict() == {"a": 2}
+        assert len(views) == 1
+
+
+# ---------------------------------------------------------------------------
+# PersistentModel
+# ---------------------------------------------------------------------------
+
+class MyModel(LocalFileSystemPersistentModel):
+    def __init__(self, weights):
+        self.weights = weights
+
+
+class PMAlgo(Algorithm):
+    def __init__(self, params=None):
+        pass
+
+    def train(self, ctx, pd):
+        return MyModel(np.arange(4.0))
+
+    def predict(self, model, q):
+        return float(model.weights.sum()) + q
+
+
+class PMDataSource(DataSource):
+    def __init__(self, params=None):
+        pass
+
+    def read_training(self, ctx):
+        return "td"
+
+
+class TestPersistentModel:
+    def test_manifest_roundtrip_through_workflow(self, tmp_path,
+                                                 monkeypatch):
+        from predictionio_tpu.workflow import (
+            get_latest_completed,
+            load_models_for_deploy,
+            run_train,
+        )
+
+        monkeypatch.setenv("PIO_HOME", str(tmp_path))
+        storage = Storage(env=MEM_ENV)
+        ctx = Context(app_name="pm", _storage=storage)
+        engine = Engine(
+            datasource_classes=PMDataSource,
+            preparator_classes=IdentityPreparator,
+            algorithm_classes=PMAlgo,
+            serving_classes=FirstServing)
+        params = EngineParams()
+        iid = run_train(ctx, engine, params, engine_id="pm")
+        # what's stored is a manifest, not the model
+        import pickle
+        blob = storage.models().get(iid)
+        stored = pickle.loads(blob.models)
+        assert isinstance(stored[0], PersistentModelManifest)
+        assert stored[0].class_name.endswith("MyModel")
+        # deploy loads through the manifest
+        inst = get_latest_completed(ctx, engine_id="pm")
+        models = load_models_for_deploy(ctx, engine, inst, params)
+        assert isinstance(models[0], MyModel)
+        np.testing.assert_array_equal(models[0].weights, np.arange(4.0))
+
+    def test_load_type_mismatch_raises(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_HOME", str(tmp_path))
+        MyModel(np.ones(2)).save("inst1", 0)
+
+        class Other(LocalFileSystemPersistentModel):
+            pass
+
+        with pytest.raises(TypeError):
+            Other.load("inst1", 0)
