@@ -42,8 +42,8 @@ class FastEvalEngineWorkflow:
         self.preparator_cache: Dict[str, list] = {}
         self.algorithms_cache: Dict[str, list] = {}
         self.serving_cache: Dict[str, list] = {}
-        #: cache-miss counters, keyed like the caches (observability +
-        #: what FastEvalEngineTest asserts on)
+        #: cache-miss counters, keyed like the caches (observability;
+        #: asserted on by tests/test_fast_eval_cleaning.py)
         self.miss_counts: Dict[str, int] = {
             "datasource": 0, "preparator": 0, "algorithms": 0, "serving": 0}
 
@@ -124,32 +124,27 @@ class FastEvalEngine(Engine):
     prefixes across engine-params variants. Build from an existing engine:
     ``FastEvalEngine.from_engine(engine)``."""
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self._workflows: Dict[int, FastEvalEngineWorkflow] = {}
-
     @classmethod
     def from_engine(cls, engine: Engine) -> "FastEvalEngine":
         fe = cls.__new__(cls)
         fe.__dict__.update(engine.__dict__)
-        fe._workflows = {}
         return fe
 
-    def _workflow(self, ctx: Context) -> FastEvalEngineWorkflow:
-        import weakref
-
-        wf = self._workflows.get(id(ctx))
+    def workflow_for(self, ctx: Context) -> FastEvalEngineWorkflow:
+        """The memoization state for one context. Cached ON the context so
+        the fold/prediction data lives exactly as long as the sweep's
+        context does — the engine never pins it."""
+        cache = getattr(ctx, "_fast_eval_workflows", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(ctx, "_fast_eval_workflows", cache)
+        wf = cache.get(id(self))
         if wf is None:
             wf = FastEvalEngineWorkflow(self, ctx)
-            key = id(ctx)
-            try:
-                # evict the cache (and its strong ctx reference) when the
-                # context dies — a sweep's data shouldn't outlive it
-                weakref.finalize(ctx, self._workflows.pop, key, None)
-            except TypeError:
-                pass  # non-weakrefable ctx: caller owns the lifetime
-            self._workflows[key] = wf
+            cache[id(self)] = wf
         return wf
+
+    _workflow = workflow_for
 
     def eval(self, ctx: Context, engine_params: EngineParams) -> list:
         return self._workflow(ctx).serving_result(engine_params)

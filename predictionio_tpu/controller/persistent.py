@@ -62,12 +62,19 @@ class LocalFileSystemPersistentModel(PersistentModel):
     (``controller/LocalFileSystemPersistentModel.scala``). Subclass and
     it just works; override ``save``/``load`` for custom layouts."""
 
+    def persisted_location(self, engine_instance_id: str,
+                           algo_index: int = 0) -> str:
+        """Absolute checkpoint path, recorded in the manifest so deploy
+        does not depend on PIO_HOME matching the training environment."""
+        return os.path.abspath(
+            model_path(engine_instance_id, algo_index) + ".pkl")
+
     def save(self, engine_instance_id: str, algo_index: int = 0) -> bool:
         import copy
 
         from ..workflow.persistence import to_host
 
-        path = model_path(engine_instance_id, algo_index) + ".pkl"
+        path = self.persisted_location(engine_instance_id, algo_index)
         # an instance is a single pytree leaf, so map to_host over its
         # attributes — that's where the device arrays live
         clone = copy.copy(self)
@@ -77,8 +84,7 @@ class LocalFileSystemPersistentModel(PersistentModel):
         return True
 
     @classmethod
-    def load(cls, engine_instance_id: str, algo_index: int = 0):
-        path = model_path(engine_instance_id, algo_index) + ".pkl"
+    def load_path(cls, path: str):
         with open(path, "rb") as f:
             model = pickle.load(f)
         if not isinstance(model, cls):
@@ -87,6 +93,12 @@ class LocalFileSystemPersistentModel(PersistentModel):
                             f"{cls.__name__}")
         return model
 
+    @classmethod
+    def load(cls, engine_instance_id: str, algo_index: int = 0):
+        return cls.load_path(
+            os.path.abspath(model_path(engine_instance_id, algo_index)
+                            + ".pkl"))
+
 
 def manifest_for(model: PersistentModel, engine_instance_id: str,
                  algo_index: int) -> Optional[PersistentModelManifest]:
@@ -94,10 +106,13 @@ def manifest_for(model: PersistentModel, engine_instance_id: str,
     the model (``Engine.makeSerializableModels`` :284-…)."""
     if model.save(engine_instance_id, algo_index):
         cls = type(model)
+        locator = getattr(model, "persisted_location", None)
         return PersistentModelManifest(
             class_name=f"{cls.__module__}:{cls.__qualname__}",
             engine_instance_id=engine_instance_id,
-            algo_index=algo_index)
+            algo_index=algo_index,
+            location=locator(engine_instance_id, algo_index)
+            if locator else "")
     return None
 
 
@@ -108,4 +123,8 @@ def load_from_manifest(manifest: PersistentModelManifest) -> Any:
     obj: Any = importlib.import_module(mod_name)
     for part in qualname.split("."):
         obj = getattr(obj, part)
+    # prefer the recorded absolute location (robust to a different
+    # PIO_HOME at deploy); fall back to the id-derived path
+    if manifest.location and hasattr(obj, "load_path"):
+        return obj.load_path(manifest.location)
     return obj.load(manifest.engine_instance_id, manifest.algo_index)

@@ -1,0 +1,512 @@
+"""LOCALFS storage backend: JSON-lines event logs + JSON metadata files.
+
+The role of the reference's LocalFS/HDFS backends
+(``storage/localfs/``, ``storage/hdfs/`` — model blobs on a filesystem)
+extended to a full backend: the event log is an append-only JSONL file
+per (app, channel) — the natural on-disk shape of PredictionIO's
+append-only event model — metadata repositories are small JSON documents
+rewritten atomically, and model blobs are plain files.
+
+Suited to single-host dev/offline-training setups; the SQLite backend
+remains the default for concurrent serving. Deletes append tombstone
+records; ``remove`` drops the whole log. Readers replay the log (events
+are immutable, so a replay is exact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from datetime import datetime
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..event import Event
+from .base import (
+    AccessKey,
+    AccessKeysDAO,
+    App,
+    AppsDAO,
+    Channel,
+    ChannelsDAO,
+    EngineInstance,
+    EngineInstancesDAO,
+    EvaluationInstance,
+    EvaluationInstancesDAO,
+    EventFilter,
+    EventStore,
+    Model,
+    ModelsDAO,
+)
+
+
+class LocalFSClient:
+    """Owns the root directory + a process-wide mutation lock."""
+
+    def __init__(self, path: str):
+        self.root = path
+        os.makedirs(path, exist_ok=True)
+        os.makedirs(os.path.join(path, "models"), exist_ok=True)
+        self.lock = threading.RLock()
+        #: per-log replay cache: path → (file size at replay, live events,
+        #: dead-record count). Size mismatch (another process appended)
+        #: invalidates the entry.
+        self.event_cache: Dict[str, tuple] = {}
+
+    @staticmethod
+    def from_config(cfg: dict) -> "LocalFSClient":
+        path = cfg.get("PATH") or os.path.join(
+            os.environ.get("PIO_HOME", "."), "localfs")
+        return LocalFSClient(path)
+
+    def close(self) -> None:
+        pass
+
+    # -- small-document helpers (metadata repositories) --------------------
+    def doc_path(self, name: str) -> str:
+        return os.path.join(self.root, f"{name}.json")
+
+    def read_doc(self, name: str, default):
+        path = self.doc_path(name)
+        if not os.path.exists(path):
+            return default
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def write_doc(self, name: str, value) -> None:
+        path = self.doc_path(name)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(value, f)
+        os.replace(tmp, path)  # atomic on POSIX
+
+
+def _log_name(app_id: int, channel_id: Optional[int]) -> str:
+    suffix = f"_{channel_id}" if channel_id is not None else ""
+    return f"events_{app_id}{suffix}.jsonl"
+
+
+class LocalFSEventStore(EventStore):
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
+        return os.path.join(self.c.root, _log_name(app_id, channel_id))
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.c.lock:
+            path = self._path(app_id, channel_id)
+            if not os.path.exists(path):
+                open(path, "a", encoding="utf-8").close()
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.c.lock:
+            path = self._path(app_id, channel_id)
+            self.c.event_cache.pop(path, None)
+            if os.path.exists(path):
+                os.remove(path)
+                return True
+        return False
+
+    def close(self) -> None:
+        pass
+
+    def _append(self, path: str, records: List[dict]) -> int:
+        with open(path, "a", encoding="utf-8") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+            f.flush()
+            return f.tell()
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        with self.c.lock:
+            path = self._path(app_id, channel_id)
+            live, dead = self._state(path)
+            records, ids = [], []
+            for e in events:
+                eid = e.event_id or uuid.uuid4().hex
+                stored = e.copy(event_id=eid)
+                records.append({"op": "put", "event": stored.to_json()})
+                live[eid] = stored
+                ids.append(eid)
+            size = self._append(path, records)
+            self.c.event_cache[path] = (size, live, dead)
+            return ids
+
+    def _state(self, path: str):
+        """(live events by id, dead-record count), replayed at most once
+        per on-disk file state. Compacts the log when tombstoned/overwritten
+        records outnumber live ones."""
+        cached = self.c.event_cache.get(path)
+        size = os.path.getsize(path) if os.path.exists(path) else -1
+        if cached is not None and cached[0] == size:
+            return cached[1], cached[2]
+        out: Dict[str, Event] = {}
+        dead = 0
+        if size >= 0:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if rec["op"] == "put":
+                        e = Event.from_json(rec["event"])
+                        if e.event_id in out:
+                            dead += 1
+                        out[e.event_id] = e
+                    elif rec["op"] == "del":
+                        if out.pop(rec["eventId"], None) is not None:
+                            dead += 2  # the put and the tombstone
+                        else:
+                            dead += 1
+        if dead > max(len(out), 16):
+            size, dead = self._compact(path, out)
+        self.c.event_cache[path] = (size, out, dead)
+        return out, dead
+
+    def _compact(self, path: str, live: Dict[str, Event]) -> tuple:
+        """Rewrite the log with only live records (atomic replace)."""
+        tmp = f"{path}.compact.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in live.values():
+                f.write(json.dumps({"op": "put", "event": e.to_json()})
+                        + "\n")
+            f.flush()
+            size = f.tell()
+        os.replace(tmp, path)
+        return size, 0
+
+    def _replay(self, app_id: int, channel_id: Optional[int]
+                ) -> Dict[str, Event]:
+        return self._state(self._path(app_id, channel_id))[0]
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        with self.c.lock:
+            return self._replay(app_id, channel_id).get(event_id)
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        with self.c.lock:
+            path = self._path(app_id, channel_id)
+            live, dead = self._state(path)
+            if event_id not in live:
+                return False
+            size = self._append(path, [{"op": "del", "eventId": event_id}])
+            live.pop(event_id)
+            self.c.event_cache[path] = (size, live, dead + 2)
+            return True
+
+    def find(self, app_id: int, channel_id: Optional[int] = None,
+             filter: EventFilter = EventFilter()) -> Iterator[Event]:
+        with self.c.lock:
+            events = list(self._replay(app_id, channel_id).values())
+        events = [e for e in events if filter.matches(e)]
+        events.sort(key=lambda e: e.event_time_millis,
+                    reverse=filter.reversed)
+        if filter.limit is not None and filter.limit >= 0:
+            events = events[: filter.limit]
+        return iter(events)
+
+
+class LocalFSApps(AppsDAO):
+    DOC = "apps"
+
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _load(self) -> List[App]:
+        return [App(**a) for a in self.c.read_doc(self.DOC, [])]
+
+    def _store(self, apps: List[App]) -> None:
+        self.c.write_doc(self.DOC, [
+            {"id": a.id, "name": a.name, "description": a.description}
+            for a in apps])
+
+    def insert(self, app: App) -> Optional[int]:
+        with self.c.lock:
+            apps = self._load()
+            if any(a.name == app.name for a in apps):
+                return None
+            app_id = app.id
+            if app_id == 0:
+                app_id = max((a.id for a in apps), default=0) + 1
+            elif any(a.id == app_id for a in apps):
+                return None
+            apps.append(App(id=app_id, name=app.name,
+                            description=app.description))
+            self._store(apps)
+            return app_id
+
+    def get(self, app_id: int) -> Optional[App]:
+        return next((a for a in self._load() if a.id == app_id), None)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return next((a for a in self._load() if a.name == name), None)
+
+    def get_all(self) -> List[App]:
+        return self._load()
+
+    def update(self, app: App) -> None:
+        with self.c.lock:
+            self._store([app if a.id == app.id else a
+                         for a in self._load()])
+
+    def delete(self, app_id: int) -> None:
+        with self.c.lock:
+            self._store([a for a in self._load() if a.id != app_id])
+
+
+class LocalFSAccessKeys(AccessKeysDAO):
+    DOC = "access_keys"
+
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _load(self) -> List[AccessKey]:
+        return [AccessKey(key=k["key"], app_id=k["appId"],
+                          events=tuple(k["events"]))
+                for k in self.c.read_doc(self.DOC, [])]
+
+    def _store(self, keys: List[AccessKey]) -> None:
+        self.c.write_doc(self.DOC, [
+            {"key": k.key, "appId": k.app_id, "events": list(k.events)}
+            for k in keys])
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        import base64
+
+        with self.c.lock:
+            keys = self._load()
+            key = access_key.key or base64.urlsafe_b64encode(
+                uuid.uuid4().bytes).decode().rstrip("=")
+            if any(k.key == key for k in keys):
+                return None
+            keys.append(AccessKey(key=key, app_id=access_key.app_id,
+                                  events=tuple(access_key.events)))
+            self._store(keys)
+            return key
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return next((k for k in self._load() if k.key == key), None)
+
+    def get_all(self) -> List[AccessKey]:
+        return self._load()
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [k for k in self._load() if k.app_id == app_id]
+
+    def update(self, access_key: AccessKey) -> None:
+        with self.c.lock:
+            self._store([access_key if k.key == access_key.key else k
+                         for k in self._load()])
+
+    def delete(self, key: str) -> None:
+        with self.c.lock:
+            self._store([k for k in self._load() if k.key != key])
+
+
+class LocalFSChannels(ChannelsDAO):
+    DOC = "channels"
+
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _load(self) -> List[Channel]:
+        return [Channel(id=ch["id"], name=ch["name"], app_id=ch["appId"])
+                for ch in self.c.read_doc(self.DOC, [])]
+
+    def _store(self, chans: List[Channel]) -> None:
+        self.c.write_doc(self.DOC, [
+            {"id": ch.id, "name": ch.name, "appId": ch.app_id}
+            for ch in chans])
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        with self.c.lock:
+            chans = self._load()
+            cid = channel.id or max((c.id for c in chans), default=0) + 1
+            if any(c.id == cid for c in chans):
+                return None
+            chans.append(Channel(id=cid, name=channel.name,
+                                 app_id=channel.app_id))
+            self._store(chans)
+            return cid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return next((c for c in self._load() if c.id == channel_id), None)
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        return [c for c in self._load() if c.app_id == app_id]
+
+    def delete(self, channel_id: int) -> None:
+        with self.c.lock:
+            self._store([c for c in self._load() if c.id != channel_id])
+
+
+def _dt(s: str) -> datetime:
+    return datetime.fromisoformat(s)
+
+
+class LocalFSEngineInstances(EngineInstancesDAO):
+    DOC = "engine_instances"
+
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _load(self) -> List[EngineInstance]:
+        out = []
+        for d in self.c.read_doc(self.DOC, []):
+            d = dict(d)
+            d["start_time"] = _dt(d["start_time"])
+            d["end_time"] = _dt(d["end_time"])
+            out.append(EngineInstance(**d))
+        return out
+
+    def _store(self, instances: List[EngineInstance]) -> None:
+        docs = []
+        for i in instances:
+            d = {
+                "id": i.id, "status": i.status,
+                "start_time": i.start_time.isoformat(),
+                "end_time": i.end_time.isoformat(),
+                "engine_id": i.engine_id,
+                "engine_version": i.engine_version,
+                "engine_variant": i.engine_variant,
+                "engine_factory": i.engine_factory, "batch": i.batch,
+                "env": dict(i.env), "spark_conf": dict(i.spark_conf),
+                "data_source_params": i.data_source_params,
+                "preparator_params": i.preparator_params,
+                "algorithms_params": i.algorithms_params,
+                "serving_params": i.serving_params,
+            }
+            docs.append(d)
+        self.c.write_doc(self.DOC, docs)
+
+    def insert(self, instance: EngineInstance) -> str:
+        with self.c.lock:
+            instances = self._load()
+            iid = instance.id or uuid.uuid4().hex
+            instances.append(instance.copy(id=iid))
+            self._store(instances)
+            return iid
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        return next((i for i in self._load() if i.id == instance_id), None)
+
+    def get_all(self) -> List[EngineInstance]:
+        return self._load()
+
+    def get_completed(self, engine_id: str, engine_version: str,
+                      engine_variant: str) -> List[EngineInstance]:
+        from .base import STATUS_COMPLETED
+        return sorted(
+            (i for i in self._load()
+             if i.status == STATUS_COMPLETED and i.engine_id == engine_id
+             and i.engine_version == engine_version
+             and i.engine_variant == engine_variant),
+            key=lambda i: i.start_time, reverse=True)
+
+    def update(self, instance: EngineInstance) -> None:
+        with self.c.lock:
+            self._store([instance if i.id == instance.id else i
+                         for i in self._load()])
+
+    def delete(self, instance_id: str) -> None:
+        with self.c.lock:
+            self._store([i for i in self._load() if i.id != instance_id])
+
+
+class LocalFSEvaluationInstances(EvaluationInstancesDAO):
+    DOC = "evaluation_instances"
+
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _load(self) -> List[EvaluationInstance]:
+        out = []
+        for d in self.c.read_doc(self.DOC, []):
+            d = dict(d)
+            d["start_time"] = _dt(d["start_time"])
+            d["end_time"] = _dt(d["end_time"])
+            out.append(EvaluationInstance(**d))
+        return out
+
+    def _store(self, instances: List[EvaluationInstance]) -> None:
+        self.c.write_doc(self.DOC, [
+            {"id": i.id, "status": i.status,
+             "start_time": i.start_time.isoformat(),
+             "end_time": i.end_time.isoformat(),
+             "evaluation_class": i.evaluation_class,
+             "engine_params_generator_class":
+                 i.engine_params_generator_class,
+             "batch": i.batch, "env": dict(i.env),
+             "spark_conf": dict(i.spark_conf),
+             "evaluator_results": i.evaluator_results,
+             "evaluator_results_html": i.evaluator_results_html,
+             "evaluator_results_json": i.evaluator_results_json}
+            for i in instances])
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        with self.c.lock:
+            instances = self._load()
+            iid = instance.id or uuid.uuid4().hex
+            instances.append(instance.copy(id=iid))
+            self._store(instances)
+            return iid
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        return next((i for i in self._load() if i.id == instance_id), None)
+
+    def get_all(self) -> List[EvaluationInstance]:
+        return self._load()
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        from .base import STATUS_EVALCOMPLETED
+        return sorted((i for i in self._load()
+                       if i.status == STATUS_EVALCOMPLETED),
+                      key=lambda i: i.start_time, reverse=True)
+
+    def update(self, instance: EvaluationInstance) -> None:
+        with self.c.lock:
+            self._store([instance if i.id == instance.id else i
+                         for i in self._load()])
+
+    def delete(self, instance_id: str) -> None:
+        with self.c.lock:
+            self._store([i for i in self._load() if i.id != instance_id])
+
+
+class LocalFSModels(ModelsDAO):
+    def __init__(self, client: LocalFSClient):
+        self.c = client
+
+    def _path(self, model_id: str) -> str:
+        return os.path.join(self.c.root, "models", f"{model_id}.bin")
+
+    def insert(self, model: Model) -> None:
+        with self.c.lock:
+            with open(self._path(model.id), "wb") as f:
+                f.write(model.models)
+
+    def get(self, model_id: str) -> Optional[Model]:
+        path = self._path(model_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return Model(id=model_id, models=f.read())
+
+    def delete(self, model_id: str) -> None:
+        with self.c.lock:
+            path = self._path(model_id)
+            if os.path.exists(path):
+                os.remove(path)

@@ -80,6 +80,22 @@ def _register_builtins() -> None:
         },
         close=lambda c: c.close()))
 
+    from . import localfs
+
+    register_backend("LOCALFS", Backend(
+        make_client=lambda cfg: localfs.LocalFSClient.from_config(cfg),
+        daos={
+            "events": lambda c: localfs.LocalFSEventStore(c),
+            "apps": lambda c: localfs.LocalFSApps(c),
+            "access_keys": lambda c: localfs.LocalFSAccessKeys(c),
+            "channels": lambda c: localfs.LocalFSChannels(c),
+            "engine_instances": lambda c: localfs.LocalFSEngineInstances(c),
+            "evaluation_instances":
+                lambda c: localfs.LocalFSEvaluationInstances(c),
+            "models": lambda c: localfs.LocalFSModels(c),
+        },
+        close=lambda c: c.close()))
+
 
 _register_builtins()
 

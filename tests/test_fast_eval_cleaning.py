@@ -120,6 +120,8 @@ class TestFastEvalEngine:
         assert CALLS["read_eval"] == 1
         assert CALLS["prepare"] == 2   # once per fold, shared across sweep
         assert CALLS["train"] == 3 * 2  # per variant per fold — no sharing
+        assert fe.workflow_for(ctx).miss_counts == {
+            "datasource": 1, "preparator": 1, "algorithms": 3, "serving": 3}
         # results encode the right factor per variant
         for (p, folds), factor in zip(results, (1, 2, 3)):
             (ei0, qpa0), _ = folds

@@ -56,8 +56,31 @@ def ev(name, eid, t, etype="user", **kw):
                  event_time=t, **kw)
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "localfs"])
 def backend(request, tmp_path):
+    if request.param == "localfs":
+        from predictionio_tpu.data.storage.localfs import (
+            LocalFSAccessKeys,
+            LocalFSApps,
+            LocalFSChannels,
+            LocalFSClient,
+            LocalFSEngineInstances,
+            LocalFSEvaluationInstances,
+            LocalFSEventStore,
+            LocalFSModels,
+        )
+        client = LocalFSClient(str(tmp_path / "localfs"))
+        yield {
+            "events": LocalFSEventStore(client),
+            "apps": LocalFSApps(client),
+            "access_keys": LocalFSAccessKeys(client),
+            "channels": LocalFSChannels(client),
+            "engine_instances": LocalFSEngineInstances(client),
+            "evaluation_instances": LocalFSEvaluationInstances(client),
+            "models": LocalFSModels(client),
+        }
+        client.close()
+        return
     if request.param == "memory":
         yield {
             "events": MemoryEventStore(),
@@ -308,3 +331,44 @@ class TestRegistry:
                 "PIO_STORAGE_SOURCES_X_TYPE": "memory",
                 "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
             })
+
+
+class TestLocalFSBackend:
+    def test_env_config_and_durability(self, tmp_path):
+        env = {
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "store"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        }
+        s = Storage(env=env)
+        s.verify_all_data_objects()
+        app_id = s.apps().insert(App(0, "fsapp"))
+        s.events().init(app_id)
+        eid = s.events().insert(ev("view", "u1", T0), app_id)
+        s.models().insert(Model(id="m1", models=b"\x00\x01"))
+        s.close()
+        # a fresh Storage over the same directory sees everything
+        s2 = Storage(env=env)
+        assert s2.apps().get_by_name("fsapp").id == app_id
+        got = s2.events().get(eid, app_id)
+        assert got is not None and got.entity_id == "u1"
+        assert s2.models().get("m1").models == b"\x00\x01"
+
+    def test_delete_tombstones_survive_reopen(self, tmp_path):
+        env = {
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "store"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        }
+        s = Storage(env=env)
+        app_id = s.apps().insert(App(0, "tomb"))
+        s.events().init(app_id)
+        eid = s.events().insert(ev("view", "u1", T0), app_id)
+        assert s.events().delete(eid, app_id)
+        s.close()
+        s2 = Storage(env=env)
+        assert s2.events().get(eid, app_id) is None
