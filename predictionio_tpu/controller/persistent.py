@@ -123,8 +123,12 @@ def load_from_manifest(manifest: PersistentModelManifest) -> Any:
     obj: Any = importlib.import_module(mod_name)
     for part in qualname.split("."):
         obj = getattr(obj, part)
-    # prefer the recorded absolute location (robust to a different
-    # PIO_HOME at deploy); fall back to the id-derived path
-    if manifest.location and hasattr(obj, "load_path"):
+    # use the recorded absolute location (robust to a different PIO_HOME
+    # at deploy) — but ONLY when the class kept the stock pickle loader;
+    # a subclass overriding `load` owns its layout entirely
+    stock_load = (getattr(obj, "load", None) is not None
+                  and obj.load.__func__
+                  is LocalFileSystemPersistentModel.load.__func__)
+    if manifest.location and stock_load:
         return obj.load_path(manifest.location)
     return obj.load(manifest.engine_instance_id, manifest.algo_index)

@@ -81,6 +81,15 @@ class LocalFSClient:
             json.dump(value, f)
         os.replace(tmp, path)  # atomic on POSIX
 
+    def next_seq(self, name: str) -> int:
+        """Monotonic id sequence per entity kind — deleted rows never free
+        their ids (matches the memory/sqlite backends; prevents a new app
+        inheriting a dead app's event log)."""
+        doc = f"{name}_seq"
+        n = int(self.read_doc(doc, 0)) + 1
+        self.write_doc(doc, n)
+        return n
+
 
 def _log_name(app_id: int, channel_id: Optional[int]) -> str:
     suffix = f"_{channel_id}" if channel_id is not None else ""
@@ -129,14 +138,18 @@ class LocalFSEventStore(EventStore):
         with self.c.lock:
             path = self._path(app_id, channel_id)
             live, dead = self._state(path)
-            records, ids = [], []
+            records, ids, stored_events = [], [], []
             for e in events:
                 eid = e.event_id or uuid.uuid4().hex
                 stored = e.copy(event_id=eid)
                 records.append({"op": "put", "event": stored.to_json()})
-                live[eid] = stored
+                stored_events.append(stored)
                 ids.append(eid)
+            # disk first: a failed append must not leave ghost events in
+            # the cache
             size = self._append(path, records)
+            for stored in stored_events:
+                live[stored.event_id] = stored
             self.c.event_cache[path] = (size, live, dead)
             return ids
 
@@ -238,7 +251,7 @@ class LocalFSApps(AppsDAO):
                 return None
             app_id = app.id
             if app_id == 0:
-                app_id = max((a.id for a in apps), default=0) + 1
+                app_id = self.c.next_seq("apps")
             elif any(a.id == app_id for a in apps):
                 return None
             apps.append(App(id=app_id, name=app.name,
@@ -282,12 +295,9 @@ class LocalFSAccessKeys(AccessKeysDAO):
             for k in keys])
 
     def insert(self, access_key: AccessKey) -> Optional[str]:
-        import base64
-
         with self.c.lock:
             keys = self._load()
-            key = access_key.key or base64.urlsafe_b64encode(
-                uuid.uuid4().bytes).decode().rstrip("=")
+            key = access_key.key or self.generate_key()
             if any(k.key == key for k in keys):
                 return None
             keys.append(AccessKey(key=key, app_id=access_key.app_id,
@@ -334,7 +344,7 @@ class LocalFSChannels(ChannelsDAO):
             return None
         with self.c.lock:
             chans = self._load()
-            cid = channel.id or max((c.id for c in chans), default=0) + 1
+            cid = channel.id or self.c.next_seq("channels")
             if any(c.id == cid for c in chans):
                 return None
             chans.append(Channel(id=cid, name=channel.name,

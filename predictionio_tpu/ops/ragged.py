@@ -57,22 +57,7 @@ def pack_histories(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     order = np.argsort(rows, kind="stable")
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     counts = np.bincount(rows_s, minlength=n_rows).astype(np.int32)
-    if max_len is not None:
-        L = int(max_len)
-    else:
-        L = int(counts.max(initial=1))
-        if n_rows * L > AUTO_CAP_ENTRIES:
-            capped = int(np.quantile(counts, 0.999)) or 1
-            capped = max(capped, AUTO_CAP_ENTRIES // max(n_rows, 1))
-            if capped < L:
-                dropped = int(np.maximum(counts - capped, 0).sum())
-                log.warning(
-                    "pack_histories: capping history length %d → %d "
-                    "(99.9th pct; dense layout would be %d×%d); dropping "
-                    "%d/%d entries from the heaviest rows. Set max_len to "
-                    "override.", L, capped, n_rows, L, dropped, len(rows_s))
-                L = capped
-    L = max(L, 1)
+    L = resolve_max_len(counts, n_rows, max_len)
 
     n_pad = ((n_rows + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
     indices = np.zeros((n_pad, L), dtype=np.int32)
@@ -95,3 +80,88 @@ def transpose_coo(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray
                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Swap the roles of rows and cols (users↔items)."""
     return cols, rows, vals
+
+
+def resolve_max_len(counts: np.ndarray, n_rows: int,
+                    max_len: Optional[int]) -> int:
+    """Padded history length: the explicit cap, or the longest row with
+    the 99.9th-percentile auto-cap (warning when entries get dropped)."""
+    if max_len is not None:
+        return max(int(max_len), 1)
+    L = int(counts.max(initial=1))
+    if n_rows * L > AUTO_CAP_ENTRIES:
+        capped = int(np.quantile(counts, 0.999)) or 1
+        capped = max(capped, AUTO_CAP_ENTRIES // max(n_rows, 1))
+        if capped < L:
+            dropped = int(np.maximum(counts - capped, 0).sum())
+            log.warning(
+                "pack_histories: capping history length %d → %d "
+                "(99.9th pct; dense layout would be %d×%d); dropping "
+                "%d/%d entries from the heaviest rows. Set max_len to "
+                "override.", L, capped, n_rows, L, dropped,
+                int(counts.sum()))
+            L = capped
+    return max(L, 1)
+
+
+def pack_histories_device(rows: np.ndarray, cols: np.ndarray,
+                          vals: np.ndarray, n_rows: int, max_len: int,
+                          pad_rows_to: int = 1) -> PaddedHistories:
+    """Device-side :func:`pack_histories`: one jitted sort + scatter.
+
+    Packing 20M MovieLens-shaped entries takes ~10s of host numpy
+    (argsort + fancy-index scatters) but milliseconds as a compiled XLA
+    program, so the COO triples ship to the device raw and the padded
+    layout is built there. Semantics match the host packer: stable
+    within-row input order, entries beyond ``max_len`` dropped, rows
+    padded to a ``pad_rows_to`` multiple.
+
+    Returns the padded arrays as ``jax.Array``s still resident on device
+    (duck-typed into ``PaddedHistories``) so the training loop can shard
+    them without a host round-trip.
+    """
+    import jax.numpy as jnp
+
+    L = max(int(max_len), 1)
+    n_pad = ((n_rows + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    idx, val, cnt = _pack_on_device(
+        jnp.asarray(rows, dtype=jnp.int32),
+        jnp.asarray(cols, dtype=jnp.int32),
+        jnp.asarray(vals, dtype=jnp.float32),
+        n_rows=n_rows, L=L, n_pad=n_pad)
+    return PaddedHistories(indices=idx, values=val, counts=cnt)
+
+
+def _pack_on_device(r, c, v, *, n_rows: int, L: int, n_pad: int):
+    import jax
+
+    global _pack_jit
+    if _pack_jit is None:
+        import jax.numpy as jnp
+
+        def pack(r, c, v, n_rows, L, n_pad):
+            nnz = r.shape[0]
+            order = jnp.argsort(r, stable=True)
+            rs, cs, vs = r[order], c[order], v[order]
+            counts = jnp.bincount(rs, length=n_rows).astype(jnp.int32)
+            starts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(counts, dtype=jnp.int32)])
+            pos = jnp.arange(nnz, dtype=jnp.int32) - starts[rs]
+            flat = rs * jnp.int32(L) + pos
+            oob = jnp.int32(n_pad * L)  # mode="drop" sentinel for pos >= L
+            flat = jnp.where(pos < L, flat, oob)
+            idx = jnp.zeros(n_pad * L, jnp.int32).at[flat].set(
+                cs, mode="drop")
+            val = jnp.zeros(n_pad * L, jnp.float32).at[flat].set(
+                vs, mode="drop")
+            cnt = jnp.zeros(n_pad, jnp.int32).at[:n_rows].set(
+                jnp.minimum(counts, L))
+            return idx.reshape(n_pad, L), val.reshape(n_pad, L), cnt
+
+        _pack_jit = jax.jit(pack,
+                            static_argnames=("n_rows", "L", "n_pad"))
+    return _pack_jit(r, c, v, n_rows=n_rows, L=L, n_pad=n_pad)
+
+
+_pack_jit = None

@@ -1,0 +1,95 @@
+"""Tracing/profiling — a first-class improvement over the reference.
+
+The reference has no tracing at all (SURVEY §5: observability = logs +
+the external Spark UI). Here the XLA profiler is wired into the
+workflow: ``trace(dir)`` captures a device trace viewable in
+TensorBoard/XProf/Perfetto, ``annotate(name)`` labels host-side phases so
+they show up on the trace timeline, and ``timed(name)`` collects
+wall-clock spans into an in-process registry the servers can expose.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA device trace under ``log_dir`` (no-op when None).
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("XLA trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label a host-side phase on the profiler timeline."""
+    try:
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except ImportError:  # profiling must never break the workflow
+        yield
+
+
+class SpanRegistry:
+    """Thread-safe wall-clock span collection (count/total/max per name)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: Dict[str, List[float]] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._spans.setdefault(name, []).append(seconds)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": len(xs),
+                    "total_sec": sum(xs),
+                    "mean_sec": sum(xs) / len(xs),
+                    "max_sec": max(xs),
+                }
+                for name, xs in self._spans.items() if xs
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+#: Process-wide registry; the engine server's status page reads it.
+spans = SpanRegistry()
+
+
+@contextlib.contextmanager
+def timed(name: str,
+          registry: Optional[SpanRegistry] = None) -> Iterator[None]:
+    """Time a block into the span registry AND the profiler timeline."""
+    reg = registry if registry is not None else spans
+    t0 = time.monotonic()
+    with annotate(name):
+        try:
+            yield
+        finally:
+            reg.record(name, time.monotonic() - t0)
