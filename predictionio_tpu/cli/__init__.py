@@ -289,6 +289,8 @@ def cmd_deploy(args, storage: Storage) -> int:
     variant = load_variant(args.engine_json)
     engine, engine_params = engine_from_variant(variant)
     ctx = _make_ctx(storage)
+    from ..server.http import ssl_context_from
+
     config = ServerConfig(
         feedback=args.feedback,
         feedback_app_name=args.feedback_app_name or None,
@@ -298,9 +300,11 @@ def cmd_deploy(args, storage: Storage) -> int:
         engine_id=args.engine_id or variant.get("id", "default"),
         engine_version=args.engine_version or variant.get("version", "1"),
         engine_variant=args.engine_json,
-        config=config, host=args.ip, port=args.port)
+        config=config, host=args.ip, port=args.port,
+        ssl_context=ssl_context_from(args.cert or None, args.key or None))
+    scheme = "https" if args.cert else "http"
     _out(f"Engine is deployed and running. Engine API is live at "
-         f"http://{args.ip}:{server.port}.")
+         f"{scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -311,12 +315,21 @@ def cmd_deploy(args, storage: Storage) -> int:
 def cmd_undeploy(args, storage: Storage) -> int:
     import urllib.request
 
-    url = f"http://{args.ip}:{args.port}/stop"
+    scheme = "https" if args.https else "http"
+    url = f"{scheme}://{args.ip}:{args.port}/stop"
     if args.accesskey:
         url += f"?accessKey={args.accesskey}"
     try:
+        import ssl as _ssl
+
+        kw = {}
+        if args.https:
+            insecure = _ssl.create_default_context()
+            insecure.check_hostname = False
+            insecure.verify_mode = _ssl.CERT_NONE  # local control plane
+            kw["context"] = insecure
         req = urllib.request.Request(url, method="POST", data=b"")
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        with urllib.request.urlopen(req, timeout=10, **kw) as resp:
             resp.read()
         _out(f"Undeployed engine server at {args.ip}:{args.port}.")
         return 0
@@ -343,11 +356,14 @@ def cmd_batchpredict(args, storage: Storage) -> int:
 
 def cmd_eventserver(args, storage: Storage) -> int:
     from ..server.eventserver import build_app
-    from ..server.http import AppServer
+    from ..server.http import AppServer, ssl_context_from
 
     server = AppServer(build_app(storage, stats=args.stats),
-                       host=args.ip, port=args.port)
-    _out(f"Event Server is listening at http://{args.ip}:{server.port}.")
+                       host=args.ip, port=args.port,
+                       ssl_context=ssl_context_from(args.cert or None,
+                                                    args.key or None))
+    scheme = "https" if args.cert else "http"
+    _out(f"Event Server is listening at {scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -466,6 +482,52 @@ def cmd_build(args, storage: Storage) -> int:
     return 0
 
 
+def cmd_shell(args, storage: Storage) -> int:
+    """Interactive shell with the framework preloaded
+    (``bin/pio-shell`` role; pypio is native here)."""
+    import code
+
+    from ..controller.context import Context
+    from ..data.store import EventStoreFacade
+    from ..pypio import PEventStore
+
+    ns = {
+        "storage": storage,
+        "event_store": EventStoreFacade(storage),
+        "p_event_store": PEventStore(EventStoreFacade(storage)),
+        "Context": Context,
+    }
+    banner = ("PredictionIO-TPU shell. Preloaded: storage, event_store, "
+              "p_event_store, Context.")
+    try:
+        import IPython
+
+        IPython.start_ipython(argv=[], user_ns=ns)
+    except ImportError:
+        code.interact(banner=banner, local=ns)
+    return 0
+
+
+def cmd_run(args, storage: Storage) -> int:
+    """Run a user entry point with storage configured
+    (``pio run`` / ``commands/Engine.scala:332``)."""
+    from ..data.storage import registry as _registry
+    from ..data.storage.registry import set_storage
+
+    fn = load_engine_factory(args.target)
+    if not callable(fn):
+        raise SystemExit(f"{args.target!r} is not callable")
+    prior = _registry._global
+    set_storage(storage)
+    try:
+        result = fn(*args.args)
+        if result is not None:
+            _out(str(result))
+        return 0
+    finally:
+        set_storage(prior)
+
+
 def cmd_template(args, storage: Storage) -> int:
     _out("Bundled engine templates (predictionio_tpu.templates):")
     _out("  recommendation  — ALS top-N (module: "
@@ -564,12 +626,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--feedback", action="store_true")
     s.add_argument("--feedback-app-name", default="")
     s.add_argument("--accesskey", default="")
+    s.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
+    s.add_argument("--key", default="", help="PEM private key")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--accesskey", default="",
                    help="access key if the server was deployed with one")
+    s.add_argument("--https", action="store_true",
+                   help="the server was deployed with --cert/--key")
 
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)
@@ -580,6 +646,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ip", default="0.0.0.0")
     s.add_argument("--port", type=int, default=7070)
     s.add_argument("--stats", action="store_true")
+    s.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
+    s.add_argument("--key", default="", help="PEM private key")
 
     s = sub.add_parser("adminserver", help="start the admin API")
     s.add_argument("--ip", default="127.0.0.1")
@@ -604,6 +672,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--input", required=True)
 
     sub.add_parser("template", help="list bundled engine templates")
+    sub.add_parser("shell", help="interactive shell with storage preloaded")
+    s = sub.add_parser("run", help="run module.path:callable with storage "
+                                   "configured")
+    s.add_argument("target")
+    s.add_argument("args", nargs="*")
     sub.add_parser("version", help="print version")
     return p
 
@@ -621,6 +694,8 @@ COMMANDS = {
     "adminserver": cmd_adminserver,
     "dashboard": cmd_dashboard,
     "status": cmd_status,
+    "shell": cmd_shell,
+    "run": cmd_run,
     "export": cmd_export,
     "import": cmd_import,
     "template": cmd_template,

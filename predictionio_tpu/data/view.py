@@ -1,0 +1,119 @@
+"""Batch views — deprecated pre-0.9 aggregation API, kept for compat.
+
+Capability parity with ``data/.../view/{LBatchView,PBatchView,DataView}.
+scala`` (SURVEY C22): an ``EventSeq`` wrapper with predicate filtering and
+ordered per-entity aggregation, plus a ``BatchView`` that snapshots an
+app's events once and answers filtered/aggregated queries. Deprecated in
+the reference and here alike — new code should use
+``EventStoreFacade.aggregate_properties`` (C16/C17).
+"""
+
+from __future__ import annotations
+
+import warnings
+from datetime import datetime
+from typing import Callable, Dict, Iterable, List, Optional, TypeVar
+
+from .datamap import DataMap
+from .event import Event
+
+T = TypeVar("T")
+
+
+def _predicate(start_time: Optional[datetime] = None,
+               until_time: Optional[datetime] = None,
+               entity_type: Optional[str] = None,
+               event: Optional[str] = None) -> Callable[[Event], bool]:
+    """Compose the ViewPredicates (``LBatchView.scala:31-75``)."""
+
+    def ok(e: Event) -> bool:
+        if start_time is not None and e.event_time < start_time:
+            return False
+        if until_time is not None and not (e.event_time < until_time):
+            return False
+        if entity_type is not None and e.entity_type != entity_type:
+            return False
+        if event is not None and e.event != event:
+            return False
+        return True
+
+    return ok
+
+
+def data_map_aggregator():
+    """The ``$set/$unset/$delete`` fold of ``ViewAggregators`` (:77-101):
+    (Optional[DataMap], Event) → Optional[DataMap]."""
+
+    def agg(acc: Optional[DataMap], e: Event) -> Optional[DataMap]:
+        if e.event == "$set":
+            base = acc.to_dict() if acc else {}
+            base.update(e.properties.to_dict())
+            return DataMap(base)
+        if e.event == "$unset":
+            base = acc.to_dict() if acc else {}
+            for k in e.properties.to_dict():
+                base.pop(k, None)
+            return DataMap(base)
+        if e.event == "$delete":
+            return None
+        return acc
+
+    return agg
+
+
+class EventSeq:
+    """List-of-events wrapper (``EventSeq``, ``LBatchView.scala:103-142``)."""
+
+    def __init__(self, events: Iterable[Event]):
+        self.events: List[Event] = list(events)
+
+    def filter(self, p: Optional[Callable[[Event], bool]] = None, *,
+               start_time: Optional[datetime] = None,
+               until_time: Optional[datetime] = None,
+               entity_type: Optional[str] = None,
+               event: Optional[str] = None) -> "EventSeq":
+        pred = p if p is not None else _predicate(
+            start_time, until_time, entity_type, event)
+        return EventSeq([e for e in self.events if pred(e)])
+
+    def aggregate_by_entity_ordered(
+            self, init: T, op: Callable[[T, Event], T]) -> Dict[str, T]:
+        """Fold events per entityId in event-time order (:134-141)."""
+        grouped: Dict[str, List[Event]] = {}
+        for e in sorted(self.events, key=lambda e: e.event_time):
+            grouped.setdefault(e.entity_id, []).append(e)
+        out: Dict[str, T] = {}
+        for eid, evs in grouped.items():
+            acc = init
+            for e in evs:
+                acc = op(acc, e)
+            out[eid] = acc
+        return out
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+
+class BatchView:
+    """Snapshot view over one app's events (``LBatchView``/``PBatchView``
+    role — the L/P split collapses here like everywhere else)."""
+
+    def __init__(self, ctx, app_name: str,
+                 start_time: Optional[datetime] = None,
+                 until_time: Optional[datetime] = None):
+        warnings.warn(
+            "BatchView is deprecated (reference data/view/); use "
+            "EventStoreFacade.aggregate_properties instead",
+            DeprecationWarning, stacklevel=2)
+        self.events = EventSeq(ctx.event_store.find(
+            app_name, start_time=start_time, until_time=until_time))
+
+    def aggregate_properties(self, entity_type: str) -> Dict[str, DataMap]:
+        """Current properties per entity (``LBatchView.scala:168-…``)."""
+        agg = data_map_aggregator()
+        folded = self.events.filter(
+            entity_type=entity_type).aggregate_by_entity_ordered(None, agg)
+        return {k: v for k, v in folded.items() if v is not None}

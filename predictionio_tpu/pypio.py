@@ -1,0 +1,63 @@
+"""pypio-compatible surface for users migrating from the reference.
+
+The reference's Python story was a py4j bridge into the JVM
+(``python/pypio/data/eventstore.py:26-48`` → ``PPythonEventStore`` →
+Spark DataFrame; SURVEY C27). This framework IS Python, so the bridge
+collapses to thin aliases over the native facade — same call names, no
+py4j, events come back as host rows ready for ``numpy``/``jax``.
+
+    from predictionio_tpu.pypio import p_event_store
+    rows = p_event_store.find(app_name="myapp")
+    props = p_event_store.aggregate_properties("myapp", "user")
+
+``find`` returns a list of ``Event``s (the DataFrame role is played by
+converting to columnar numpy with ``events_to_columns``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .data.event import Event
+from .data.store import EventStoreFacade, event_store
+
+
+class PEventStore:
+    """Name-compatible with ``pypio.data.PEventStore``."""
+
+    def __init__(self, facade: Optional[EventStoreFacade] = None):
+        self._facade = facade or event_store
+
+    def find(self, app_name: str, channel_name: Optional[str] = None,
+             **filters) -> List[Event]:
+        return list(self._facade.find(app_name, channel_name=channel_name,
+                                      **filters))
+
+    def aggregate_properties(self, app_name: str, entity_type: str,
+                             channel_name: Optional[str] = None,
+                             **filters):
+        return self._facade.aggregate_properties(
+            app_name, entity_type, channel_name=channel_name, **filters)
+
+
+def events_to_columns(events: Sequence[Event]) -> Dict[str, np.ndarray]:
+    """Columnar view of an event list (the Spark-DataFrame role): object
+    arrays for ids/names, int64 millis for times."""
+    return {
+        "event": np.array([e.event for e in events], dtype=object),
+        "entityType": np.array([e.entity_type for e in events],
+                               dtype=object),
+        "entityId": np.array([e.entity_id for e in events], dtype=object),
+        "targetEntityType": np.array(
+            [e.target_entity_type for e in events], dtype=object),
+        "targetEntityId": np.array(
+            [e.target_entity_id for e in events], dtype=object),
+        "eventTime": np.array([e.event_time_millis for e in events],
+                              dtype=np.int64),
+    }
+
+
+#: module-level instance, mirroring `pypio`'s usage style
+p_event_store = PEventStore()

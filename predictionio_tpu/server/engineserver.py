@@ -237,11 +237,11 @@ def build_app(server: QueryServer) -> HTTPApp:
 
 
 def create_engine_server(server: QueryServer, host: str = "0.0.0.0",
-                         port: int = 8000) -> AppServer:
+                         port: int = 8000, ssl_context=None) -> AppServer:
     """Bind the engine server (reference default port 8000,
     ``CreateServer.scala:78``)."""
     app = build_app(server)
-    srv = AppServer(app, host, port)
+    srv = AppServer(app, host, port, ssl_context=ssl_context)
     app._server_ref.append(srv)  # type: ignore[attr-defined]
     return srv
 
@@ -250,7 +250,8 @@ def deploy(ctx: Context, engine: Engine, engine_params: EngineParams,
            engine_id: str = "default", engine_version: str = "1",
            engine_variant: str = "engine.json",
            config: Optional[ServerConfig] = None,
-           host: str = "0.0.0.0", port: int = 8000) -> AppServer:
+           host: str = "0.0.0.0", port: int = 8000,
+           ssl_context=None) -> AppServer:
     """The ``pio deploy`` flow (``commands/Engine.scala:207`` →
     ``CreateServer``): find the latest COMPLETED instance, re-materialize
     its models, bind the HTTP server."""
@@ -264,4 +265,4 @@ def deploy(ctx: Context, engine: Engine, engine_params: EngineParams,
             f"{engine_variant}; run train first.")
     models = wf.load_models_for_deploy(ctx, engine, instance, engine_params)
     server = QueryServer(ctx, engine, engine_params, models, instance, config)
-    return create_engine_server(server, host, port)
+    return create_engine_server(server, host, port, ssl_context=ssl_context)

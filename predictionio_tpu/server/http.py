@@ -63,6 +63,26 @@ def json_response(body: Any, status: int = 200) -> Response:
     return Response(status=status, body=body)
 
 
+def ssl_context_from(cert_path: Optional[str] = None,
+                     key_path: Optional[str] = None):
+    """Build a server SSLContext from PEM files; falls back to the
+    ``PIO_SSL_CERT``/``PIO_SSL_KEY`` env vars; None when unconfigured
+    (the reference's keystore-driven SSLConfiguration, PEM-based)."""
+    import os
+    import ssl
+
+    cert = cert_path or os.environ.get("PIO_SSL_CERT")
+    key = key_path or os.environ.get("PIO_SSL_KEY")
+    if not cert:
+        if key:
+            raise ValueError("SSL key configured without a certificate; "
+                             "set both or neither")
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key or None)
+    return ctx
+
+
 Handler = Callable[[Request], Response]
 
 
@@ -141,9 +161,15 @@ class AppServer:
     """Owns a ``ThreadingHTTPServer`` for one :class:`HTTPApp`; start in a
     daemon thread (tests, embedded) or serve on the main thread (CLI)."""
 
-    def __init__(self, app: HTTPApp, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, app: HTTPApp, host: str = "0.0.0.0", port: int = 0,
+                 ssl_context=None):
         handler = type("BoundHandler", (_Handler,), {"app": app})
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        if ssl_context is not None:
+            # HTTPS (the reference's JKS SSLConfiguration,
+            # common/.../SSLConfiguration.scala:26-58, PEM-based here)
+            self.httpd.socket = ssl_context.wrap_socket(
+                self.httpd.socket, server_side=True)
         self.app = app
         self._thread: Optional[threading.Thread] = None
 

@@ -1,0 +1,147 @@
+"""Deprecated batch views (C22), SSL (C26), pypio bridge (C27), and the
+`run` CLI command."""
+
+import json
+import warnings
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import Context
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+@pytest.fixture()
+def seeded():
+    storage = Storage(env=MEM_ENV)
+    app_id = storage.apps().insert(App(0, "viewapp"))
+    storage.events().init(app_id)
+    storage.events().insert_batch([
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"a": 1, "b": 2}), event_time=T0),
+        Event(event="$unset", entity_type="user", entity_id="u1",
+              properties=DataMap({"b": None}),
+              event_time=T0 + timedelta(hours=1)),
+        Event(event="$set", entity_type="user", entity_id="u2",
+              properties=DataMap({"a": 5}), event_time=T0),
+        Event(event="$delete", entity_type="user", entity_id="u2",
+              event_time=T0 + timedelta(hours=2)),
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=T0 + timedelta(hours=3)),
+    ], app_id)
+    return Context(app_name="viewapp", _storage=storage)
+
+
+class TestBatchViews:
+    def test_batch_view_aggregate(self, seeded):
+        from predictionio_tpu.data.view import BatchView
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            view = BatchView(seeded, "viewapp")
+        props = view.aggregate_properties("user")
+        assert set(props) == {"u1"}  # u2 deleted
+        assert props["u1"].to_dict() == {"a": 1}
+
+    def test_event_seq_filter_and_fold(self, seeded):
+        from predictionio_tpu.data.view import EventSeq
+
+        events = EventSeq(seeded.event_store.find("viewapp"))
+        assert len(events.filter(event="view")) == 1
+        assert len(events.filter(entity_type="user")) == 5
+        assert len(events.filter(
+            start_time=T0 + timedelta(hours=1))) == 3
+        counts = events.aggregate_by_entity_ordered(
+            0, lambda acc, e: acc + 1)
+        assert counts == {"u1": 3, "u2": 2}
+
+    def test_deprecation_warning(self, seeded):
+        from predictionio_tpu.data.view import BatchView
+
+        with pytest.warns(DeprecationWarning):
+            BatchView(seeded, "viewapp")
+
+
+class TestSSL:
+    def test_https_server(self, tmp_path):
+        import ssl
+        import subprocess
+        import urllib.request
+
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-nodes", "-subj", "/CN=localhost"],
+            check=True, capture_output=True)
+
+        from predictionio_tpu.server.adminserver import build_app
+        from predictionio_tpu.server.http import (
+            AppServer,
+            ssl_context_from,
+        )
+
+        ctx = ssl_context_from(str(cert), str(key))
+        assert ctx is not None
+        srv = AppServer(build_app(Storage(env=MEM_ENV)),
+                        host="127.0.0.1", port=0, ssl_context=ctx)
+        srv.start_background()
+        try:
+            client_ctx = ssl.create_default_context()
+            client_ctx.check_hostname = False
+            client_ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                    f"https://127.0.0.1:{srv.port}/",
+                    context=client_ctx, timeout=5) as resp:
+                assert json.loads(resp.read())["status"] == "alive"
+        finally:
+            srv.shutdown()
+
+    def test_unconfigured_returns_none(self, monkeypatch):
+        from predictionio_tpu.server.http import ssl_context_from
+
+        monkeypatch.delenv("PIO_SSL_CERT", raising=False)
+        assert ssl_context_from() is None
+
+
+class TestPypio:
+    def test_find_and_columns(self, seeded):
+        from predictionio_tpu.data.store import EventStoreFacade
+        from predictionio_tpu.pypio import PEventStore, events_to_columns
+
+        store = PEventStore(EventStoreFacade(seeded.storage))
+        rows = store.find("viewapp", event_names=["view"])
+        assert len(rows) == 1
+        props = store.aggregate_properties("viewapp", "user")
+        assert set(props) == {"u1"}
+        cols = events_to_columns(rows)
+        assert cols["entityId"].tolist() == ["u1"]
+        assert cols["eventTime"].dtype == np.int64
+
+
+def _run_target(storage_marker):
+    return f"ran:{storage_marker}"
+
+
+class TestRunCommand:
+    def test_run_invokes_callable(self, capsys):
+        from predictionio_tpu.cli import main
+
+        storage = Storage(env=MEM_ENV)
+        rc = main(["run", "tests.test_compat_layers:_run_target", "xyz"],
+                  storage=storage)
+        assert rc == 0
+        assert "ran:xyz" in capsys.readouterr().out
