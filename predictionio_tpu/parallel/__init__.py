@@ -1,5 +1,13 @@
 """Parallelism layer: meshes, shardings, collective helpers."""
 
+from .collectives import (
+    all_gather,
+    all_reduce_sum,
+    reduce_scatter,
+    ring_permute,
+    sharded,
+    sharded_top_k,
+)
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -10,8 +18,24 @@ from .mesh import (
     replicated,
     single_device_mesh,
 )
+from .multihost import (
+    from_process_local,
+    global_mesh,
+    host_shard,
+    initialize_distributed,
+)
 
 __all__ = [
+    "all_gather",
+    "all_reduce_sum",
+    "reduce_scatter",
+    "ring_permute",
+    "sharded",
+    "sharded_top_k",
+    "from_process_local",
+    "global_mesh",
+    "host_shard",
+    "initialize_distributed",
     "DATA_AXIS",
     "MODEL_AXIS",
     "data_sharding",

@@ -1,0 +1,82 @@
+"""Multi-host (multi-slice) initialization and data feeding.
+
+The reference scaled out by letting Spark place executors across a
+cluster; the TPU-native equivalent is JAX multi-controller: every host
+runs the same program, ``jax.distributed.initialize`` wires the hosts
+into one system (ICI within a slice, DCN across slices), and each host
+feeds its local shard of the global batch
+(``jax.make_array_from_process_local_data``). SURVEY §2.3's
+"host-side sharded scan → per-host feeding" lands here.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Join the multi-host system (no-op when single-process).
+
+    Arguments fall back to ``PIO_COORDINATOR`` / ``PIO_NUM_PROCESSES`` /
+    ``PIO_PROCESS_ID`` env vars; on TPU pods the platform usually
+    auto-detects everything, so bare ``initialize_distributed()`` is
+    enough there.
+    """
+    import jax
+
+    coordinator = coordinator_address or os.environ.get("PIO_COORDINATOR")
+    n = num_processes if num_processes is not None else \
+        int(os.environ.get("PIO_NUM_PROCESSES", "0")) or None
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PIO_PROCESS_ID", "-1"))
+    if coordinator is None and n is None:
+        # single-process or TPU-pod auto-detect path
+        try:
+            jax.distributed.initialize()
+        except Exception as e:  # noqa: BLE001 — single-host fallback
+            log.debug("distributed auto-init unavailable (%s); "
+                      "continuing single-process", e)
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n,
+                               process_id=pid if pid >= 0 else None)
+
+
+def global_mesh(data: Optional[int] = None, model: int = 1):
+    """A mesh over ALL processes' devices (``jax.devices()`` is global
+    after ``initialize_distributed``)."""
+    from .mesh import make_mesh
+
+    return make_mesh(data=data, model=model)
+
+
+def host_shard(array: np.ndarray, *, axis: int = 0) -> np.ndarray:
+    """This process's contiguous slice of a host-global array — what the
+    local event-store scan should yield before device feeding."""
+    import jax
+
+    n = jax.process_count()
+    i = jax.process_index()
+    size = array.shape[axis]
+    per = (size + n - 1) // n
+    start = min(i * per, size)
+    stop = min(start + per, size)
+    return np.take(array, np.arange(start, stop), axis=axis)
+
+
+def from_process_local(local: np.ndarray, mesh, spec) -> "object":
+    """Assemble a global sharded ``jax.Array`` from per-host shards
+    (``jax.make_array_from_process_local_data``)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local)

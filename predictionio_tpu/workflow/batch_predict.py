@@ -21,6 +21,41 @@ from ..data.storage.base import EngineInstance
 from ..utils.jsonutil import from_jsonable, to_jsonable
 
 
+def predict_serve_batch(algorithms: List[Any], models: List[Any],
+                        serving: Any, queries: List[Any]) -> List[Any]:
+    """The batched serving pipeline shared by the engine server's
+    micro-batcher and the batch-predict job: supplement each query, ONE
+    ``batch_predict`` device dispatch per algorithm, then serve per
+    query. Per-query failures (supplement/serve) come back as the raised
+    exception in that query's slot; a ``batch_predict`` failure fills
+    every live slot (it is one dispatch)."""
+    out: List[Any] = [None] * len(queries)
+    supplemented: List[Any] = []
+    live: List[int] = []
+    for i, q in enumerate(queries):
+        try:
+            supplemented.append(serving.supplement(q))
+            live.append(i)
+        except Exception as e:  # noqa: BLE001 — isolate to this query
+            out[i] = e
+    if live:
+        try:
+            per_algo = [a.batch_predict(m, supplemented)
+                        for a, m in zip(algorithms, models)]
+        except Exception as e:  # noqa: BLE001 — one dispatch, whole batch
+            for i in live:
+                out[i] = e
+            return out
+        for row, i in enumerate(live):
+            try:
+                # serve sees the original query (CreateServer.scala:511)
+                out[i] = serving.serve(queries[i],
+                                       [preds[row] for preds in per_algo])
+            except Exception as e:  # noqa: BLE001
+                out[i] = e
+    return out
+
+
 def batch_predict_lines(engine: Engine,
                         engine_params: EngineParams, models: List[Any],
                         query_lines: Iterable[str],
@@ -32,11 +67,10 @@ def batch_predict_lines(engine: Engine,
 
     def flush(raw_batch: List[Any]) -> Iterator[str]:
         queries = [from_jsonable(query_cls, q) for q in raw_batch]
-        supplemented = [serving.supplement(q) for q in queries]
-        per_algo = [a.batch_predict(m, supplemented)
-                    for a, m in zip(algorithms, models)]
-        for i, q in enumerate(queries):
-            prediction = serving.serve(q, [preds[i] for preds in per_algo])
+        results = predict_serve_batch(algorithms, models, serving, queries)
+        for i, prediction in enumerate(results):
+            if isinstance(prediction, Exception):
+                raise prediction  # a batch job fails loudly
             yield json.dumps({"query": to_jsonable(raw_batch[i]),
                               "prediction": to_jsonable(prediction)})
 
