@@ -59,6 +59,16 @@ class ALSParams:
     max_history: Optional[int] = None  # cap padded history length
     scale_reg_by_count: bool = True    # ALS-WR λ·n_u scaling (MLlib parity)
     block_rows: Optional[int] = None   # per-device rows per update block
+    #: "bfloat16" runs the normal-equation einsums on the MXU in bf16
+    #: with f32 accumulation (the TPU-native mixed-precision idiom);
+    #: factors and solves stay f32.
+    matmul_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.matmul_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"matmul_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.matmul_dtype!r}")
 
 
 @jax.tree_util.register_dataclass
@@ -94,10 +104,12 @@ class RatingsCOO:
     n_items: int
 
 
-@functools.partial(jax.jit, static_argnames=("implicit", "scale_reg"))
+@functools.partial(jax.jit, static_argnames=("implicit", "scale_reg",
+                                             "bf16"))
 def _update_block(fixed: jax.Array, G, indices: jax.Array,
                   values: jax.Array, counts: jax.Array, reg: float,
-                  alpha: float, implicit: bool, scale_reg: bool) -> jax.Array:
+                  alpha: float, implicit: bool, scale_reg: bool,
+                  bf16: bool = False) -> jax.Array:
     """Recompute one block of rows, holding ``fixed`` constant.
 
     fixed: [m, r] (flat, row-sharded); G: [r, r] Gramian of ``fixed`` (only
@@ -111,15 +123,25 @@ def _update_block(fixed: jax.Array, G, indices: jax.Array,
              < counts[:, :, None]).astype(jnp.float32)
     F = fixed[indices]  # [d, B, L, r] — cross-shard gather under a mesh
 
+    def outer(Fm, w):
+        """Σ_l w·f fᵀ and Σ_l w·f, on the MXU (optionally bf16 inputs
+        with f32 accumulation — the TPU mixed-precision idiom)."""
+        if bf16:
+            Fw = (Fm * w[..., None]).astype(jnp.bfloat16)
+            Fc = Fm.astype(jnp.bfloat16)
+            return jnp.einsum("dnlr,dnls->dnrs", Fw, Fc,
+                              preferred_element_type=jnp.float32)
+        return jnp.einsum("dnlr,dnls,dnl->dnrs", Fm, Fm, w)
+
     if implicit:
         # Hu-Koren-Volinsky: c = 1 + alpha·r, preference p=1 on observed.
         # A = G + Σ (c-1)·f fᵀ (G = FᵀF baseline over *all* items),
         # b = Σ c·f on observed entries.
         c1 = alpha * values * valid              # c - 1, 0 at padding
-        A = G[None, None] + jnp.einsum("dnlr,dnls,dnl->dnrs", F, F, c1)
+        A = G[None, None] + outer(F, c1)
         b = jnp.einsum("dnlr,dnl->dnr", F, (c1 + 1.0) * valid)
     else:
-        A = jnp.einsum("dnlr,dnls,dnl->dnrs", F, F, valid)
+        A = outer(F, valid)
         b = jnp.einsum("dnlr,dnl->dnr", F, values * valid)
 
     reg_n = reg * jnp.maximum(counts.astype(jnp.float32), 1.0) if scale_reg \
@@ -145,7 +167,8 @@ def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
         blocks.append(_update_block(
             fixed, G, indices[:, s:e], values[:, s:e], counts[:, s:e],
             params.reg, params.alpha, params.implicit_prefs,
-            params.scale_reg_by_count))
+            params.scale_reg_by_count,
+            bf16=(params.matmul_dtype == "bfloat16")))
     out = blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=1)
     return out.reshape(d * n_per, out.shape[-1])
 
@@ -227,7 +250,10 @@ def pack_ratings(ratings: RatingsCOO, params: ALSParams,
 def train_als(ratings: RatingsCOO, params: ALSParams,
               mesh: Optional[Mesh] = None,
               packed: Optional[Tuple[PaddedHistories, PaddedHistories]]
-              = None) -> Tuple[jax.Array, jax.Array]:
+              = None,
+              checkpoint_dir: Optional[str] = None,
+              checkpoint_every: int = 0
+              ) -> Tuple[jax.Array, jax.Array]:
     """Run ALS; returns (user_factors, item_factors) with padded rows.
 
     Under a mesh, factor matrices and histories are row-sharded over all
@@ -235,6 +261,11 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     (Gramian all-reduce, cross-shard factor gathers) XLA derives from the
     shardings. ``packed`` (from :func:`pack_ratings` with the SAME params
     + mesh) skips history packing.
+
+    With ``checkpoint_dir``, factors are checkpointed every
+    ``checkpoint_every`` iterations and a restarted call resumes from
+    the latest saved iteration (step-level resume, SURVEY §5 — the
+    reference restarts training from scratch after any failure).
     """
     n_dev = 1 if mesh is None else mesh.devices.size
     user_h, item_h = packed if packed is not None else pack_ratings(
@@ -253,9 +284,49 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     bi = params.block_rows or _auto_block_rows(
         item_h.n_rows // n_dev, item_h.max_len, params.rank)
 
-    for _ in range(params.num_iterations):
+    ckpt = None
+    start = 0
+    fingerprint = ""
+    if checkpoint_dir:
+        import hashlib
+        import json as _json
+
+        from ..workflow.checkpoint import Checkpointer
+
+        if checkpoint_every <= 0:
+            checkpoint_every = 1  # a checkpoint dir implies checkpointing
+        # refuse to resume from a different problem/params: fingerprint
+        # everything that determines the factor trajectory
+        fingerprint = hashlib.sha256(_json.dumps([
+            params.rank, params.reg, params.alpha, params.implicit_prefs,
+            params.seed, params.scale_reg_by_count, params.matmul_dtype,
+            ratings.n_users, ratings.n_items, len(ratings.users),
+        ]).encode()).hexdigest()[:16]
+        ckpt = Checkpointer(checkpoint_dir)
+        meta = ckpt.get_metadata()
+        if meta is not None and meta.get("fingerprint") != fingerprint:
+            raise ValueError(
+                f"checkpoint dir {checkpoint_dir} belongs to a different "
+                f"ALS run (params/dataset mismatch); use a fresh dir")
+        ckpt.set_metadata({"fingerprint": fingerprint})
+        # resume from the largest step within this run's iteration budget
+        steps = [s for s in ckpt.all_steps()
+                 if s <= params.num_iterations]
+        if steps:
+            latest = max(steps)
+            state = ckpt.restore(latest, like={"U": U, "V": V})
+            U = _shard(state["U"], mesh, ROWS)
+            V = _shard(state["V"], mesh, ROWS)
+            start = int(latest)
+
+    for it in range(start, params.num_iterations):
         U = _update_side(V, uh["idx"], uh["val"], uh["cnt"], params, bu)
         V = _update_side(U, ih["idx"], ih["val"], ih["cnt"], params, bi)
+        if ckpt is not None:
+            ckpt.maybe_save(it + 1, {"U": U, "V": V},
+                            every=checkpoint_every)
+    if ckpt is not None:
+        ckpt.close()
     return U, V
 
 

@@ -220,3 +220,42 @@ class TestRecommend:
         assert np.asarray(V).shape[0] >= 16  # actually padded
         ids, _ = recommend_products(model, 0, 10)
         assert ids.max() < 10
+
+
+class TestBF16MatmulPath:
+    def test_bf16_preserves_preference_structure(self):
+        """bfloat16 MXU einsums (f32 accumulation) must not degrade the
+        learned preference structure."""
+        users, items, vals = [], [], []
+        rng = np.random.default_rng(0)
+        for u in range(30):
+            liked = rng.choice(10, size=5, replace=False) if u % 2 == 0 \
+                else rng.choice(np.arange(10, 20), size=5, replace=False)
+            for i in liked:
+                users.append(u)
+                items.append(i)
+                vals.append(1.0)
+        ratings = RatingsCOO(np.array(users, np.int32),
+                             np.array(items, np.int32),
+                             np.array(vals, np.float32), 30, 20)
+        params = ALSParams(rank=8, num_iterations=10, reg=0.01, alpha=40.0,
+                           implicit_prefs=True, seed=1,
+                           matmul_dtype="bfloat16")
+        U, V = train_als(ratings, params)
+        pred = np.asarray(U)[:30] @ np.asarray(V)[:20].T
+        even_pref = pred[0::2, :10].mean() - pred[0::2, 10:].mean()
+        odd_pref = pred[1::2, 10:].mean() - pred[1::2, :10].mean()
+        assert even_pref > 0.3
+        assert odd_pref > 0.3
+
+    def test_bf16_close_to_f32_explicit(self):
+        ratings, _, _ = make_synthetic(seed=3)
+        f32 = ALSParams(rank=4, num_iterations=6, reg=0.05, seed=2)
+        b16 = ALSParams(rank=4, num_iterations=6, reg=0.05, seed=2,
+                        matmul_dtype="bfloat16")
+        U1, V1 = train_als(ratings, f32)
+        U2, V2 = train_als(ratings, b16)
+        p1 = np.asarray(U1) @ np.asarray(V1).T
+        p2 = np.asarray(U2) @ np.asarray(V2).T
+        # predictions agree to bf16-level tolerance
+        assert np.abs(p1 - p2).mean() < 0.05 * max(np.abs(p1).mean(), 1.0)

@@ -1,0 +1,112 @@
+"""Step-level checkpoint/resume (Checkpointer + ALS resume)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.als import ALSParams, RatingsCOO, train_als
+from predictionio_tpu.workflow.checkpoint import Checkpointer
+
+
+def ratings_fixture():
+    rng = np.random.default_rng(4)
+    nnz = 800
+    return RatingsCOO(
+        users=rng.integers(0, 30, nnz).astype(np.int32),
+        items=rng.integers(0, 20, nnz).astype(np.int32),
+        ratings=rng.uniform(1, 5, nnz).astype(np.float32),
+        n_users=30, n_items=20)
+
+
+class TestCheckpointer:
+    def test_save_restore_latest(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        state = {"a": np.arange(5.0), "b": 3}
+        ckpt.save(2, state)
+        ckpt.save(4, {"a": np.arange(5.0) * 2, "b": 7})
+        assert ckpt.latest_step() == 4
+        got = ckpt.restore(4, like=state)
+        np.testing.assert_array_equal(np.asarray(got["a"]),
+                                      np.arange(5.0) * 2)
+        assert int(got["b"]) == 7
+        ckpt.close()
+
+    def test_maybe_save_cadence(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        assert not ckpt.maybe_save(1, {"x": 1}, every=2)
+        assert ckpt.maybe_save(2, {"x": 1}, every=2)
+        assert not ckpt.maybe_save(3, {"x": 1}, every=0)
+        assert ckpt.latest_step() == 2
+        ckpt.close()
+
+
+class TestALSResume:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        ratings = ratings_fixture()
+        base = ALSParams(rank=6, num_iterations=6, seed=2)
+
+        # uninterrupted reference run
+        U_ref, V_ref = train_als(ratings, base)
+
+        # interrupted: 3 iterations with checkpointing, then a fresh call
+        # (new process semantics) resumes from step 3 and finishes
+        ckdir = str(tmp_path / "als_ck")
+        train_als(ratings, ALSParams(rank=6, num_iterations=3, seed=2),
+                  checkpoint_dir=ckdir, checkpoint_every=1)
+        U2, V2 = train_als(ratings, base, checkpoint_dir=ckdir,
+                           checkpoint_every=1)
+
+        np.testing.assert_allclose(np.asarray(U_ref), np.asarray(U2),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(V_ref), np.asarray(V2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_completed_checkpoint_short_circuits(self, tmp_path):
+        ratings = ratings_fixture()
+        params = ALSParams(rank=4, num_iterations=2, seed=1)
+        ckdir = str(tmp_path / "als_done")
+        U1, V1 = train_als(ratings, params, checkpoint_dir=ckdir,
+                           checkpoint_every=1)
+        # re-run: latest step == num_iterations → no further updates
+        U2, V2 = train_als(ratings, params, checkpoint_dir=ckdir,
+                           checkpoint_every=1)
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U2),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(V1), np.asarray(V2),
+                                   rtol=1e-5)
+
+
+class TestCheckpointGuards:
+    def test_foreign_checkpoint_rejected(self, tmp_path):
+        ratings = ratings_fixture()
+        ckdir = str(tmp_path / "guard")
+        train_als(ratings, ALSParams(rank=4, num_iterations=2, seed=1),
+                  checkpoint_dir=ckdir)
+        with pytest.raises(ValueError, match="different ALS run"):
+            train_als(ratings, ALSParams(rank=6, num_iterations=2, seed=1),
+                      checkpoint_dir=ckdir)
+
+    def test_checkpoint_dir_without_every_still_saves(self, tmp_path):
+        ratings = ratings_fixture()
+        ckdir = str(tmp_path / "implied")
+        train_als(ratings, ALSParams(rank=4, num_iterations=3, seed=1),
+                  checkpoint_dir=ckdir)  # checkpoint_every defaults on
+        assert Checkpointer(ckdir).latest_step() == 3
+
+    def test_larger_step_than_budget_ignored(self, tmp_path):
+        ratings = ratings_fixture()
+        ckdir = str(tmp_path / "budget")
+        train_als(ratings, ALSParams(rank=4, num_iterations=5, seed=1),
+                  checkpoint_dir=ckdir)
+        # a shorter run must NOT return the 5-iteration factors
+        U3, V3 = train_als(ratings,
+                           ALSParams(rank=4, num_iterations=3, seed=1),
+                           checkpoint_dir=ckdir)
+        U3_ref, V3_ref = train_als(ratings,
+                                   ALSParams(rank=4, num_iterations=3,
+                                             seed=1))
+        np.testing.assert_allclose(np.asarray(U3), np.asarray(U3_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bad_matmul_dtype_rejected(self):
+        with pytest.raises(ValueError, match="matmul_dtype"):
+            ALSParams(matmul_dtype="bf16")
