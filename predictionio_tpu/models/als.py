@@ -300,6 +300,7 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
         fingerprint = hashlib.sha256(_json.dumps([
             params.rank, params.reg, params.alpha, params.implicit_prefs,
             params.seed, params.scale_reg_by_count, params.matmul_dtype,
+            params.max_history,  # affects history truncation → trajectory
             ratings.n_users, ratings.n_items, len(ratings.users),
         ]).encode()).hexdigest()[:16]
         ckpt = Checkpointer(checkpoint_dir)
@@ -319,14 +320,16 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             V = _shard(state["V"], mesh, ROWS)
             start = int(latest)
 
-    for it in range(start, params.num_iterations):
-        U = _update_side(V, uh["idx"], uh["val"], uh["cnt"], params, bu)
-        V = _update_side(U, ih["idx"], ih["val"], ih["cnt"], params, bi)
+    try:
+        for it in range(start, params.num_iterations):
+            U = _update_side(V, uh["idx"], uh["val"], uh["cnt"], params, bu)
+            V = _update_side(U, ih["idx"], ih["val"], ih["cnt"], params, bi)
+            if ckpt is not None:
+                ckpt.maybe_save(it + 1, {"U": U, "V": V},
+                                every=checkpoint_every)
+    finally:
         if ckpt is not None:
-            ckpt.maybe_save(it + 1, {"U": U, "V": V},
-                            every=checkpoint_every)
-    if ckpt is not None:
-        ckpt.close()
+            ckpt.close()
     return U, V
 
 

@@ -44,6 +44,19 @@ class Checkpointer:
                 self.directory,
                 options=ocp.CheckpointManagerOptions(max_to_keep=keep))
         except Exception as e:  # noqa: BLE001 — pickle fallback
+            # refuse a silent restart-from-0: if the directory already
+            # holds orbax-format steps (digit-named dirs), degrading to
+            # pickle would hide them and lose the resume guarantee
+            orbax_steps = [n for n in os.listdir(self.directory)
+                           if n.isdigit()
+                           and os.path.isdir(os.path.join(self.directory,
+                                                          n))]
+            if orbax_steps:
+                raise RuntimeError(
+                    f"{self.directory} holds orbax checkpoints (steps "
+                    f"{sorted(orbax_steps)}) but orbax is unavailable "
+                    f"({e}); fix the environment instead of silently "
+                    f"restarting from scratch")
             log.warning("orbax unavailable (%s); using pickle checkpoints",
                         e)
             self._ocp = None
@@ -51,8 +64,11 @@ class Checkpointer:
     # -- orbax path --------------------------------------------------------
     def save(self, step: int, state: Any) -> None:
         if self._mgr is not None:
-            self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+            # async: only wait for the PREVIOUS save before issuing this
+            # one, so writes overlap the next training step; close()
+            # drains the last one
             self._mgr.wait_until_finished()
+            self._mgr.save(step, args=self._ocp.args.StandardSave(state))
             return
         import pickle
 
@@ -114,6 +130,7 @@ class Checkpointer:
 
     def close(self) -> None:
         if self._mgr is not None:
+            self._mgr.wait_until_finished()
             self._mgr.close()
 
     # -- pickle fallback helpers -------------------------------------------

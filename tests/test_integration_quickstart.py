@@ -1,0 +1,177 @@
+"""Quickstart integration test — the reference's
+``tests/pio_tests/scenarios/quickstart_test.py:50`` flow driven through
+REAL subprocesses and HTTP: app new → event ingestion via the Event
+Server REST API → train → deploy → live queries → undeploy.
+
+Where the reference needed dockerized HBase/ES/postgres, the default
+SQLite backend under a temp PIO_HOME covers durability across the CLI
+process boundaries.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def cli_env(pio_home: Path) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PIO_HOME": str(pio_home),
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+    })
+    # a TPU plugin may override JAX_PLATFORMS; tests must not grab the chip
+    env.pop("PJRT_DEVICE", None)
+    return env
+
+
+def run_cli(pio_home: Path, *args, timeout=180):
+    return subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli", *args],
+        env=cli_env(pio_home), capture_output=True, text=True,
+        timeout=timeout, cwd=str(REPO))
+
+
+def http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    def parse(raw):
+        try:
+            return json.loads(raw or b"null")
+        except json.JSONDecodeError:
+            return raw.decode(errors="replace")  # e.g. HTML status pages
+
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, parse(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, parse(e.read())
+
+
+def wait_port(port, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            status, _ = http("GET", f"http://127.0.0.1:{port}/")
+            if status == 200:
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"nothing listening on {port}")
+
+
+@pytest.mark.integration
+def test_quickstart_end_to_end(tmp_path):
+    pio_home = tmp_path / "pio_home"
+    pio_home.mkdir()
+
+    # -- app new (CLI process #1) -----------------------------------------
+    out = run_cli(pio_home, "app", "new", "qsapp")
+    assert out.returncode == 0, out.stderr
+    access_key = next(l.split(":", 1)[1].strip()
+                      for l in out.stdout.splitlines()
+                      if l.startswith("Access Key:"))
+
+    # -- event server (long-lived process) + REST ingestion ----------------
+    es_port = 17091
+    es = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli", "eventserver",
+         "--ip", "127.0.0.1", "--port", str(es_port)],
+        env=cli_env(pio_home), cwd=str(REPO),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        wait_port(es_port)
+        rng = np.random.default_rng(6)
+        base = f"http://127.0.0.1:{es_port}"
+        # single-event endpoint
+        for u in range(16):
+            pool = range(0, 8) if u % 2 == 0 else range(8, 16)
+            for i in rng.choice(list(pool), size=4, replace=False):
+                status, body = http(
+                    "POST", f"{base}/events.json?accessKey={access_key}",
+                    {"event": "rate", "entityType": "user",
+                     "entityId": f"u{u}", "targetEntityType": "item",
+                     "targetEntityId": f"i{i}",
+                     "properties": {"rating": 5.0}})
+                assert status == 201, body
+        # batch endpoint (≤50 semantics)
+        batch = [{"event": "buy", "entityType": "user",
+                  "entityId": f"u{u}", "targetEntityType": "item",
+                  "targetEntityId": "i1"} for u in range(4)]
+        status, body = http(
+            "POST", f"{base}/batch/events.json?accessKey={access_key}",
+            batch)
+        assert status == 200 and len(body) == 4
+    finally:
+        es.terminate()
+        es.wait(timeout=10)
+
+    # -- build + train (CLI processes) -------------------------------------
+    variant = {
+        "id": "qs", "version": "1",
+        "engineFactory": "predictionio_tpu.templates.recommendation:"
+                         "recommendation_engine",
+        "datasource": {"params": {"app_name": "qsapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 8, "num_iterations": 5,
+                                   "seed": 2}}],
+    }
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(variant))
+    assert run_cli(pio_home, "build", "--engine-json",
+                   str(ej)).returncode == 0
+    out = run_cli(pio_home, "train", "--engine-json", str(ej))
+    assert out.returncode == 0, out.stderr
+    assert "Training completed" in out.stdout
+
+    # -- deploy (long-lived process) + live queries -------------------------
+    q_port = 17092
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli", "deploy",
+         "--engine-json", str(ej), "--ip", "127.0.0.1",
+         "--port", str(q_port)],
+        env=cli_env(pio_home), cwd=str(REPO),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        wait_port(q_port, timeout=90)  # model load + first compile
+        status, body = http("POST",
+                            f"http://127.0.0.1:{q_port}/queries.json",
+                            {"user": "u0", "num": 4})
+        assert status == 200 and len(body["itemScores"]) == 4
+        scores = [s["score"] for s in body["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+        status, _ = http("POST",
+                         f"http://127.0.0.1:{q_port}/queries.json",
+                         {"bogus": 1})
+        assert status == 400
+
+        # -- undeploy via CLI ----------------------------------------------
+        out = run_cli(pio_home, "undeploy", "--ip", "127.0.0.1",
+                      "--port", str(q_port))
+        assert out.returncode == 0, out.stderr
+        deadline = time.monotonic() + 15
+        stopped = False
+        while time.monotonic() < deadline:
+            try:
+                http("GET", f"http://127.0.0.1:{q_port}/status.json",
+                     timeout=2)
+                time.sleep(0.3)
+            except OSError:
+                stopped = True
+                break
+        assert stopped, "engine server still up after undeploy"
+    finally:
+        srv.terminate()
+        srv.wait(timeout=10)
