@@ -47,12 +47,14 @@ def params_from_json(params_cls: Optional[Type], obj: Mapping[str, Any]) -> Any:
     if params_cls is None:
         return dict(obj)
     if dataclasses.is_dataclass(params_cls):
-        names = {f.name for f in dataclasses.fields(params_cls)}
-        unknown = set(obj) - names
-        if unknown:
+        # same aliasing as the query boundary: camelCase and python-keyword
+        # fields (lambda → lambda_) accepted, unknown keys rejected
+        from ..utils.jsonutil import from_jsonable
+        try:
+            return from_jsonable(params_cls, obj)
+        except ValueError as e:
             raise ValueError(
-                f"unknown params for {params_cls.__name__}: {sorted(unknown)}")
-        return params_cls(**obj)
+                f"invalid params for {params_cls.__name__}: {e}")
     return params_cls(**obj)
 
 
@@ -112,7 +114,8 @@ def engine_params_from_variant(
 
     Accepts both shapes the reference accepts: ``{"params": {...}}`` and
     ``{"name": "...", "params": {...}}`` per slot; ``algorithms`` is a list
-    of named entries.
+    of named entries. Each ``*_cls`` may be a single params class or a
+    name → class map (for engines exposing named component variants).
     """
 
     def one(key, cls) -> Tuple[str, Any]:
@@ -120,6 +123,8 @@ def engine_params_from_variant(
         if node is None:
             return ("", None)
         name = node.get("name", "")
+        if isinstance(cls, Mapping):
+            cls = cls.get(name)
         return (name, params_from_json(cls, node.get("params", {})))
 
     algos: List[Tuple[str, Any]] = []

@@ -15,7 +15,7 @@ engine server speaks:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,8 +46,16 @@ from ..models.data import kfold_split, ratings_from_events
 
 @dataclass(frozen=True)
 class Query:
+    """``Query.scala``; ``black_list`` is the blacklist-items variant's
+    added field (``examples/scala-parallel-recommendation/blacklist-items/
+    src/main/scala/Engine.scala:26``)."""
     user: str
     num: int = 10
+    black_list: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        if self.black_list is not None:
+            object.__setattr__(self, "black_list", tuple(self.black_list))
 
 
 @dataclass(frozen=True)
@@ -87,6 +95,13 @@ class DataSourceParams:
     eval_query_num: int = 10     # N per eval query
     eval_rating_threshold: float = 2.0  # "relevant" cutoff for actuals
     seed: int = 3
+    #: event name → fixed rating (None ⇒ read the ``rating`` property).
+    #: Default replays the quickstart (rate + buy=4.0); the
+    #: reading-custom-events / train-with-view-event variants configure
+    #: e.g. {"like": 5.0, "dislike": 1.0} or {"view": 1.0} here instead
+    #: of editing the DataSource (``examples/scala-parallel-recommendation/
+    #: {reading-custom-events,train-with-view-event}/…/DataSource.scala:50``).
+    event_weights: Optional[Dict[str, Optional[float]]] = None
 
 
 @dataclass(frozen=True)
@@ -106,12 +121,14 @@ class RecommendationDataSource(DataSource):
         self.params = params
 
     def _read_ratings(self, ctx: Context):
+        weights = self.params.event_weights
         events = ctx.event_store.find(
             self.params.app_name or ctx.app_name,
             channel_name=self.params.channel_name,
             entity_type="user", target_entity_type="item",
-            event_names=["rate", "buy"])
-        return ratings_from_events(events)
+            event_names=(list(weights) if weights is not None
+                         else ["rate", "buy"]))
+        return ratings_from_events(events, event_weights=weights)
 
     def read_training(self, ctx: Context) -> TrainingData:
         ratings, user_ids, item_ids = self._read_ratings(ctx)
@@ -175,11 +192,18 @@ class ALSAlgorithm(Algorithm):
         uidx = model.user_ids.get(query.user) if model.user_ids else None
         if uidx is None:
             return PredictedResult()  # unknown user (reference returns empty)
-        ids, scores = recommend_products(model, int(uidx), query.num)
+        black = {model.item_ids[i] for i in (query.black_list or ())
+                 if i in model.item_ids}
+        # over-fetch by the blacklist size, then filter (the variant's
+        # recommendProductsWithFilter, blacklist-items ALSAlgorithm.scala:
+        # 102-104)
+        ids, scores = recommend_products(model, int(uidx),
+                                         query.num + len(black))
         inv = model.item_ids.inverse
+        out = [(int(i), float(s)) for i, s in zip(ids, scores)
+               if int(i) not in black][: query.num]
         return PredictedResult(tuple(
-            ItemScore(item=inv[int(i)], score=float(s))
-            for i, s in zip(ids, scores)))
+            ItemScore(item=inv[i], score=s) for i, s in out))
 
     def batch_predict(self, model: ALSModel, queries: Sequence[Query]
                       ) -> List[PredictedResult]:
@@ -192,15 +216,21 @@ class ALSAlgorithm(Algorithm):
         out: List[PredictedResult] = [PredictedResult()] * len(queries)
         if not known:
             return out
-        num = max(q.num for q in queries)
+        max_black = max((len(q.black_list or ()) for q in queries),
+                        default=0)
+        num = max(q.num for q in queries) + max_black
         idx = np.array([u for _, u in known], dtype=np.int64)
         ids, scores = recommend_batch(model, idx, num)
         inv = model.item_ids.inverse
         for row, (qi, _) in enumerate(known):
-            n = queries[qi].num
+            q = queries[qi]
+            black = {model.item_ids[i] for i in (q.black_list or ())
+                     if i in model.item_ids}
+            picked = [(int(i), float(s))
+                      for i, s in zip(ids[row], scores[row])
+                      if int(i) not in black][: q.num]
             out[qi] = PredictedResult(tuple(
-                ItemScore(item=inv[int(i)], score=float(s))
-                for i, s in zip(ids[row, :n], scores[row, :n])))
+                ItemScore(item=inv[i], score=s) for i, s in picked))
         return out
 
 
@@ -208,15 +238,88 @@ class RecommendationServing(FirstServing):
     pass
 
 
+@dataclass(frozen=True)
+class FileBlacklistServingParams:
+    """``ServingParams(filepath)`` of the customize-serving variant."""
+    filepath: str = ""
+
+
+class FileBlacklistServing(RecommendationServing):
+    """Drop items listed (one per line) in a file re-read per request —
+    the customize-serving variant (``examples/scala-parallel-
+    recommendation/customize-serving/src/main/scala/Serving.scala:28-44``)."""
+
+    def __init__(self, params: FileBlacklistServingParams
+                 = FileBlacklistServingParams()):
+        self.params = params
+
+    def serve(self, query: Query,
+              predictions) -> PredictedResult:
+        disabled = set()
+        if self.params.filepath:
+            with open(self.params.filepath, "r", encoding="utf-8") as f:
+                disabled = {line.strip() for line in f if line.strip()}
+        first = predictions[0]
+        return PredictedResult(tuple(
+            s for s in first.item_scores if s.item not in disabled))
+
+
+@dataclass(frozen=True)
+class ExcludeItemsPreparatorParams:
+    """The customize-data-prep variant's exclusion list: items read from
+    a file (one per line) or given inline are dropped before training
+    (``examples/scala-parallel-recommendation/customize-data-prep/src/
+    main/scala/Preparator.scala``)."""
+    filepath: str = ""
+    items: Tuple[str, ...] = ()
+
+
+class ExcludeItemsPreparator(IdentityPreparator):
+    def __init__(self, params: ExcludeItemsPreparatorParams
+                 = ExcludeItemsPreparatorParams()):
+        self.params = params
+
+    def prepare(self, ctx: Context, td: TrainingData) -> TrainingData:
+        excluded = set(self.params.items)
+        if self.params.filepath:
+            with open(self.params.filepath, "r", encoding="utf-8") as f:
+                excluded |= {line.strip() for line in f if line.strip()}
+        bad_idx = {td.item_ids[i] for i in excluded if i in td.item_ids}
+        if not bad_idx:
+            return td
+        # excluded items leave the model ENTIRELY (re-indexed out), so
+        # they can never be recommended — matching the reference, where a
+        # filtered item simply has no MLlib factor entry
+        from ..data.bimap import BiMap
+
+        new_item_ids = BiMap.string_int(
+            k for k in td.item_ids.keys() if k not in excluded)
+        remap = np.full(td.ratings.n_items, -1, dtype=np.int64)
+        for old_key, new_i in new_item_ids.items():
+            remap[td.item_ids[old_key]] = new_i
+        keep = ~np.isin(td.ratings.items, list(bad_idx))
+        return TrainingData(
+            RatingsCOO(td.ratings.users[keep],
+                       remap[td.ratings.items[keep]].astype(
+                           td.ratings.items.dtype),
+                       td.ratings.ratings[keep], td.ratings.n_users,
+                       len(new_item_ids)),
+            td.user_ids, new_item_ids)
+
+
 def recommendation_engine() -> Engine:
     """Engine factory (the template's ``EngineFactory`` object)."""
     return Engine(
         datasource_classes=RecommendationDataSource,
-        preparator_classes=IdentityPreparator,
+        preparator_classes={"": IdentityPreparator,
+                            "exclude": ExcludeItemsPreparator},
         algorithm_classes={"als": ALSAlgorithm, "": ALSAlgorithm},
-        serving_classes=RecommendationServing,
+        serving_classes={"": RecommendationServing,
+                         "fileblacklist": FileBlacklistServing},
         datasource_params_class=DataSourceParams,
+        preparator_params_class={"exclude": ExcludeItemsPreparatorParams},
         algorithm_params_classes={"als": ALSParams, "": ALSParams},
+        serving_params_class={"fileblacklist": FileBlacklistServingParams},
     )
 
 

@@ -246,7 +246,7 @@ class TestVariantParsing:
 
     def test_unknown_param_rejected(self):
         variant = {"datasource": {"params": {"nope": 1}}}
-        with pytest.raises(ValueError, match="unknown params"):
+        with pytest.raises(ValueError, match="unknown field"):
             make_engine().params_from_variant(variant)
 
     def test_simple_engine(self):

@@ -174,3 +174,118 @@ class TestEvaluationE2E:
         assert len(done) == 1
         assert "best variant" in done[0].evaluator_results
         assert done[0].evaluator_results_json
+
+
+class TestTemplateVariants:
+    """The reference's recommendation sub-examples (SURVEY §2.2 variants:
+    blacklist-items, customize-serving, customize-data-prep,
+    train-with-view-event / reading-custom-events)."""
+
+    def test_query_blacklist(self, seeded_ctx):
+        ctx = seeded_ctx
+        engine, ep = engine_and_params()
+        model = engine.train(ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        base = algo.predict(model, Query(user="u0", num=5))
+        banned = base.item_scores[0].item
+        filtered = algo.predict(model, Query(user="u0", num=5,
+                                             black_list=[banned]))
+        assert banned not in {s.item for s in filtered.item_scores}
+        assert len(filtered.item_scores) == 5
+        # batch path honors the same blacklist
+        batch = algo.batch_predict(model, [Query(user="u0", num=5,
+                                                 black_list=[banned])])
+        assert banned not in {s.item for s in batch[0].item_scores}
+
+    def test_file_blacklist_serving(self, seeded_ctx, tmp_path):
+        from predictionio_tpu.templates.recommendation import (
+            FileBlacklistServing,
+            FileBlacklistServingParams,
+        )
+
+        ctx = seeded_ctx
+        engine, ep = engine_and_params()
+        model = engine.train(ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        pred = algo.predict(model, Query(user="u0", num=5))
+        disabled = pred.item_scores[0].item
+        f = tmp_path / "disabled.txt"
+        f.write_text(disabled + "\n")
+        serving = FileBlacklistServing(
+            FileBlacklistServingParams(filepath=str(f)))
+        served = serving.serve(Query(user="u0", num=5), [pred])
+        assert disabled not in {s.item for s in served.item_scores}
+
+    def test_exclude_items_preparator(self, seeded_ctx):
+        from predictionio_tpu.controller.params import EngineParams
+        from predictionio_tpu.models.als import ALSParams
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams,
+            ExcludeItemsPreparatorParams,
+        )
+
+        ctx = seeded_ctx
+        engine = recommendation_engine()
+        ep = EngineParams(
+            datasource=("", DataSourceParams(app_name="mlapp")),
+            preparator=("exclude",
+                        ExcludeItemsPreparatorParams(items=("i0", "i1"))),
+            algorithms=[("als", ALSParams(rank=4, num_iterations=4,
+                                          seed=2))])
+        model = engine.train(ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        # excluded items leave the model entirely: they can NEVER be
+        # recommended, no matter the query size
+        pred = algo.predict(model, Query(user="u0", num=30))
+        returned = {s.item for s in pred.item_scores}
+        assert pred.item_scores
+        assert not ({"i0", "i1"} & returned), returned
+        assert "i0" not in model.item_ids and "i1" not in model.item_ids
+
+    def test_variant_json_configures_named_prep_and_serving(self,
+                                                            seeded_ctx,
+                                                            tmp_path):
+        """The examples/README workflow: named preparator/serving with
+        typed params straight from engine.json."""
+        disabled = tmp_path / "disabled.txt"
+        engine = recommendation_engine()
+        variant = {
+            "datasource": {"params": {"app_name": "mlapp"}},
+            "preparator": {"name": "exclude",
+                           "params": {"items": ["i3"]}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "num_iterations": 4,
+                                       "seed": 2}}],
+            "serving": {"name": "fileblacklist",
+                        "params": {"filepath": str(disabled)}},
+        }
+        ep = engine.params_from_variant(variant)
+        model = engine.train(seeded_ctx, ep).models[0]
+        algo = engine.make_algorithms(ep)[0]
+        serving = engine.make_serving(ep)
+        pred = algo.predict(model, Query(user="u0", num=5))
+        banned = pred.item_scores[0].item
+        disabled.write_text(banned + "\n")
+        served = serving.serve(Query(user="u0", num=5), [pred])
+        assert banned not in {s.item for s in served.item_scores}
+        assert "i3" not in model.item_ids  # excluded via variant JSON
+
+    def test_custom_event_weights(self, seeded_ctx):
+        """train-with-view-event shape: implicit ALS over a single custom
+        event with a fixed weight."""
+        from predictionio_tpu.controller.params import EngineParams
+        from predictionio_tpu.models.als import ALSParams
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams,
+        )
+
+        ctx = seeded_ctx
+        engine = recommendation_engine()
+        ep = EngineParams(
+            datasource=("", DataSourceParams(
+                app_name="mlapp", event_weights={"buy": 1.0})),
+            algorithms=[("als", ALSParams(rank=4, num_iterations=4,
+                                          implicit_prefs=True, alpha=10.0,
+                                          seed=2))])
+        result = engine.train(ctx, ep)
+        assert result.models[0].item_factors is not None
