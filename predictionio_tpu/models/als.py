@@ -359,12 +359,49 @@ def _compiled_k(k: int, n_items: int) -> int:
     return min(p, n_items)
 
 
+#: host-serving work budget in (batch × factor-matrix elements): under it,
+#: serving runs on the HOST (numpy dot + sort, microseconds) instead of
+#: paying a per-query device dispatch — SURVEY hard part 3: the reference
+#: served from an in-JVM BLAS dot, and a small catalog never justifies
+#: the dispatch (let alone a tunneled one). Large catalogs — or large
+#: coalesced micro-batches over mid-size catalogs — stay on the MXU,
+#: where the batched matmul wins.
+HOST_SERVE_WORK = 64 * 1024 * 1024
+
+
+def _host_topk(user_vecs: np.ndarray, item_factors: np.ndarray,
+               k: int, n_items: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact host mirror of the device path: descending score, ties to
+    the LOWEST item index (``lax.top_k`` semantics), so a model answers
+    identically whichever path serves it."""
+    scores = np.asarray(user_vecs) @ np.asarray(item_factors)[:n_items].T
+    k = min(k, n_items)
+    ids = np.empty((scores.shape[0], k), dtype=np.int64)
+    out = np.empty((scores.shape[0], k), dtype=scores.dtype)
+    idx_key = np.arange(n_items)
+    for b in range(scores.shape[0]):
+        order = np.lexsort((idx_key, -scores[b]))[:k]
+        ids[b] = order
+        out[b] = scores[b, order]
+    return ids, out
+
+
+def _serve_on_host(model: ALSModel, batch: int) -> bool:
+    return (isinstance(model.item_factors, np.ndarray)
+            and model.item_factors.size * max(batch, 1) <= HOST_SERVE_WORK)
+
+
 def recommend_products(model: ALSModel, user_index: int, k: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k (item_index, score) for one user — the
     ``ALSModel.recommendProducts`` role (``ALSAlgorithm.scala:95-109``).
     Like the reference, asking for more than the catalog returns the whole
     catalog ranked, never padded rows."""
+    if _serve_on_host(model, batch=1):
+        ids, scores = _host_topk(
+            np.asarray(model.user_factors)[user_index][None, :],
+            model.item_factors, k, model.n_items)
+        return ids[0], scores[0]
     k_dev = _compiled_k(k, model.n_items)
     scores, ids = _topk_scores(
         jnp.asarray(model.user_factors)[user_index][None, :],
@@ -375,7 +412,12 @@ def recommend_products(model: ALSModel, user_index: int, k: int
 
 def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
-    """Micro-batched top-k for many users (one device dispatch)."""
+    """Micro-batched top-k for many users (one device dispatch, or the
+    host path for small models + small batches)."""
+    if _serve_on_host(model, batch=len(user_indices)):
+        return _host_topk(
+            np.asarray(model.user_factors)[np.asarray(user_indices)],
+            model.item_factors, k, model.n_items)
     k_dev = _compiled_k(k, model.n_items)
     vecs = jnp.asarray(model.user_factors)[jnp.asarray(user_indices)]
     scores, ids = _topk_scores(vecs, jnp.asarray(model.item_factors),

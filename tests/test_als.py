@@ -259,3 +259,57 @@ class TestBF16MatmulPath:
         p2 = np.asarray(U2) @ np.asarray(V2).T
         # predictions agree to bf16-level tolerance
         assert np.abs(p1 - p2).mean() < 0.05 * max(np.abs(p1).mean(), 1.0)
+
+
+class TestHostServeParity:
+    def _model(self, n_items=40):
+        ratings, _, _ = make_synthetic(n_items=n_items, seed=5)
+        params = ALSParams(rank=4, num_iterations=5, reg=0.05, seed=2)
+        U, V = train_als(ratings, params)
+        from predictionio_tpu.models.als import ALSModel
+        return (ALSModel(user_factors=np.asarray(U),
+                         item_factors=np.asarray(V), n_users=60,
+                         n_items=n_items, user_ids=None, item_ids=None,
+                         params=params),
+                ALSModel(user_factors=U, item_factors=V, n_users=60,
+                         n_items=n_items, user_ids=None, item_ids=None,
+                         params=params))
+
+    def test_host_matches_device(self):
+        from predictionio_tpu.models.als import (
+            recommend_batch,
+            recommend_products,
+        )
+
+        host, dev = self._model()
+        for u in (0, 13, 42):
+            ih, sh = recommend_products(host, u, 7)
+            idv, sv = recommend_products(dev, u, 7)
+            assert list(np.asarray(ih)) == list(np.asarray(idv))
+            np.testing.assert_allclose(np.asarray(sh), np.asarray(sv),
+                                       rtol=1e-5)
+        bh = recommend_batch(host, np.array([0, 13]), 5)
+        bd = recommend_batch(dev, np.array([0, 13]), 5)
+        np.testing.assert_array_equal(np.asarray(bh[0]),
+                                      np.asarray(bd[0]))
+
+    def test_tie_break_lowest_index(self):
+        """Duplicate factor rows: host path must prefer the lowest item
+        index, like lax.top_k."""
+        from predictionio_tpu.models.als import _host_topk
+
+        V = np.ones((6, 4), dtype=np.float32)  # all items tie
+        u = np.ones((1, 4), dtype=np.float32)
+        ids, scores = _host_topk(u, V, k=3, n_items=6)
+        assert ids[0].tolist() == [0, 1, 2]
+
+    def test_work_gate_scales_with_batch(self):
+        from predictionio_tpu.models.als import (
+            HOST_SERVE_WORK,
+            _serve_on_host,
+        )
+
+        host, _ = self._model()
+        size = host.item_factors.size
+        assert _serve_on_host(host, batch=1)
+        assert not _serve_on_host(host, batch=HOST_SERVE_WORK // size + 1)

@@ -60,6 +60,14 @@ def http(method, url, body=None, timeout=10):
         return e.code, parse(e.read())
 
 
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def wait_port(port, timeout=30):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -85,7 +93,7 @@ def test_quickstart_end_to_end(tmp_path):
                       if l.startswith("Access Key:"))
 
     # -- event server (long-lived process) + REST ingestion ----------------
-    es_port = 17091
+    es_port = free_port()
     es = subprocess.Popen(
         [sys.executable, "-m", "predictionio_tpu.cli", "eventserver",
          "--ip", "127.0.0.1", "--port", str(es_port)],
@@ -137,7 +145,7 @@ def test_quickstart_end_to_end(tmp_path):
     assert "Training completed" in out.stdout
 
     # -- deploy (long-lived process) + live queries -------------------------
-    q_port = 17092
+    q_port = free_port()
     srv = subprocess.Popen(
         [sys.executable, "-m", "predictionio_tpu.cli", "deploy",
          "--engine-json", str(ej), "--ip", "127.0.0.1",
