@@ -1,0 +1,81 @@
+"""EntityMap: id-indexed entity data.
+
+Capability parity with ``data/.../storage/EntityMap.scala``
+(``EntityIdIxMap`` :28-66, ``EntityMap`` :69-…) and
+``PEvents.extractEntityMap`` (``storage/PEvents.scala:136-…``): a
+string-id ↔ dense-int indexation plus per-entity payloads extracted from
+aggregated properties — the host-side precursor to device-resident
+embedding/feature tables keyed by the same dense ids.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, Iterable, Optional, TypeVar
+
+from .bimap import BiMap
+from .datamap import PropertyMap
+
+A = TypeVar("A")
+
+
+class EntityIdIxMap:
+    """String id ↔ dense index (``EntityIdIxMap``)."""
+
+    def __init__(self, id_to_ix: BiMap):
+        self.id_to_ix = id_to_ix
+        self.ix_to_id = id_to_ix.inverse
+
+    @staticmethod
+    def from_keys(keys: Iterable[str]) -> "EntityIdIxMap":
+        return EntityIdIxMap(BiMap.string_int(keys))
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self.id_to_ix[key]
+        return self.ix_to_id[key]
+
+    def __contains__(self, key) -> bool:
+        return (key in self.id_to_ix if isinstance(key, str)
+                else key in self.ix_to_id)
+
+    def get(self, key, default=None):
+        return (self.id_to_ix.get(key, default) if isinstance(key, str)
+                else self.ix_to_id.get(key, default))
+
+    def to_map(self) -> Dict[str, int]:
+        return self.id_to_ix.to_dict()
+
+    def __len__(self) -> int:
+        return len(self.id_to_ix)
+
+    def take(self, n: int) -> "EntityIdIxMap":
+        keys = list(self.id_to_ix.keys())[:n]
+        return EntityIdIxMap(self.id_to_ix.take(keys))
+
+
+class EntityMap(EntityIdIxMap, Generic[A]):
+    """EntityIdIxMap + a payload per entity (``EntityMap[A]``)."""
+
+    def __init__(self, id_to_data: Dict[str, A],
+                 id_to_ix: Optional[BiMap] = None):
+        super().__init__(id_to_ix if id_to_ix is not None
+                         else BiMap.string_int(id_to_data.keys()))
+        self.id_to_data = dict(id_to_data)
+
+    def data(self, key) -> A:
+        if isinstance(key, str):
+            return self.id_to_data[key]
+        return self.id_to_data[self.ix_to_id[key]]
+
+
+def extract_entity_map(store, app_name: str, entity_type: str,
+                       extract: Callable[[PropertyMap], A],
+                       channel_name: Optional[str] = None,
+                       start_time=None, until_time=None,
+                       required=None) -> EntityMap[A]:
+    """``PEvents.extractEntityMap`` over the facade: aggregate an entity
+    type's properties and map each through ``extract``."""
+    props = store.aggregate_properties(
+        app_name, entity_type, channel_name=channel_name,
+        start_time=start_time, until_time=until_time, required=required)
+    return EntityMap({eid: extract(pm) for eid, pm in props.items()})

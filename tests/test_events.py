@@ -161,3 +161,47 @@ class TestBiMap:
         m = BiMap.string_int(["a", "b", "c"])
         t = m.take(["a", "c", "zz"])
         assert set(t.keys()) == {"a", "c"}
+
+
+class TestEntityMap:
+    def test_entity_id_ix_map(self):
+        from predictionio_tpu.data.entitymap import EntityIdIxMap
+
+        m = EntityIdIxMap.from_keys(["a", "b", "c"])
+        assert m["a"] == 0 and m[2] == "c"
+        assert "b" in m and 1 in m
+        assert m.get("zz") is None
+        assert len(m) == 3
+        assert m.take(2).to_map() == {"a": 0, "b": 1}
+
+    def test_entity_map_data(self):
+        from predictionio_tpu.data.entitymap import EntityMap
+
+        em = EntityMap({"u1": {"age": 30}, "u2": {"age": 40}})
+        assert em.data("u1") == {"age": 30}
+        assert em.data(em["u2"]) == {"age": 40}
+
+    def test_extract_entity_map(self):
+        from datetime import datetime, timezone
+
+        from predictionio_tpu.controller import Context
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.entitymap import extract_entity_map
+        from predictionio_tpu.data.storage import App, Storage
+
+        st = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+        aid = st.apps().insert(App(0, "em"))
+        st.events().init(aid)
+        t = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        st.events().insert_batch([
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"price": 9.5}), event_time=t),
+            Event(event="$set", entity_type="item", entity_id="i2",
+                  properties=DataMap({"price": 3.0}), event_time=t),
+        ], aid)
+        ctx = Context(app_name="em", _storage=st)
+        em = extract_entity_map(ctx.event_store, "em", "item",
+                                lambda pm: float(pm.get("price")))
+        assert em.data("i1") == 9.5
+        assert em.data(em["i2"]) == 3.0
+        assert len(em) == 2
