@@ -3,6 +3,7 @@
 from .datamap import DataMap, DataMapError, PropertyMap
 from .event import Event, EventValidationError, SPECIAL_EVENTS
 from .bimap import BiMap
+from .entitymap import EntityIdIxMap, EntityMap, extract_entity_map
 from .aggregation import (
     EventOp,
     aggregate_properties,
@@ -18,6 +19,9 @@ __all__ = [
     "EventValidationError",
     "SPECIAL_EVENTS",
     "BiMap",
+    "EntityIdIxMap",
+    "EntityMap",
+    "extract_entity_map",
     "EventOp",
     "aggregate_properties",
     "aggregate_properties_ordered",

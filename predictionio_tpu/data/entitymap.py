@@ -67,6 +67,13 @@ class EntityMap(EntityIdIxMap, Generic[A]):
             return self.id_to_data[key]
         return self.id_to_data[self.ix_to_id[key]]
 
+    def take(self, n: int) -> "EntityMap[A]":
+        """First-n entities WITH their payloads (the reference's
+        ``EntityMap.take`` override)."""
+        keys = list(self.id_to_ix.keys())[:n]
+        return EntityMap({k: self.id_to_data[k] for k in keys},
+                         self.id_to_ix.take(keys))
+
 
 def extract_entity_map(store, app_name: str, entity_type: str,
                        extract: Callable[[PropertyMap], A],

@@ -285,8 +285,15 @@ def build_app(server: QueryServer) -> HTTPApp:
     @app.route("POST", "/stop")
     def stop(req: Request) -> Response:
         _auth(req)
-        threading.Thread(target=lambda: app_server_ref[0].shutdown(),
-                         daemon=True).start()
+
+        def delayed_shutdown():
+            # grace period so THIS response flushes before the listener
+            # dies (otherwise the client sees a closed connection and
+            # `undeploy` reports failure for a stop that worked)
+            time.sleep(0.25)
+            app_server_ref[0].shutdown()
+
+        threading.Thread(target=delayed_shutdown, daemon=True).start()
         return json_response({"message": "Shutting down..."})
 
     @app.route("GET", "/plugins.json")

@@ -205,3 +205,11 @@ class TestEntityMap:
         assert em.data("i1") == 9.5
         assert em.data(em["i2"]) == 3.0
         assert len(em) == 2
+
+    def test_entity_map_take_keeps_data(self):
+        from predictionio_tpu.data import EntityMap
+
+        em = EntityMap({"a": 1, "b": 2, "c": 3})
+        sub = em.take(2)
+        assert isinstance(sub, EntityMap)
+        assert sub.data("a") == 1 and len(sub) == 2
