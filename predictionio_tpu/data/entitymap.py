@@ -48,9 +48,13 @@ class EntityIdIxMap:
     def __len__(self) -> int:
         return len(self.id_to_ix)
 
+    def _first_keys(self, n: int) -> list:
+        import itertools
+
+        return list(itertools.islice(self.id_to_ix.keys(), n))
+
     def take(self, n: int) -> "EntityIdIxMap":
-        keys = list(self.id_to_ix.keys())[:n]
-        return EntityIdIxMap(self.id_to_ix.take(keys))
+        return EntityIdIxMap(self.id_to_ix.take(self._first_keys(n)))
 
 
 class EntityMap(EntityIdIxMap, Generic[A]):
@@ -70,7 +74,7 @@ class EntityMap(EntityIdIxMap, Generic[A]):
     def take(self, n: int) -> "EntityMap[A]":
         """First-n entities WITH their payloads (the reference's
         ``EntityMap.take`` override)."""
-        keys = list(self.id_to_ix.keys())[:n]
+        keys = self._first_keys(n)
         return EntityMap({k: self.id_to_data[k] for k in keys},
                          self.id_to_ix.take(keys))
 

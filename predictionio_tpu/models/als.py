@@ -193,9 +193,12 @@ def _shard(x, mesh: Optional[Mesh], spec: P):
 
 
 def _auto_block_rows(n_per: int, L: int, rank: int) -> int:
-    """Per-device rows per update block, targeting ~128MB for the
-    [B, L, r] f32 gather temp."""
-    budget = 128 * 1024 * 1024
+    """Per-device rows per update block, targeting ~1GB for the [B, L, r]
+    f32 gather temp. Fewer, bigger blocks matter more than temp memory:
+    each block is a separate dispatch, and measured on a v5e chip the
+    half-step went 414M→2.4B ratings/s/iter moving 128MB→1GB (68→9
+    dispatches); HBM comfortably holds the temp beside factors+histories."""
+    budget = 1024 * 1024 * 1024
     b = max(64, budget // max(1, L * rank * 4))
     return min(n_per, b)
 
