@@ -246,6 +246,9 @@ def cmd_train(args, storage: Storage) -> int:
     variant = load_variant(args.engine_json)
     engine, engine_params = engine_from_variant(variant)
     ctx = _make_ctx(storage)
+    ctx = ctx.copy(skip_sanity_check=args.skip_sanity_check,
+                   stop_after_read=args.stop_after_read,
+                   stop_after_prepare=args.stop_after_prepare)
     instance_id = run_train(
         ctx, engine, engine_params,
         engine_id=args.engine_id or variant.get("id", "default"),
@@ -612,6 +615,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("train", help="train an engine")
     add_engine_flags(s)
+    s.add_argument("--skip-sanity-check", action="store_true")
+    s.add_argument("--stop-after-read", action="store_true")
+    s.add_argument("--stop-after-prepare", action="store_true")
 
     s = sub.add_parser("eval", help="run an evaluation")
     s.add_argument("evaluation",

@@ -255,3 +255,17 @@ gen = Gen()
                    "cli_eval_mod:gen") == 0
         out = capsys.readouterr().out
         assert "Precision@3" in out or "0." in out
+
+
+class TestTrainWorkflowFlags:
+    def test_stop_after_read(self, storage, tmp_path, capsys):
+        """--stop-after-read leaves the instance in INIT (reference
+        WorkflowParams semantics)."""
+        seed_ratings(storage, "flagapp")
+        ej = write_variant(tmp_path, "flagapp")
+        assert run(storage, "train", "--engine-json", ej,
+                   "--stop-after-read") == 0
+        from predictionio_tpu.data.storage.base import STATUS_COMPLETED
+        instances = storage.engine_instances().get_all()
+        assert instances
+        assert all(i.status != STATUS_COMPLETED for i in instances)
