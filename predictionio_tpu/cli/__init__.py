@@ -255,7 +255,12 @@ def cmd_train(args, storage: Storage) -> int:
         engine_version=args.engine_version or variant.get("version", "1"),
         engine_variant=args.engine_json,
         engine_factory=variant.get("engineFactory", ""))
-    _out(f"Training completed. Engine instance ID: {instance_id}")
+    if args.stop_after_read or args.stop_after_prepare:
+        stage = "read" if args.stop_after_read else "prepare"
+        _out(f"Workflow stopped after {stage} (instance {instance_id} "
+             f"left in INIT).")
+    else:
+        _out(f"Training completed. Engine instance ID: {instance_id}")
     return 0
 
 

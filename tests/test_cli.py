@@ -265,7 +265,23 @@ class TestTrainWorkflowFlags:
         ej = write_variant(tmp_path, "flagapp")
         assert run(storage, "train", "--engine-json", ej,
                    "--stop-after-read") == 0
-        from predictionio_tpu.data.storage.base import STATUS_COMPLETED
+        from predictionio_tpu.data.storage.base import STATUS_INIT
         instances = storage.engine_instances().get_all()
         assert instances
-        assert all(i.status != STATUS_COMPLETED for i in instances)
+        assert all(i.status == STATUS_INIT for i in instances)
+
+    def test_stop_after_prepare(self, storage, tmp_path):
+        seed_ratings(storage, "flagapp2")
+        ej = write_variant(tmp_path, "flagapp2")
+        assert run(storage, "train", "--engine-json", ej,
+                   "--stop-after-prepare") == 0
+        from predictionio_tpu.data.storage.base import STATUS_INIT
+        assert all(i.status == STATUS_INIT
+                   for i in storage.engine_instances().get_all())
+
+    def test_skip_sanity_check_trains(self, storage, tmp_path, capsys):
+        seed_ratings(storage, "flagapp3")
+        ej = write_variant(tmp_path, "flagapp3")
+        assert run(storage, "train", "--engine-json", ej,
+                   "--skip-sanity-check") == 0
+        assert "Training completed" in capsys.readouterr().out
