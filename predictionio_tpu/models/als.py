@@ -270,6 +270,10 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     the latest saved iteration (step-level resume, SURVEY §5 — the
     reference restarts training from scratch after any failure).
     """
+    if len(ratings.users) == 0 or ratings.n_users == 0 \
+            or ratings.n_items == 0:
+        raise ValueError("ALS requires a non-empty ratings matrix "
+                         "(0 entries/users/items given)")
     n_dev = 1 if mesh is None else mesh.devices.size
     user_h, item_h = packed if packed is not None else pack_ratings(
         ratings, params, mesh)

@@ -1,0 +1,89 @@
+"""Metric library semantics (reference MetricTest: Average/OptionAverage/
+Stdev/Sum/Zero over multi-fold eval data + ranking helpers; best-params
+selection is covered by tests/test_engine.py TestMetricEvaluator)."""
+
+import math
+
+import pytest
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+    ndcg_at_k,
+    precision_at_k,
+)
+
+
+class QMinusA(AverageMetric):
+    def calculate_point(self, ei, q, p, a):
+        return q - a
+
+
+class OptionalScore(OptionAverageMetric):
+    def calculate_point(self, ei, q, p, a):
+        return None if a is None else float(q)
+
+
+def folds(*points_per_fold):
+    """Build EvalData: each arg is a list of (q, p, a) tuples."""
+    return [(None, pts) for pts in points_per_fold]
+
+
+class TestMetricAggregation:
+    def test_average_across_folds(self):
+        # reference semantics: one global mean over the union of folds
+        data = folds([(4, 0, 1), (2, 0, 1)], [(9, 0, 3)])
+        assert QMinusA().calculate(data) == pytest.approx((3 + 1 + 6) / 3)
+
+    def test_option_average_excludes_none(self):
+        data = folds([(4, 0, 1), (2, 0, None), (6, 0, 1)])
+        # None point excluded from numerator AND denominator
+        assert OptionalScore().calculate(data) == pytest.approx(5.0)
+
+    def test_average_empty_is_nan(self):
+        assert math.isnan(QMinusA().calculate(folds([])))
+
+    def test_stdev_population(self):
+        class S(StdevMetric):
+            def calculate_point(self, ei, q, p, a):
+                return q
+
+        data = folds([(2, 0, 0), (4, 0, 0), (4, 0, 0), (4, 0, 0),
+                      (5, 0, 0), (5, 0, 0), (7, 0, 0), (9, 0, 0)])
+        assert S().calculate(data) == pytest.approx(2.0)  # classic example
+
+    def test_sum(self):
+        class S(SumMetric):
+            def calculate_point(self, ei, q, p, a):
+                return q
+
+        assert S().calculate(folds([(1, 0, 0)], [(2, 0, 0),
+                                                 (3, 0, 0)])) == 6.0
+
+    def test_zero(self):
+        assert ZeroMetric().calculate(folds([(1, 2, 3)])) == 0.0
+
+    def test_compare_ordering(self):
+        m = QMinusA()
+        assert m.compare(2.0, 1.0) > 0
+        assert m.compare(1.0, 2.0) < 0
+        assert m.compare(1.0, 1.0) == 0
+
+
+class TestRankingHelpers:
+    def test_precision_at_k(self):
+        assert precision_at_k(["a", "b", "c"], {"a", "c"}, 2) == 0.5
+        # denominator is min(k, |relevant|) — reference semantics
+        assert precision_at_k(["a", "b"], {"a"}, 3) == 1.0
+        assert precision_at_k(["a"], set(), 3) is None
+
+    def test_ndcg_at_k(self):
+        # perfect ranking → 1.0
+        assert ndcg_at_k(["a", "b"], {"a", "b"}, 2) == pytest.approx(1.0)
+        # relevant item at position 2 only
+        got = ndcg_at_k(["x", "a"], {"a"}, 2)
+        assert got == pytest.approx((1 / math.log2(3)) / 1.0)
+        assert ndcg_at_k(["x"], set(), 2) is None
