@@ -60,9 +60,9 @@ def main():
     params_run = ALSParams(rank=rank, num_iterations=iterations,
                            implicit_prefs=True, alpha=40.0, reg=0.01,
                            seed=3, max_history=256)
-    # best of 2 timed runs — the shared-tunnel TPU shows run-to-run noise
+    # best of 3 timed runs — the shared-tunnel TPU shows run-to-run noise
     dt = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.monotonic()
         U, V = train_als(ratings, params_run, packed=packed)
         jax.block_until_ready((U, V))

@@ -282,8 +282,6 @@ class TestTrainWorkflowFlags:
     def test_skip_sanity_check_trains(self, storage, tmp_path, capsys):
         """An app with no events fails the sanity check — unless the
         flag actually reaches the workflow."""
-        import pytest
-
         run(storage, "app", "new", "emptyapp")
         ej = write_variant(tmp_path, "emptyapp")
         with pytest.raises(ValueError, match="no ratings"):
@@ -293,3 +291,9 @@ class TestTrainWorkflowFlags:
         with pytest.raises(ValueError, match="non-empty ratings matrix"):
             run(storage, "train", "--engine-json", ej,
                 "--skip-sanity-check")
+        # success path: flag on a HEALTHY app still trains to COMPLETED
+        seed_ratings(storage, "flagok")
+        ej2 = write_variant(tmp_path, "flagok")
+        assert run(storage, "train", "--engine-json", ej2,
+                   "--skip-sanity-check") == 0
+        assert "Training completed" in capsys.readouterr().out

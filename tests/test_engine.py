@@ -254,3 +254,38 @@ class TestVariantParsing:
         r = se.train(Context(), EngineParams())
         assert r.models == [("model", TD(0), 0)]
         assert isinstance(se.make_serving(EngineParams()), FirstServing)
+
+
+class TestRetrainOnDeploy:
+    def test_none_persistent_model_retrains(self):
+        """An algorithm whose make_persistent_model returns None (the
+        reference's Unit-model semantics) must be retrained by
+        prepare_deploy (controller/Engine.scala:210-232)."""
+        calls = {"train": 0}
+
+        class EphemeralAlgo(Algo):
+            def make_persistent_model(self, model, iid, ax):
+                return None
+
+            def train(self, ctx, pd):
+                calls["train"] += 1
+                return super().train(ctx, pd)
+
+        engine = Engine(
+            datasource_classes=DS,
+            preparator_classes=Prep,
+            algorithm_classes={"a1": EphemeralAlgo},
+            serving_classes=ServeSum,
+            datasource_params_class=DSParams,
+            preparator_params_class=PParams,
+        )
+        params = ep()
+        ctx = Context()
+        result = engine.train(ctx, params)
+        algo = engine.make_algorithms(params)[0]
+        stored = algo.make_persistent_model(result.models[0], "iid", 0)
+        assert stored is None
+        trained_before = calls["train"]
+        models = engine.prepare_deploy(ctx, params, [None], "iid")
+        assert calls["train"] == trained_before + 1  # retrained
+        assert models[0] is not None
