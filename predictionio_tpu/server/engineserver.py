@@ -58,6 +58,10 @@ class ServerConfig:
     batching: bool = False
     batch_window_ms: float = 2.0   # max wait for a batch to fill
     max_batch: int = 64
+    #: POST query errors to this URL (``remoteLog``,
+    #: ``CreateServer.scala:435-446``); never fails the query.
+    log_url: Optional[str] = None
+    log_prefix: str = ""
 
 
 class QueryServer:
@@ -207,6 +211,35 @@ class QueryServer:
             result = dict(result, prId=pr_id)
         return result
 
+    def remote_log(self, message: str, wait: bool = False) -> None:
+        """Ship an error to the configured log collector
+        (``remoteLog``, ``CreateServer.scala:435-446``); failures to ship
+        are logged and swallowed. Ships from a daemon thread so a slow or
+        dead collector never delays the error response (pass ``wait=True``
+        to block, e.g. in tests)."""
+        if not self.config.log_url:
+            return
+        import urllib.request
+
+        payload = (self.config.log_prefix + json.dumps({
+            "engineInstance": self.instance.id,
+            "message": message})).encode("utf-8")
+
+        def ship():
+            try:
+                req = urllib.request.Request(self.config.log_url,
+                                             data=payload, method="POST")
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    resp.read()
+            except Exception as e:  # noqa: BLE001 — must not fail us
+                log.error("Unable to send remote log: %s", e)
+
+        if wait:
+            ship()
+        else:
+            threading.Thread(target=ship, daemon=True,
+                             name="remote-log").start()
+
     def reload(self) -> str:
         """Rebind to the latest COMPLETED instance
         (``MasterActor.receive`` :342-371)."""
@@ -268,12 +301,22 @@ def build_app(server: QueryServer) -> HTTPApp:
             query_json = req.json()
         except (ValueError, UnicodeDecodeError) as e:
             raise HTTPError(400, str(e))
-        if batcher is not None:
-            result = batcher.submit(query_json)
-            if isinstance(result, HTTPError):
-                raise result
-            return json_response(result)
-        return json_response(server.query(query_json))
+        try:
+            if batcher is not None:
+                result = batcher.submit(query_json)
+                if isinstance(result, HTTPError):
+                    raise result
+                return json_response(result)
+            return json_response(server.query(query_json))
+        except HTTPError as e:
+            # batch-wide failures are logged ONCE by the batcher, not by
+            # each of the coalesced handler threads
+            if e.status >= 500 and not getattr(e, "_remote_logged", False):
+                server.remote_log(e.message)
+            raise
+        except Exception as e:  # noqa: BLE001 — log then surface as 500
+            server.remote_log(str(e))
+            raise
 
     @app.route("POST", "/reload")
     def reload(req: Request) -> Response:
@@ -352,7 +395,10 @@ class MicroBatcher:
             try:
                 results = self.server.query_batch([b[0] for b in batch])
             except Exception as e:  # noqa: BLE001 — isolate to this batch
-                results = [HTTPError(500, str(e))] * len(batch)
+                self.server.remote_log(str(e))  # once for the whole batch
+                err = HTTPError(500, str(e))
+                err._remote_logged = True
+                results = [err] * len(batch)
             for (_, done, slot), result in zip(batch, results):
                 slot[0] = result
                 done.set()

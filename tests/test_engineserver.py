@@ -262,3 +262,64 @@ class TestBatchIsolation:
         assert isinstance(out[1], HTTPError) and out[1].status == 500
         assert isinstance(out[2], HTTPError) and out[2].status == 400
         assert len(out[3]["itemScores"]) == 2
+
+
+class TestRemoteLog:
+    def test_remote_log_ships_and_swallows(self, trained_ctx):
+        """remote_log POSTs {engineInstance, message} with the prefix
+        (CreateServer.scala remoteLog :435-446) and swallows collector
+        outages; 400s do not remote-log over HTTP."""
+        import json as _json
+
+        from predictionio_tpu.server.engineserver import QueryServer
+        from predictionio_tpu.server.http import (
+            AppServer,
+            HTTPApp,
+            Request,
+            json_response,
+        )
+        from predictionio_tpu.workflow import (
+            get_latest_completed,
+            load_models_for_deploy,
+        )
+
+        received = []
+        collector_app = HTTPApp("collector")
+
+        @collector_app.route("POST", "/log")
+        def log_sink(req: Request):
+            received.append(req.body.decode())
+            return json_response({"ok": True})
+
+        collector = AppServer(collector_app, "127.0.0.1", 0)
+        collector.start_background()
+        try:
+            ctx, engine, ep = trained_ctx
+            cfg = ServerConfig(
+                log_url=f"http://127.0.0.1:{collector.port}/log",
+                log_prefix="PIO: ")
+
+            # client errors do not remote-log
+            srv = deploy(ctx, engine, ep, engine_id="srv",
+                         engine_version="1", config=cfg,
+                         host="127.0.0.1", port=0)
+            srv.start_background()
+            try:
+                status, _ = call(srv.port, "POST", "/queries.json",
+                                 {"bogus": 1})
+                assert status == 400
+                assert not received
+            finally:
+                srv.shutdown()
+
+            inst = get_latest_completed(ctx, engine_id="srv")
+            models = load_models_for_deploy(ctx, engine, inst, ep)
+            qs = QueryServer(ctx, engine, ep, models, inst, cfg)
+            qs.remote_log("boom", wait=True)
+            assert received and received[-1].startswith("PIO: ")
+            body = _json.loads(received[-1][len("PIO: "):])
+            assert body["message"] == "boom"
+            assert body["engineInstance"] == inst.id
+        finally:
+            collector.shutdown()
+        qs.remote_log("after-shutdown", wait=True)  # down: must not raise
