@@ -381,9 +381,14 @@ def cmd_eventserver(args, storage: Storage) -> int:
 
 def cmd_adminserver(args, storage: Storage) -> int:
     from ..server.adminserver import create_admin_server
+    from ..server.http import ssl_context_from
 
-    server = create_admin_server(storage, host=args.ip, port=args.port)
-    _out(f"Admin server is listening at http://{args.ip}:{server.port}.")
+    server = create_admin_server(
+        storage, host=args.ip, port=args.port,
+        accesskey=args.accesskey or None,
+        ssl_context=ssl_context_from(args.cert or None, args.key or None))
+    scheme = "https" if args.cert else "http"
+    _out(f"Admin server is listening at {scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -393,9 +398,14 @@ def cmd_adminserver(args, storage: Storage) -> int:
 
 def cmd_dashboard(args, storage: Storage) -> int:
     from ..server.dashboard import create_dashboard
+    from ..server.http import ssl_context_from
 
-    server = create_dashboard(storage, host=args.ip, port=args.port)
-    _out(f"Dashboard is listening at http://{args.ip}:{server.port}.")
+    server = create_dashboard(
+        storage, host=args.ip, port=args.port,
+        accesskey=args.accesskey or None,
+        ssl_context=ssl_context_from(args.cert or None, args.key or None))
+    scheme = "https" if args.cert else "http"
+    _out(f"Dashboard is listening at {scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -663,10 +673,16 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("adminserver", help="start the admin API")
     s.add_argument("--ip", default="127.0.0.1")
     s.add_argument("--port", type=int, default=7071)
+    s.add_argument("--accesskey", default="")
+    s.add_argument("--cert", default="")
+    s.add_argument("--key", default="")
 
     s = sub.add_parser("dashboard", help="start the evaluation dashboard")
     s.add_argument("--ip", default="127.0.0.1")
     s.add_argument("--port", type=int, default=9000)
+    s.add_argument("--accesskey", default="")
+    s.add_argument("--cert", default="")
+    s.add_argument("--key", default="")
 
     sub.add_parser("status", help="check environment and storage")
 

@@ -14,14 +14,24 @@ from typing import Optional
 
 from ..data.storage.base import AccessKey, App
 from ..data.storage.registry import Storage, get_storage
-from .http import AppServer, HTTPApp, Request, Response, json_response
+from .http import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    json_response,
+    make_key_auth,
+)
 
 
-def build_app(storage: Optional[Storage] = None) -> HTTPApp:
+def build_app(storage: Optional[Storage] = None,
+              accesskey: Optional[str] = None) -> HTTPApp:
     app = HTTPApp("adminserver")
 
     def st() -> Storage:
         return storage if storage is not None else get_storage()
+
+    _auth = make_key_auth(accesskey)
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -29,6 +39,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
 
     @app.route("GET", "/cmd/app")
     def app_list(req: Request) -> Response:
+        _auth(req)
         s = st()
         apps = []
         for a in s.apps().get_all():
@@ -40,6 +51,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
 
     @app.route("POST", "/cmd/app")
     def app_new(req: Request) -> Response:
+        _auth(req)
         body = req.json() or {}
         name = body.get("name")
         if not name:
@@ -64,6 +76,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
 
     @app.route("DELETE", r"/cmd/app/(?P<name>[^/]+)/data")
     def app_data_delete(req: Request) -> Response:
+        _auth(req)
         s = st()
         a = s.apps().get_by_name(req.path_params["name"])
         if a is None:
@@ -79,6 +92,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
 
     @app.route("DELETE", r"/cmd/app/(?P<name>[^/]+)")
     def app_delete(req: Request) -> Response:
+        _auth(req)
         s = st()
         a = s.apps().get_by_name(req.path_params["name"])
         if a is None:
@@ -101,5 +115,8 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
 
 def create_admin_server(storage: Optional[Storage] = None,
                         host: str = "127.0.0.1",
-                        port: int = 7071) -> AppServer:
-    return AppServer(build_app(storage), host=host, port=port)
+                        port: int = 7071,
+                        accesskey: Optional[str] = None,
+                        ssl_context=None) -> AppServer:
+    return AppServer(build_app(storage, accesskey=accesskey), host=host,
+                     port=port, ssl_context=ssl_context)

@@ -14,18 +14,29 @@ from typing import Optional
 
 from ..data.event import utcnow
 from ..data.storage.registry import Storage, get_storage
-from .http import AppServer, HTTPApp, Request, Response, json_response
+from .http import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    json_response,
+    make_key_auth,
+)
 
 
-def build_app(storage: Optional[Storage] = None) -> HTTPApp:
+def build_app(storage: Optional[Storage] = None,
+              accesskey: Optional[str] = None) -> HTTPApp:
     app = HTTPApp("dashboard")
     start_time = utcnow()
 
     def st() -> Storage:
         return storage if storage is not None else get_storage()
 
+    _auth = make_key_auth(accesskey)
+
     @app.route("GET", "/")
     def index(req: Request) -> Response:
+        _auth(req)
         rows = []
         for i in st().evaluation_instances().get_completed():
             esc = _html.escape
@@ -57,6 +68,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"evaluator_results\.txt")
     def results_txt(req: Request) -> Response:
+        _auth(req)
         i = _instance(req)
         if i is None:
             return json_response({"message": "Not Found"}, 404)
@@ -66,6 +78,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"evaluator_results\.html")
     def results_html(req: Request) -> Response:
+        _auth(req)
         i = _instance(req)
         if i is None:
             return json_response({"message": "Not Found"}, 404)
@@ -75,6 +88,7 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"evaluator_results\.json")
     def results_json(req: Request) -> Response:
+        _auth(req)
         i = _instance(req)
         if i is None:
             return json_response({"message": "Not Found"}, 404)
@@ -92,5 +106,8 @@ def build_app(storage: Optional[Storage] = None) -> HTTPApp:
 
 
 def create_dashboard(storage: Optional[Storage] = None,
-                     host: str = "127.0.0.1", port: int = 9000) -> AppServer:
-    return AppServer(build_app(storage), host=host, port=port)
+                     host: str = "127.0.0.1", port: int = 9000,
+                     accesskey: Optional[str] = None,
+                     ssl_context=None) -> AppServer:
+    return AppServer(build_app(storage, accesskey=accesskey), host=host,
+                     port=port, ssl_context=ssl_context)

@@ -32,7 +32,15 @@ from ..controller.params import EngineParams
 from ..data.event import Event, utcnow
 from ..data.storage.base import EngineInstance
 from ..utils.jsonutil import from_jsonable, to_jsonable
-from .http import AppServer, HTTPApp, HTTPError, Request, Response, json_response
+from .http import (
+    AppServer,
+    HTTPApp,
+    HTTPError,
+    Request,
+    Response,
+    json_response,
+    make_key_auth,
+)
 from .plugins import EngineServerPlugins
 
 log = logging.getLogger(__name__)
@@ -264,9 +272,7 @@ def build_app(server: QueryServer) -> HTTPApp:
     batcher = (MicroBatcher(server, cfg.batch_window_ms, cfg.max_batch)
                if cfg.batching else None)
 
-    def _auth(req: Request) -> None:
-        if cfg.accesskey and req.query.get("accessKey") != cfg.accesskey:
-            raise HTTPError(401, "Invalid accessKey.")
+    _auth = make_key_auth(cfg.accesskey)
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -342,6 +348,29 @@ def build_app(server: QueryServer) -> HTTPApp:
     @app.route("GET", "/plugins.json")
     def plugins_json(req: Request) -> Response:
         return json_response({"plugins": server.plugins.describe()})
+
+    @app.route("GET", r"/plugins/(?P<ptype>[^/]+)/(?P<pname>[^/]+)"
+                      r"(?P<rest>(/[^/]+)*)")
+    def plugin_rest(req: Request) -> Response:
+        """Per-plugin REST surface (``CreateServer.scala:684-689``):
+        ``/plugins/<outputblockers|outputsniffers>/<name>/<args…>`` calls
+        the plugin's ``handle_rest`` with the remaining segments.
+        Key-guarded like the other control routes (plugins may expose
+        internal state)."""
+        _auth(req)
+        ptype = req.path_params["ptype"]
+        registry = {"outputblockers": server.plugins.output_blockers,
+                    "outputsniffers": server.plugins.output_sniffers}
+        plugins = registry.get(ptype)
+        if plugins is None:
+            raise HTTPError(404, f"unknown plugin type {ptype!r}")
+        plugin = plugins.get(req.path_params["pname"])
+        if plugin is None:
+            raise HTTPError(404,
+                            f"plugin {req.path_params['pname']!r} "
+                            f"not registered")
+        args = [seg for seg in req.path_params["rest"].split("/") if seg]
+        return json_response(plugin.handle_rest(args))
 
     app_server_ref: List[AppServer] = []
     app._server_ref = app_server_ref  # type: ignore[attr-defined]

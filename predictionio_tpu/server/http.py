@@ -63,6 +63,20 @@ def json_response(body: Any, status: int = 200) -> Response:
     return Response(status=status, body=body)
 
 
+def make_key_auth(accesskey: Optional[str]) -> Callable[["Request"], None]:
+    """Shared ``?accessKey=`` guard (the reference's KeyAuthentication,
+    ``common/.../KeyAuthentication.scala:33-58``): no-op when no key is
+    configured; constant-time comparison otherwise."""
+    import hmac
+
+    def _auth(req: "Request") -> None:
+        if accesskey and not hmac.compare_digest(
+                req.query.get("accessKey") or "", accesskey):
+            raise HTTPError(401, "Invalid accessKey.")
+
+    return _auth
+
+
 def ssl_context_from(cert_path: Optional[str] = None,
                      key_path: Optional[str] = None):
     """Build a server SSLContext from PEM files; falls back to the

@@ -297,3 +297,34 @@ class TestTrainWorkflowFlags:
         assert run(storage, "train", "--engine-json", ej2,
                    "--skip-sanity-check") == 0
         assert "Training completed" in capsys.readouterr().out
+
+
+class TestAdminDashboardAuth:
+    def test_admin_accesskey_guard(self, storage):
+        from predictionio_tpu.server.adminserver import build_app
+        from predictionio_tpu.server.http import Request
+
+        app = build_app(storage, accesskey="SECRET")
+
+        def call(path, query=None):
+            return app.handle(Request(method="GET", path=path,
+                                      query=query or {}, headers={},
+                                      body=b"")).status
+
+        assert call("/") == 200               # liveness stays open
+        assert call("/cmd/app") == 401
+        assert call("/cmd/app", {"accessKey": "SECRET"}) == 200
+
+    def test_dashboard_accesskey_guard(self, storage):
+        from predictionio_tpu.server.dashboard import build_app
+        from predictionio_tpu.server.http import Request
+
+        app = build_app(storage, accesskey="SECRET")
+
+        def call(path, query=None):
+            return app.handle(Request(method="GET", path=path,
+                                      query=query or {}, headers={},
+                                      body=b"")).status
+
+        assert call("/") == 401
+        assert call("/", {"accessKey": "SECRET"}) == 200

@@ -323,3 +323,51 @@ class TestRemoteLog:
         finally:
             collector.shutdown()
         qs.remote_log("after-shutdown", wait=True)  # down: must not raise
+
+
+class TestPluginREST:
+    def test_plugin_rest_route(self, trained_ctx):
+        """/plugins/<type>/<name>/<args…> dispatches to handle_rest
+        (CreateServer.scala:684-689)."""
+        from predictionio_tpu.server.engineserver import QueryServer
+        from predictionio_tpu.server.plugins import (
+            EngineServerPlugin,
+            EngineServerPlugins,
+        )
+        from predictionio_tpu.workflow import (
+            get_latest_completed,
+            load_models_for_deploy,
+        )
+        from predictionio_tpu.server.engineserver import (
+            create_engine_server,
+        )
+
+        class EchoPlugin(EngineServerPlugin):
+            plugin_name = "echo"
+            plugin_description = "echoes its REST args"
+
+            def process(self, query, prediction):
+                return prediction
+
+            def handle_rest(self, args):
+                return {"args": args}
+
+        ctx, engine, ep = trained_ctx
+        inst = get_latest_completed(ctx, engine_id="srv")
+        models = load_models_for_deploy(ctx, engine, inst, ep)
+        plugins = EngineServerPlugins()
+        plugins.register(EchoPlugin(), blocker=True)
+        qs = QueryServer(ctx, engine, ep, models, inst, plugins=plugins)
+        srv = create_engine_server(qs, "127.0.0.1", 0).start_background()
+        try:
+            status, body = call(srv.port, "GET",
+                                "/plugins/outputblockers/echo/a/b")
+            assert status == 200 and body == {"args": ["a", "b"]}
+            status, body = call(srv.port, "GET", "/plugins.json")
+            assert "echo" in body["plugins"]["outputblockers"]
+            assert call(srv.port, "GET",
+                        "/plugins/outputblockers/nope")[0] == 404
+            assert call(srv.port, "GET",
+                        "/plugins/badtype/echo")[0] == 404
+        finally:
+            srv.shutdown()
