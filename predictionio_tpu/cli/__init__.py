@@ -303,14 +303,14 @@ def cmd_deploy(args, storage: Storage) -> int:
         feedback=args.feedback,
         feedback_app_name=args.feedback_app_name or None,
         accesskey=args.accesskey or None)
+    ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
         engine_id=args.engine_id or variant.get("id", "default"),
         engine_version=args.engine_version or variant.get("version", "1"),
         engine_variant=args.engine_json,
-        config=config, host=args.ip, port=args.port,
-        ssl_context=ssl_context_from(args.cert or None, args.key or None))
-    scheme = "https" if args.cert else "http"
+        config=config, host=args.ip, port=args.port, ssl_context=ssl_ctx)
+    scheme = "https" if ssl_ctx else "http"
     _out(f"Engine is deployed and running. Engine API is live at "
          f"{scheme}://{args.ip}:{server.port}.")
     try:
@@ -366,11 +366,10 @@ def cmd_eventserver(args, storage: Storage) -> int:
     from ..server.eventserver import build_app
     from ..server.http import AppServer, ssl_context_from
 
+    ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = AppServer(build_app(storage, stats=args.stats),
-                       host=args.ip, port=args.port,
-                       ssl_context=ssl_context_from(args.cert or None,
-                                                    args.key or None))
-    scheme = "https" if args.cert else "http"
+                       host=args.ip, port=args.port, ssl_context=ssl_ctx)
+    scheme = "https" if ssl_ctx else "http"
     _out(f"Event Server is listening at {scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()
@@ -383,11 +382,11 @@ def cmd_adminserver(args, storage: Storage) -> int:
     from ..server.adminserver import create_admin_server
     from ..server.http import ssl_context_from
 
+    ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = create_admin_server(
         storage, host=args.ip, port=args.port,
-        accesskey=args.accesskey or None,
-        ssl_context=ssl_context_from(args.cert or None, args.key or None))
-    scheme = "https" if args.cert else "http"
+        accesskey=args.accesskey or None, ssl_context=ssl_ctx)
+    scheme = "https" if ssl_ctx else "http"
     _out(f"Admin server is listening at {scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()
@@ -400,11 +399,11 @@ def cmd_dashboard(args, storage: Storage) -> int:
     from ..server.dashboard import create_dashboard
     from ..server.http import ssl_context_from
 
+    ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = create_dashboard(
         storage, host=args.ip, port=args.port,
-        accesskey=args.accesskey or None,
-        ssl_context=ssl_context_from(args.cert or None, args.key or None))
-    scheme = "https" if args.cert else "http"
+        accesskey=args.accesskey or None, ssl_context=ssl_ctx)
+    scheme = "https" if ssl_ctx else "http"
     _out(f"Dashboard is listening at {scheme}://{args.ip}:{server.port}.")
     try:
         server.serve_forever()

@@ -33,6 +33,8 @@ def build_app(storage: Optional[Storage] = None,
         return storage if storage is not None else get_storage()
 
     _auth = make_key_auth(accesskey)
+    #: propagated to generated links so navigation stays authenticated
+    key_qs = f"?accessKey={accesskey}" if accesskey else ""
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -47,11 +49,11 @@ def build_app(storage: Optional[Storage] = None,
                 f"<td>{esc(i.evaluation_class)}</td>"
                 f"<td>{esc(i.evaluator_results)}</td>"
                 f"<td><a href='/engine_instances/{esc(i.id)}/"
-                f"evaluator_results.html'>HTML</a> "
+                f"evaluator_results.html{key_qs}'>HTML</a> "
                 f"<a href='/engine_instances/{esc(i.id)}/"
-                f"evaluator_results.json'>JSON</a> "
+                f"evaluator_results.json{key_qs}'>JSON</a> "
                 f"<a href='/engine_instances/{esc(i.id)}/"
-                f"evaluator_results.txt'>TXT</a></td></tr>")
+                f"evaluator_results.txt{key_qs}'>TXT</a></td></tr>")
         body = (
             "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
             f"<body><h1>Evaluation history</h1>"

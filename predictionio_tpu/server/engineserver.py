@@ -357,19 +357,14 @@ def build_app(server: QueryServer) -> HTTPApp:
         the plugin's ``handle_rest`` with the remaining segments.
         Key-guarded like the other control routes (plugins may expose
         internal state)."""
+        from .plugins import resolve_plugin
+
         _auth(req)
-        ptype = req.path_params["ptype"]
-        registry = {"outputblockers": server.plugins.output_blockers,
-                    "outputsniffers": server.plugins.output_sniffers}
-        plugins = registry.get(ptype)
-        if plugins is None:
-            raise HTTPError(404, f"unknown plugin type {ptype!r}")
-        plugin = plugins.get(req.path_params["pname"])
-        if plugin is None:
-            raise HTTPError(404,
-                            f"plugin {req.path_params['pname']!r} "
-                            f"not registered")
-        args = [seg for seg in req.path_params["rest"].split("/") if seg]
+        plugin, args = resolve_plugin(
+            {"outputblockers": server.plugins.output_blockers,
+             "outputsniffers": server.plugins.output_sniffers},
+            req.path_params["ptype"], req.path_params["pname"],
+            req.path_params["rest"])
         return json_response(plugin.handle_rest(args))
 
     app_server_ref: List[AppServer] = []

@@ -92,6 +92,23 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
     def plugins_json(req: Request) -> Response:
         return json_response({"plugins": plug.describe()})
 
+    @app.route("GET", r"/plugins/(?P<ptype>[^/]+)/(?P<pname>[^/]+)"
+                      r"(?P<rest>(/[^/]+)*)")
+    def plugin_rest(req: Request) -> Response:
+        """Per-plugin REST surface (``EventServer.scala:174-205``):
+        accessKey-authenticated; the plugin's ``handle_rest`` receives the
+        caller's (appId, channelId) plus the remaining path segments."""
+        from .plugins import resolve_plugin
+
+        auth = _auth(req)
+        plugin, args = resolve_plugin(
+            {"inputblockers": plug.input_blockers,
+             "inputsniffers": plug.input_sniffers},
+            req.path_params["ptype"], req.path_params["pname"],
+            req.path_params["rest"])
+        return json_response(
+            plugin.handle_rest(auth.app_id, auth.channel_id, args))
+
     @app.route("POST", "/events.json")
     def post_event(req: Request) -> Response:
         auth = _auth(req)

@@ -133,3 +133,18 @@ class EngineServerPlugins:
                     for name, p in plugins.items()}
         return {"outputblockers": one(self.output_blockers),
                 "outputsniffers": one(self.output_sniffers)}
+
+
+def resolve_plugin(registry_map, ptype: str, pname: str, rest: str):
+    """Shared ``/plugins/<type>/<name>/<args…>`` dispatch for the engine
+    and event servers: returns (plugin, args) or raises the appropriate
+    404 ``HTTPError``."""
+    from .http import HTTPError
+
+    plugins = registry_map.get(ptype)
+    if plugins is None:
+        raise HTTPError(404, f"unknown plugin type {ptype!r}")
+    plugin = plugins.get(pname)
+    if plugin is None:
+        raise HTTPError(404, f"plugin {pname!r} not registered")
+    return plugin, [seg for seg in rest.split("/") if seg]

@@ -328,3 +328,24 @@ class TestAdminDashboardAuth:
 
         assert call("/") == 401
         assert call("/", {"accessKey": "SECRET"}) == 200
+
+    def test_dashboard_links_carry_accesskey(self, storage):
+        from datetime import datetime, timezone
+
+        from predictionio_tpu.data.storage.base import (
+            STATUS_EVALCOMPLETED,
+            EvaluationInstance,
+        )
+        from predictionio_tpu.server.dashboard import build_app
+        from predictionio_tpu.server.http import Request
+
+        t = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        storage.evaluation_instances().insert(EvaluationInstance(
+            id="", status=STATUS_EVALCOMPLETED, start_time=t, end_time=t,
+            evaluator_results="r"))
+        app = build_app(storage, accesskey="SECRET")
+        resp = app.handle(Request(method="GET", path="/",
+                                  query={"accessKey": "SECRET"},
+                                  headers={}, body=b""))
+        html = resp.encoded().decode()
+        assert "evaluator_results.html?accessKey=SECRET" in html

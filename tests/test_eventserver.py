@@ -257,3 +257,48 @@ def test_input_blocker_plugin():
     resp = app.handle(Request(method="GET", path="/plugins.json", query={},
                               headers={}, body=b""))
     assert "rejectall" in json.loads(resp.encoded())["plugins"]["inputblockers"]
+
+
+class TestEventPluginREST:
+    def test_plugin_rest_authenticated(self):
+        """/plugins/<type>/<name>/<args> is key-authenticated and passes
+        (appId, channelId, args) to handle_rest (EventServer.scala:174)."""
+        from predictionio_tpu.server.http import AppServer
+        from predictionio_tpu.server.plugins import (
+            EventServerPlugin,
+            EventServerPlugins,
+        )
+
+        st = make_storage()
+        app_id = st.apps().get_by_name("testapp").id
+
+        class EchoPlugin(EventServerPlugin):
+            plugin_name = "echo"
+            plugin_description = "echoes REST context"
+
+            def process(self, app_id, channel_id, event):
+                pass
+
+            def handle_rest(self, app_id, channel_id, args):
+                return {"appId": app_id, "channelId": channel_id,
+                        "args": args}
+
+        plugins = EventServerPlugins()
+        plugins.register(EchoPlugin(), blocker=True)
+        psrv = AppServer(build_app(st, plugins=plugins),
+                         "127.0.0.1", 0).start_background()
+        try:
+            status, body = call(psrv, "GET",
+                                "/plugins/inputblockers/echo/x/y"
+                                "?accessKey=KEY1")
+            assert status == 200
+            assert body == {"appId": app_id, "channelId": None,
+                            "args": ["x", "y"]}
+            status, _ = call(psrv, "GET",
+                             "/plugins/inputblockers/echo/x")
+            assert status == 401  # no key
+            status, _ = call(psrv, "GET",
+                             "/plugins/inputblockers/nope?accessKey=KEY1")
+            assert status == 404
+        finally:
+            psrv.shutdown()
