@@ -332,10 +332,13 @@ def cmd_undeploy(args, storage: Storage) -> int:
 
         kw = {}
         if args.https:
-            insecure = _ssl.create_default_context()
-            insecure.check_hostname = False
-            insecure.verify_mode = _ssl.CERT_NONE  # local control plane
-            kw["context"] = insecure
+            ctx = _ssl.create_default_context()
+            if getattr(args, "insecure", False):
+                # opt-in for self-signed local certs; the accessKey rides
+                # this URL, so verification stays on by default
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            kw["context"] = ctx
         req = urllib.request.Request(url, method="POST", data=b"")
         with urllib.request.urlopen(req, timeout=10, **kw) as resp:
             resp.read()
@@ -656,6 +659,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="access key if the server was deployed with one")
     s.add_argument("--https", action="store_true",
                    help="the server was deployed with --cert/--key")
+    s.add_argument("--insecure", action="store_true",
+                   help="skip TLS certificate verification (self-signed "
+                        "local certs only)")
 
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)

@@ -125,6 +125,13 @@ class Algorithm(abc.ABC, Generic[PD, M, Q, P]):
                 return manifest
         return to_host(model)
 
+    def bind_serving(self, ctx: Context) -> None:
+        """Called on the instances that will actually serve queries (engine
+        server bind/reload, batch predict) with the serving Context.
+        Override to capture serving-time resources — e.g. the e-commerce
+        template grabs ``ctx.event_store`` so its realtime filter reads hit
+        the deployed storage, not the process-global default. No-op here."""
+
     def load_persistent_model(self, ctx: Context, stored: Any) -> M:
         """Invert :meth:`make_persistent_model` at deploy time."""
         from ..workflow.persistence import to_device

@@ -50,6 +50,26 @@ class EventFilter:
     target_entity_id: Any = ANY
     limit: Optional[int] = None
     reversed: bool = False
+    #: Optional ``time.monotonic()`` deadline. Backends check it *inside*
+    #: their scan loops and raise :class:`TimeoutError` — the role of the
+    #: reference's bounded ``Await.result(..., timeout)``
+    #: (``LEventStore.scala:76-120``); serving-time filters must degrade
+    #: within their latency budget, not after materializing a heavy scan.
+    deadline: Optional[float] = None
+
+    def check_deadline(self) -> None:
+        import time
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise TimeoutError("event scan exceeded its deadline")
+
+    def apply(self, events: Iterable[Event]) -> Iterator[Event]:
+        """Yield matching events, checking the deadline every 4096 — the
+        one scan loop every in-process backend shares."""
+        for i, e in enumerate(events):
+            if i % 4096 == 0:
+                self.check_deadline()
+            if self.matches(e):
+                yield e
 
     def matches(self, e: Event) -> bool:
         if self.start_time is not None and e.event_time < self.start_time:

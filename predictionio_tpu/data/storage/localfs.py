@@ -15,10 +15,32 @@ are immutable, so a replay is exact).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
 import uuid
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: fall back to the per-process lock only
+    fcntl = None  # type: ignore[assignment]
+
+
+@contextlib.contextmanager
+def _flock(path: str):
+    """OS-level exclusive lock on ``path``'s sidecar lockfile, covering
+    cross-process appenders (e.g. a separately running eventserver in the
+    quickstart topology) that the per-process RLock cannot see."""
+    if fcntl is None:
+        yield
+        return
+    with open(f"{path}.lock", "a") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
 from datetime import datetime
 from typing import Dict, Iterator, List, Optional, Sequence
 
@@ -115,19 +137,38 @@ class LocalFSEventStore(EventStore):
             path = self._path(app_id, channel_id)
             self.c.event_cache.pop(path, None)
             if os.path.exists(path):
-                os.remove(path)
+                # the .lock sidecar is deliberately left in place: unlinking
+                # it would let a process blocked on the old inode and a new
+                # process that re-creates the file both hold an "exclusive"
+                # lock at once
+                with _flock(path):
+                    os.remove(path)
                 return True
         return False
 
     def close(self) -> None:
         pass
 
-    def _append(self, path: str, records: List[dict]) -> int:
-        with open(path, "a", encoding="utf-8") as f:
-            for r in records:
-                f.write(json.dumps(r) + "\n")
-            f.flush()
-            return f.tell()
+    def _append(self, path: str, records: List[dict],
+                expected_size: Optional[int] = None) -> Optional[int]:
+        """Append records under the cross-process lock. When
+        ``expected_size`` is given (the size our replay cache is based on)
+        and another process appended in between, returns None — the caller
+        must invalidate its cache instead of publishing a live-set that
+        silently misses the other process's events."""
+        with _flock(path):
+            clean = True
+            if expected_size is not None:
+                current = os.path.getsize(path) if os.path.exists(path) \
+                    else -1
+                if current < 0:
+                    current = 0  # about to be created by the append
+                clean = current == max(expected_size, 0)
+            with open(path, "a", encoding="utf-8") as f:
+                for r in records:
+                    f.write(json.dumps(r) + "\n")
+                f.flush()
+                return f.tell() if clean else None
 
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
@@ -138,6 +179,8 @@ class LocalFSEventStore(EventStore):
         with self.c.lock:
             path = self._path(app_id, channel_id)
             live, dead = self._state(path)
+            cached = self.c.event_cache.get(path)
+            prior_size = cached[0] if cached is not None else -1
             records, ids, stored_events = [], [], []
             for e in events:
                 eid = e.event_id or uuid.uuid4().hex
@@ -147,25 +190,40 @@ class LocalFSEventStore(EventStore):
                 ids.append(eid)
             # disk first: a failed append must not leave ghost events in
             # the cache
-            size = self._append(path, records)
-            for stored in stored_events:
-                live[stored.event_id] = stored
-            self.c.event_cache[path] = (size, live, dead)
+            size = self._append(path, records, expected_size=prior_size)
+            if size is None:
+                # another process appended between our replay and this
+                # append: drop the cache so the next read replays the file
+                # instead of serving a live-set missing their events
+                self.c.event_cache.pop(path, None)
+            else:
+                for stored in stored_events:
+                    live[stored.event_id] = stored
+                self.c.event_cache[path] = (size, live, dead)
             return ids
 
-    def _state(self, path: str):
+    def _state(self, path: str, deadline: Optional[float] = None):
         """(live events by id, dead-record count), replayed at most once
         per on-disk file state. Compacts the log when tombstoned/overwritten
-        records outnumber live ones."""
+        records outnumber live ones. ``deadline`` (monotonic) bounds a
+        serving-time replay; insert/delete paths never pass one."""
         cached = self.c.event_cache.get(path)
         size = os.path.getsize(path) if os.path.exists(path) else -1
         if cached is not None and cached[0] == size:
             return cached[1], cached[2]
+        import time as _time
         out: Dict[str, Event] = {}
         dead = 0
         if size >= 0:
-            with open(path, "r", encoding="utf-8") as f:
-                for line in f:
+            # flock against cross-process writers: without it a reader can
+            # see a torn trailing record mid-flush and crash on json.loads
+            with _flock(path), open(path, "r", encoding="utf-8") as f:
+                size = os.path.getsize(path)  # re-stat now that we hold it
+                for ln, line in enumerate(f):
+                    if deadline is not None and ln % 4096 == 0 \
+                            and _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "event-log replay exceeded its deadline")
                     line = line.strip()
                     if not line:
                         continue
@@ -181,25 +239,35 @@ class LocalFSEventStore(EventStore):
                         else:
                             dead += 1
         if dead > max(len(out), 16):
-            size, dead = self._compact(path, out)
+            compacted = self._compact(path, out, size)
+            if compacted is not None:
+                size, dead = compacted
         self.c.event_cache[path] = (size, out, dead)
         return out, dead
 
-    def _compact(self, path: str, live: Dict[str, Event]) -> tuple:
-        """Rewrite the log with only live records (atomic replace)."""
-        tmp = f"{path}.compact.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            for e in live.values():
-                f.write(json.dumps({"op": "put", "event": e.to_json()})
-                        + "\n")
-            f.flush()
-            size = f.tell()
-        os.replace(tmp, path)
-        return size, 0
+    def _compact(self, path: str, live: Dict[str, Event],
+                 replayed_size: int) -> Optional[tuple]:
+        """Rewrite the log with only live records (atomic replace). Holds
+        the cross-process lock and re-stats the log first: if another
+        process appended since our replay, skip — replacing from a stale
+        snapshot would silently drop their events."""
+        with _flock(path):
+            current = os.path.getsize(path) if os.path.exists(path) else -1
+            if current != replayed_size:
+                return None
+            tmp = f"{path}.compact.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for e in live.values():
+                    f.write(json.dumps({"op": "put", "event": e.to_json()})
+                            + "\n")
+                f.flush()
+                size = f.tell()
+            os.replace(tmp, path)
+            return size, 0
 
-    def _replay(self, app_id: int, channel_id: Optional[int]
-                ) -> Dict[str, Event]:
-        return self._state(self._path(app_id, channel_id))[0]
+    def _replay(self, app_id: int, channel_id: Optional[int],
+                deadline: Optional[float] = None) -> Dict[str, Event]:
+        return self._state(self._path(app_id, channel_id), deadline)[0]
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
@@ -213,16 +281,23 @@ class LocalFSEventStore(EventStore):
             live, dead = self._state(path)
             if event_id not in live:
                 return False
-            size = self._append(path, [{"op": "del", "eventId": event_id}])
-            live.pop(event_id)
-            self.c.event_cache[path] = (size, live, dead + 2)
+            cached = self.c.event_cache.get(path)
+            prior_size = cached[0] if cached is not None else -1
+            size = self._append(path, [{"op": "del", "eventId": event_id}],
+                                expected_size=prior_size)
+            if size is None:
+                self.c.event_cache.pop(path, None)
+            else:
+                live.pop(event_id)
+                self.c.event_cache[path] = (size, live, dead + 2)
             return True
 
     def find(self, app_id: int, channel_id: Optional[int] = None,
              filter: EventFilter = EventFilter()) -> Iterator[Event]:
         with self.c.lock:
-            events = list(self._replay(app_id, channel_id).values())
-        events = [e for e in events if filter.matches(e)]
+            events = list(self._replay(app_id, channel_id,
+                                       filter.deadline).values())
+        events = list(filter.apply(events))
         events.sort(key=lambda e: e.event_time_millis,
                     reverse=filter.reversed)
         if filter.limit is not None and filter.limit >= 0:

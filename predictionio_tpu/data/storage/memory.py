@@ -75,7 +75,7 @@ class MemoryEventStore(EventStore):
              filter: EventFilter = EventFilter()) -> Iterator[Event]:
         with self._lock:
             events = list(self._bucket(app_id, channel_id).values())
-        events = [e for e in events if filter.matches(e)]
+        events = list(filter.apply(events))
         events.sort(key=lambda e: e.event_time_millis, reverse=filter.reversed)
         if filter.limit is not None and filter.limit >= 0:
             events = events[: filter.limit]

@@ -200,7 +200,16 @@ class SQLiteEventStore(EventStore):
         sql = f"SELECT * FROM {_table(app_id, channel_id)}{where}{order}{lim}"
         with self.client.lock:
             try:
-                rows = self._conn.execute(sql, params).fetchall()
+                cur = self._conn.execute(sql, params)
+                rows: list = []
+                while True:
+                    # chunked fetch so a heavy scan honors filter.deadline
+                    # instead of materializing everything first
+                    filter.check_deadline()
+                    chunk = cur.fetchmany(4096)
+                    if not chunk:
+                        break
+                    rows.extend(chunk)
             except sqlite3.OperationalError as e:
                 if "no such table" in str(e):
                     return iter(())

@@ -90,22 +90,22 @@ class EventStoreFacade:
                        timeout_ms: Optional[int] = None) -> List[Event]:
         """Blocking point read used by serving-time filters (e.g. the
         e-commerce template's seen/unavailable lookups). ``timeout_ms``
-        bounds wall-clock like the reference's Duration argument; storage
-        backends here are local so it is a soft deadline check."""
-        t0 = time.monotonic()
+        bounds wall-clock like the reference's Duration argument
+        (``LEventStore.scala:76-120``): the deadline is pushed into the
+        backend scan (checked inside iteration) and also enforced while
+        draining the iterator, so a heavy entity raises ``TimeoutError``
+        at ~the deadline instead of after materializing everything."""
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms is not None else None)
         app_id, channel_id = self.resolve(app_name, channel_name)
         it = self.storage.events().find(app_id, channel_id, EventFilter(
             start_time=start_time, until_time=until_time,
             entity_type=entity_type, entity_id=entity_id,
             event_names=event_names, target_entity_type=target_entity_type,
             target_entity_id=target_entity_id, limit=limit,
-            reversed=latest))
-        out = list(it)
-        if timeout_ms is not None \
-                and (time.monotonic() - t0) * 1000 > timeout_ms:
-            raise TimeoutError(
-                f"find_by_entity exceeded {timeout_ms}ms deadline")
-        return out
+            reversed=latest, deadline=deadline))
+        drain = EventFilter(deadline=deadline)  # matches all; bounds drain
+        return list(drain.apply(it))
 
 
 #: Default facade bound to the process-wide storage — what templates import,

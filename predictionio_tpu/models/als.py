@@ -304,15 +304,32 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             checkpoint_every = 1  # a checkpoint dir implies checkpointing
         # refuse to resume from a different problem/params: fingerprint
         # everything that determines the factor trajectory
-        fingerprint = hashlib.sha256(_json.dumps([
+        # cheap content digest so a *different* dataset with identical
+        # shape cannot silently resume from foreign factors: sample the
+        # first/last 1024 COO triples (native dtype, no copies) plus
+        # whole-array sums
+        k = 1024
+        content = hashlib.sha256()
+        for arr in (np.asarray(ratings.users), np.asarray(ratings.items),
+                    np.asarray(ratings.ratings)):
+            content.update(np.ascontiguousarray(arr[:k]).tobytes())
+            content.update(np.ascontiguousarray(arr[-k:]).tobytes())
+            content.update(np.float64(arr.sum(dtype=np.float64)).tobytes())
+        base = [
             params.rank, params.reg, params.alpha, params.implicit_prefs,
             params.seed, params.scale_reg_by_count, params.matmul_dtype,
             params.max_history,  # affects history truncation → trajectory
             ratings.n_users, ratings.n_items, len(ratings.users),
-        ]).encode()).hexdigest()[:16]
+        ]
+        fingerprint = hashlib.sha256(_json.dumps(
+            base + [content.hexdigest()]).encode()).hexdigest()[:16]
+        # pre-content-digest dirs (round-1 scheme) stay resumable: accept a
+        # legacy match once and upgrade the metadata in place
+        legacy = hashlib.sha256(_json.dumps(base).encode()).hexdigest()[:16]
         ckpt = Checkpointer(checkpoint_dir)
         meta = ckpt.get_metadata()
-        if meta is not None and meta.get("fingerprint") != fingerprint:
+        if meta is not None \
+                and meta.get("fingerprint") not in (fingerprint, legacy):
             raise ValueError(
                 f"checkpoint dir {checkpoint_dir} belongs to a different "
                 f"ALS run (params/dataset mismatch); use a fresh dir")

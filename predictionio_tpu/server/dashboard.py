@@ -14,31 +14,33 @@ from typing import Optional
 
 from ..data.event import utcnow
 from ..data.storage.registry import Storage, get_storage
-from .http import (
-    AppServer,
-    HTTPApp,
-    Request,
-    Response,
-    json_response,
-    make_key_auth,
-)
+from .http import AppServer, HTTPApp, Request, Response, SessionAuth
 
 
 def build_app(storage: Optional[Storage] = None,
-              accesskey: Optional[str] = None) -> HTTPApp:
+              accesskey: Optional[str] = None,
+              secure: bool = False) -> HTTPApp:
     app = HTTPApp("dashboard")
     start_time = utcnow()
 
     def st() -> Storage:
         return storage if storage is not None else get_storage()
 
-    _auth = make_key_auth(accesskey)
-    #: propagated to generated links so navigation stays authenticated
-    key_qs = f"?accessKey={accesskey}" if accesskey else ""
+    # cookie session after the first authenticated request: generated
+    # links never carry the accessKey (it would land in browser history,
+    # proxy logs, and Referer headers)
+    _session = SessionAuth(accesskey, secure=secure)
+
+    def _auth(req: Request) -> dict:
+        """Authorize; returns response headers (Set-Cookie on first
+        key-authenticated request) to attach to every outcome, 404s
+        included."""
+        set_cookie = _session(req)
+        return {"Set-Cookie": set_cookie} if set_cookie else {}
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
-        _auth(req)
+        headers = _auth(req)
         rows = []
         for i in st().evaluation_instances().get_completed():
             esc = _html.escape
@@ -49,11 +51,11 @@ def build_app(storage: Optional[Storage] = None,
                 f"<td>{esc(i.evaluation_class)}</td>"
                 f"<td>{esc(i.evaluator_results)}</td>"
                 f"<td><a href='/engine_instances/{esc(i.id)}/"
-                f"evaluator_results.html{key_qs}'>HTML</a> "
+                f"evaluator_results.html'>HTML</a> "
                 f"<a href='/engine_instances/{esc(i.id)}/"
-                f"evaluator_results.json{key_qs}'>JSON</a> "
+                f"evaluator_results.json'>JSON</a> "
                 f"<a href='/engine_instances/{esc(i.id)}/"
-                f"evaluator_results.txt{key_qs}'>TXT</a></td></tr>")
+                f"evaluator_results.txt'>TXT</a></td></tr>")
         body = (
             "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
             f"<body><h1>Evaluation history</h1>"
@@ -62,7 +64,8 @@ def build_app(storage: Optional[Storage] = None,
             "<th>Evaluation</th><th>Result</th><th>Details</th></tr>"
             + "".join(rows) + "</table></body></html>")
         return Response(status=200, body=body,
-                        content_type="text/html; charset=utf-8")
+                        content_type="text/html; charset=utf-8",
+                        headers=headers)
 
     def _instance(req: Request):
         return st().evaluation_instances().get(req.path_params["iid"])
@@ -70,32 +73,37 @@ def build_app(storage: Optional[Storage] = None,
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"evaluator_results\.txt")
     def results_txt(req: Request) -> Response:
-        _auth(req)
+        headers = _auth(req)
         i = _instance(req)
         if i is None:
-            return json_response({"message": "Not Found"}, 404)
+            return Response(status=404, body={"message": "Not Found"},
+                            headers=headers)
         return Response(status=200, body=i.evaluator_results,
-                        content_type="text/plain; charset=utf-8")
+                        content_type="text/plain; charset=utf-8",
+                        headers=headers)
 
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"evaluator_results\.html")
     def results_html(req: Request) -> Response:
-        _auth(req)
+        headers = _auth(req)
         i = _instance(req)
         if i is None:
-            return json_response({"message": "Not Found"}, 404)
+            return Response(status=404, body={"message": "Not Found"},
+                            headers=headers)
         return Response(status=200, body=i.evaluator_results_html,
-                        content_type="text/html; charset=utf-8")
+                        content_type="text/html; charset=utf-8",
+                        headers=headers)
 
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"evaluator_results\.json")
     def results_json(req: Request) -> Response:
-        _auth(req)
+        headers = _auth(req)
         i = _instance(req)
         if i is None:
-            return json_response({"message": "Not Found"}, 404)
+            return Response(status=404, body={"message": "Not Found"},
+                            headers=headers)
         return Response(status=200, body=i.evaluator_results_json,
-                        content_type="application/json")
+                        content_type="application/json", headers=headers)
 
     @app.route("GET", r"/engine_instances/(?P<iid>[^/]+)/"
                       r"local_evaluator_results\.json")
@@ -111,5 +119,6 @@ def create_dashboard(storage: Optional[Storage] = None,
                      host: str = "127.0.0.1", port: int = 9000,
                      accesskey: Optional[str] = None,
                      ssl_context=None) -> AppServer:
-    return AppServer(build_app(storage, accesskey=accesskey), host=host,
-                     port=port, ssl_context=ssl_context)
+    return AppServer(build_app(storage, accesskey=accesskey,
+                               secure=ssl_context is not None),
+                     host=host, port=port, ssl_context=ssl_context)

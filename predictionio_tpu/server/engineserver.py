@@ -108,6 +108,8 @@ class QueryServer:
             self.models = models
             self.instance = instance
             self.algorithms = self.engine.make_algorithms(engine_params)
+            for algo in self.algorithms:
+                algo.bind_serving(self.ctx)
             self.serving = self.engine.make_serving(engine_params)
 
     # -- batched hot path ---------------------------------------------------

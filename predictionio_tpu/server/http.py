@@ -77,6 +77,85 @@ def make_key_auth(accesskey: Optional[str]) -> Callable[["Request"], None]:
     return _auth
 
 
+class SessionAuth:
+    """Cookie-session guard for browser-facing servers (dashboard).
+
+    Accepts the accessKey once — via ``?accessKey=`` or an
+    ``Authorization: Bearer`` header — then mints an HttpOnly session
+    cookie, so generated links never embed the secret (which would leak
+    into browser history, proxy logs, and Referer headers). The reference
+    dashboard had no auth at all; this extends its KeyAuthentication
+    pattern (``common/.../KeyAuthentication.scala:33-58``) to browsers.
+
+    Calling the instance authorizes a request and returns a ``Set-Cookie``
+    header value when a new session was minted (else ``None``); raises
+    :class:`HTTPError` 401 on failure.
+    """
+
+    MAX_SESSIONS = 4096
+
+    def __init__(self, accesskey: Optional[str],
+                 cookie_name: str = "pio_dashboard_session",
+                 secure: bool = False):
+        import hmac as _hmac
+        self._hmac = _hmac
+        self.accesskey = accesskey
+        self.cookie_name = cookie_name
+        self.secure = secure
+        #: insertion-ordered so overflow evicts the oldest session only —
+        #: a cookie-less poller (curl health check) must not wholesale
+        #: log out live browser sessions; values are monotonic expiry times
+        self._tokens: "Dict[str, float]" = {}
+        self._lock = threading.Lock()
+
+    #: sessions expire after 24h; a captured cookie does not authenticate
+    #: for the life of the server process
+    TTL_SECONDS = 24 * 3600.0
+
+    def _cookie_token(self, req: "Request") -> Optional[str]:
+        header = req.headers.get("Cookie") or ""
+        for part in header.split(";"):
+            name, _, value = part.strip().partition("=")
+            if name == self.cookie_name and value:
+                return value
+        return None
+
+    def __call__(self, req: "Request") -> Optional[str]:
+        if not self.accesskey:
+            return None
+        import time as _time
+        now = _time.monotonic()
+        tok = self._cookie_token(req)
+        if tok is not None:
+            with self._lock:
+                for t, expiry in self._tokens.items():
+                    if self._hmac.compare_digest(tok, t):
+                        if now <= expiry:
+                            return None
+                        break  # expired: fall through to key auth
+        supplied = req.query.get("accessKey") or ""
+        if not supplied:
+            auth = req.headers.get("Authorization") or ""
+            if auth.startswith("Bearer "):
+                supplied = auth[len("Bearer "):]
+        if supplied and self._hmac.compare_digest(supplied, self.accesskey):
+            import secrets
+            tok = secrets.token_urlsafe(32)
+            with self._lock:
+                expired = [t for t, exp in self._tokens.items()
+                           if now > exp]
+                for t in expired:
+                    del self._tokens[t]
+                while len(self._tokens) >= self.MAX_SESSIONS:
+                    self._tokens.pop(next(iter(self._tokens)))
+                self._tokens[tok] = now + self.TTL_SECONDS
+            attrs = "; HttpOnly; SameSite=Strict; Path=/"
+            if self.secure:
+                attrs += "; Secure"
+            return f"{self.cookie_name}={tok}{attrs}"
+        raise HTTPError(401, "Invalid accessKey.")
+
+
 def ssl_context_from(cert_path: Optional[str] = None,
                      key_path: Optional[str] = None):
     """Build a server SSLContext from PEM files; falls back to the

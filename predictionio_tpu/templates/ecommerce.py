@@ -183,6 +183,7 @@ class ECommAlgorithm(Algorithm):
     def train(self, ctx: Context, td: TrainingData) -> ECommModel:
         if not td.view_events:
             raise ValueError("viewEvents cannot be empty")
+        self._serving_store = ctx.event_store
         user_ids = BiMap.string_int(td.users.keys())
         item_ids = BiMap.string_int(td.items.keys())
         ratings = self.gen_ratings(td, user_ids, item_ids)
@@ -206,7 +207,16 @@ class ECommAlgorithm(Algorithm):
             items={item_ids[k]: v for k, v in td.items.items()})
 
     # -- serving-time event-store lookups -------------------------------------
+    def bind_serving(self, ctx: Context) -> None:
+        # capture the serving Context's storage so filter reads
+        # (seen/unavailable/weighted/recent) hit the same backend the model
+        # was deployed against, not the process-global default
+        self._serving_store = ctx.event_store
+
     def _ctx_store(self):
+        store = getattr(self, "_serving_store", None)
+        if store is not None:
+            return store
         from ..data.store import event_store
         return event_store
 

@@ -59,9 +59,13 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
 def batch_predict_lines(engine: Engine,
                         engine_params: EngineParams, models: List[Any],
                         query_lines: Iterable[str],
-                        batch_size: int = 1024) -> Iterator[str]:
+                        batch_size: int = 1024,
+                        ctx: Optional[Context] = None) -> Iterator[str]:
     """Yield one JSON result line per non-empty input query line."""
     algorithms = engine.make_algorithms(engine_params)
+    if ctx is not None:
+        for algo in algorithms:
+            algo.bind_serving(ctx)
     serving = engine.make_serving(engine_params)
     query_cls = algorithms[0].query_class
 
@@ -109,7 +113,8 @@ def run_batch_predict(ctx: Context, engine: Engine,
     with open(input_path, "r", encoding="utf-8") as fin, \
             open(output_path, "w", encoding="utf-8") as fout:
         for line in batch_predict_lines(engine, engine_params, models,
-                                        fin, batch_size=batch_size):
+                                        fin, batch_size=batch_size,
+                                        ctx=ctx):
             fout.write(line + "\n")
             n += 1
     return n

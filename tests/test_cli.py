@@ -329,7 +329,10 @@ class TestAdminDashboardAuth:
         assert call("/") == 401
         assert call("/", {"accessKey": "SECRET"}) == 200
 
-    def test_dashboard_links_carry_accesskey(self, storage):
+    def test_dashboard_session_cookie_keeps_links_clean(self, storage):
+        """First authenticated request mints an HttpOnly session cookie;
+        generated links never embed the accessKey (browser history /
+        proxy logs / Referer leakage — ADVICE r1)."""
         from datetime import datetime, timezone
 
         from predictionio_tpu.data.storage.base import (
@@ -348,4 +351,16 @@ class TestAdminDashboardAuth:
                                   query={"accessKey": "SECRET"},
                                   headers={}, body=b""))
         html = resp.encoded().decode()
-        assert "evaluator_results.html?accessKey=SECRET" in html
+        assert "accessKey" not in html        # links carry no secret
+        cookie = resp.headers.get("Set-Cookie", "")
+        assert "HttpOnly" in cookie
+        # the minted cookie authenticates follow-up requests on its own
+        token = cookie.split(";")[0]
+        resp2 = app.handle(Request(method="GET", path="/", query={},
+                                   headers={"Cookie": token}, body=b""))
+        assert resp2.status == 200
+        # and a bogus cookie does not
+        resp3 = app.handle(Request(
+            method="GET", path="/", query={},
+            headers={"Cookie": "pio_dashboard_session=forged"}, body=b""))
+        assert resp3.status == 401
