@@ -1,0 +1,147 @@
+"""Data-path benchmark: event-log scan → RatingsCOO throughput.
+
+Measures the VERDICT r1 top gap end to end on a MovieLens-20M-shaped
+synthetic log in SQLite (the durable default backend):
+
+- ``ingest``: bulk row ingest (one-time cost, executemany)
+- ``encode``: first columnar read — sidecar delta encode (one-time)
+- ``warm scan``: steady-state training read — mmap segments →
+  filter pushdown → :func:`ratings_from_columnar` (what every
+  ``ptpu train`` after the first pays)
+- ``row path``: the round-1 per-event loop, for the same read, measured
+  on a 1/20 subsample and scaled (it is ~two orders slower)
+
+Usage: python benchmarks/data_path_bench.py [n_events] [--keep]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from predictionio_tpu.data.storage import App, EventFilter, Storage  # noqa: E402
+from predictionio_tpu.data.store import EventStoreFacade  # noqa: E402
+from predictionio_tpu.models.data import (  # noqa: E402
+    ratings_from_columnar,
+    ratings_from_events,
+)
+
+N_USERS = 138_000
+N_ITEMS = 27_000
+
+
+def build_db(path: str, n_events: int, seed: int = 7) -> Storage:
+    """Synthetic rate-event log shaped like MovieLens-20M (zipf items)."""
+    env = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": path,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    }
+    storage = Storage(env=env)
+    if storage.apps().get_by_name("ml20m") is not None:
+        return storage
+    app_id = storage.apps().insert(App(0, "ml20m"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(seed)
+    t0 = time.monotonic()
+    conn = es.client.conn
+    chunk = 500_000
+    written = 0
+    base_ms = 1_760_000_000_000
+    while written < n_events:
+        m = min(chunk, n_events - written)
+        users = rng.integers(0, N_USERS, m)
+        items = (rng.zipf(1.3, m) - 1) % N_ITEMS
+        stars = rng.integers(1, 6, m).astype(np.float64)
+        times = base_ms + rng.integers(0, 3_000_000_000, m)
+        rows = [
+            (f"e{written + j}", "rate", "user", f"u{users[j]}", "item",
+             f"i{items[j]}", '{"rating": %.1f}' % stars[j],
+             int(times[j]), "[]", None, int(times[j]))
+            for j in range(m)
+        ]
+        with es.client.lock:
+            conn.executemany(
+                f"INSERT INTO events_{app_id} VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?)", rows)
+            conn.commit()
+        written += m
+        print(f"  ingest {written}/{n_events} "
+              f"({written / (time.monotonic() - t0):,.0f} ev/s)",
+              flush=True)
+    return storage
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000_000
+    keep = "--keep" in sys.argv
+    root = os.environ.get("PIO_BENCH_DIR", "/tmp/pio_datapath_bench")
+    os.makedirs(root, exist_ok=True)
+    db = os.path.join(root, f"bench_{n}.db")
+
+    print(f"== ingest ({n:,} events) ==", flush=True)
+    t0 = time.monotonic()
+    storage = build_db(db, n)
+    ingest_s = time.monotonic() - t0
+    fac = EventStoreFacade(storage)
+
+    print("== first columnar read (sidecar encode) ==", flush=True)
+    t0 = time.monotonic()
+    batch = fac.find_columnar("ml20m", entity_type="user",
+                              target_entity_type="item",
+                              event_names=["rate", "buy"])
+    encode_s = time.monotonic() - t0
+    assert batch.n == n, (batch.n, n)
+
+    print("== warm scans (steady-state training read) ==", flush=True)
+    warm = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        batch = fac.find_columnar("ml20m", entity_type="user",
+                                  target_entity_type="item",
+                                  event_names=["rate", "buy"],
+                                  ordered=False, with_props=False)
+        coo, user_ids, item_ids = ratings_from_columnar(batch)
+        warm.append(time.monotonic() - t0)
+    warm_s = min(warm)
+    assert len(coo.users) == n
+
+    print("== row path (1/20 subsample, scaled) ==", flush=True)
+    sub = max(n // 20, 1)
+    t0 = time.monotonic()
+    it = storage.events().find(
+        1, None, EventFilter(entity_type="user", target_entity_type="item",
+                             event_names=["rate", "buy"], limit=sub))
+    coo_r, _, _ = ratings_from_events(it)
+    row_s_scaled = (time.monotonic() - t0) * (n / sub)
+
+    result = {
+        "n_events": n,
+        "ingest_events_per_s": round(n / ingest_s),
+        "encode_s": round(encode_s, 2),
+        "encode_events_per_s": round(n / encode_s),
+        "warm_scan_s": round(warm_s, 3),
+        "warm_scan_events_per_s": round(n / warm_s),
+        "row_path_events_per_s": round(n / row_s_scaled),
+        "speedup_vs_row_path": round(row_s_scaled / warm_s, 1),
+        "nnz_check": int(len(coo.users)),
+    }
+    print(json.dumps(result))
+    if not keep:
+        storage.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,18 +48,26 @@ class EventOp:
 
     @staticmethod
     def from_event(e: Event) -> "EventOp":
-        t = e.event_time_millis
-        if e.event == "$set":
+        return EventOp.from_parts(e.event, e.properties.to_dict(),
+                                  e.event_time_millis, e.event_time)
+
+    @staticmethod
+    def from_parts(event: str, properties: Dict[str, Any], t: int,
+                   event_time: datetime) -> "EventOp":
+        """Build from raw parts — lets the columnar path skip ``Event``
+        object construction entirely."""
+        if event == "$set":
             return EventOp(
-                set_fields={k: (v, t) for k, v in e.properties.items()},
-                set_t=t, first_updated=e.event_time, last_updated=e.event_time)
-        if e.event == "$unset":
+                set_fields={k: (v, t) for k, v in properties.items()},
+                set_t=t, first_updated=event_time, last_updated=event_time)
+        if event == "$unset":
             return EventOp(
-                unset_fields={k: t for k in e.properties.keys()},
-                first_updated=e.event_time, last_updated=e.event_time)
-        if e.event == "$delete":
+                unset_fields={k: t for k in properties.keys()},
+                first_updated=event_time, last_updated=event_time)
+        if event == "$delete":
             return EventOp(
-                delete_t=t, first_updated=e.event_time, last_updated=e.event_time)
+                delete_t=t, first_updated=event_time,
+                last_updated=event_time)
         return EventOp()
 
     def merge(self, other: "EventOp") -> "EventOp":
@@ -139,6 +147,31 @@ def aggregate_properties(events: Iterable[Event]) -> Dict[str, PropertyMap]:
         op = EventOp.from_event(e)
         prev = ops.get(e.entity_id)
         ops[e.entity_id] = prev.merge(op) if prev is not None else op
+    out: Dict[str, PropertyMap] = {}
+    for entity_id, op in ops.items():
+        pm = op.to_property_map()
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def aggregate_from_columnar(batch) -> Dict[str, PropertyMap]:
+    """Monoid aggregation over a columnar batch of ``$set/$unset/$delete``
+    events (``PEventAggregator.scala:196-210`` without per-event objects):
+    the caller pushes entity-type/time filters down as columnar masks; only
+    the surviving special events pay Python-level JSON merges."""
+    from .event import from_millis
+
+    names = batch.dicts.event_names.values
+    entity_values = batch.dicts.entity_ids.values
+    ops: Dict[str, EventOp] = {}
+    for i in range(batch.n):
+        op = EventOp.from_parts(
+            names[batch.event[i]], batch.props_json(i),
+            int(batch.event_time[i]), from_millis(int(batch.event_time[i])))
+        eid = entity_values[batch.entity_id[i]]
+        prev = ops.get(eid)
+        ops[eid] = prev.merge(op) if prev is not None else op
     out: Dict[str, PropertyMap] = {}
     for entity_id, op in ops.items():
         pm = op.to_property_map()

@@ -1,0 +1,603 @@
+"""Columnar bulk event reads — the PEvents analogue.
+
+The reference's entire training read path was parallel:
+``data/src/main/scala/org/apache/predictionio/data/storage/PEvents.scala:38-189``
+hands templates an ``RDD[Event]`` whose partitions Spark scans in
+parallel (``storage/jdbc/.../JDBCPEvents.scala:49-89`` splits the SQL
+scan by time range). A TPU-native framework has no executors to ship
+closures to — what it needs from the data layer is **columns**: dense
+integer codes and flat value arrays that turn straight into
+``jax.Array`` shards. So the P-side contract here is
+:class:`ColumnarBatch`: every event field dictionary-encoded into numpy
+arrays, filters pushed down as vectorized masks, host-sharding for
+multi-host feeding (``PEvents``' partition role) as array slicing.
+
+Layout (one batch = one app/channel log projection):
+
+- ``event``, ``entity_type``, ``target_entity_type``: int32 codes into
+  per-log :class:`StringDict`\\ s (-1 where the target is absent)
+- ``entity_id``, ``target_entity_id``: int32 codes into the entity/target
+  id dicts — the ``BiMap.stringInt`` indexation
+  (``data/.../storage/BiMap.scala:105``) precomputed at the storage layer
+- ``event_time``: int64 epoch millis
+- ``float_props[name]``: float64 with NaN for missing — numeric
+  properties (e.g. ``rating``) extracted at encode time
+- ``props_offsets``/``props_blob``: raw JSON property bytes, offset-
+  indexed (empty slice ⇒ no properties) — feeds the ``$set`` aggregation
+  path and full-event reconstruction
+
+This is a *bulk-read projection*: per-event metadata that training never
+touches (event ids, tags, prId, creation time) stays in the row store.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX
+    fcntl = None  # type: ignore[assignment]
+
+import numpy as np
+
+from .event import Event, from_millis, to_millis
+from .storage.base import ANY, EventFilter
+
+try:  # pandas.factorize is ~10x numpy for bulk string->code encoding
+    import pandas as _pd
+except ImportError:  # pragma: no cover - pandas is baked into the image
+    _pd = None
+
+__all__ = [
+    "StringDict",
+    "ColumnarBatch",
+    "ColumnarDicts",
+    "SegmentLog",
+    "columnar_from_events",
+    "columnar_from_columns",
+]
+
+
+class StringDict:
+    """Append-only string → dense int32 code dictionary.
+
+    Codes are assigned in first-seen order and never change, so segments
+    encoded at different times against the same dict concatenate without
+    remapping (the property per-log dicts exist for).
+    """
+
+    __slots__ = ("values", "index")
+
+    def __init__(self, values: Optional[List[str]] = None):
+        self.values: List[str] = list(values or [])
+        self.index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def encode_one(self, s: str) -> int:
+        code = self.index.get(s)
+        if code is None:
+            code = len(self.values)
+            self.index[s] = code
+            self.values.append(s)
+        return code
+
+    def encode(self, strings: Sequence[Optional[str]],
+               missing: int = -1) -> np.ndarray:
+        """Bulk-encode (appending unseen strings); None → ``missing``."""
+        n = len(strings)
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
+        if _pd is not None:
+            codes, uniques = _pd.factorize(
+                _pd.array(strings, dtype=object), use_na_sentinel=True)
+            if len(uniques) == 0:  # every value None
+                return np.full(n, missing, dtype=np.int32)
+            # map the batch-local codes onto the persistent dict
+            remap = np.fromiter((self.encode_one(u) for u in uniques),
+                                dtype=np.int32, count=len(uniques))
+            out = np.where(codes >= 0, remap[np.maximum(codes, 0)],
+                           np.int32(missing)).astype(np.int32)
+            return out
+        enc = self.encode_one
+        return np.fromiter(
+            (missing if s is None else enc(s) for s in strings),
+            dtype=np.int32, count=n)
+
+    def decode(self, codes: np.ndarray) -> List[Optional[str]]:
+        vals = self.values
+        return [vals[c] if c >= 0 else None for c in codes]
+
+    def as_array(self) -> np.ndarray:
+        return np.asarray(self.values, dtype=object)
+
+
+@dataclass
+class ColumnarDicts:
+    """The five per-log dictionaries all of a log's segments share."""
+
+    event_names: StringDict = field(default_factory=StringDict)
+    entity_types: StringDict = field(default_factory=StringDict)
+    entity_ids: StringDict = field(default_factory=StringDict)
+    target_types: StringDict = field(default_factory=StringDict)
+    target_ids: StringDict = field(default_factory=StringDict)
+
+    def counts(self) -> Dict[str, int]:
+        return {k: len(getattr(self, k)) for k in (
+            "event_names", "entity_types", "entity_ids",
+            "target_types", "target_ids")}
+
+
+_EMPTY_F64 = lambda n: np.full(n, np.nan, dtype=np.float64)  # noqa: E731
+
+
+@dataclass
+class ColumnarBatch:
+    """A projection of one event log as dictionary-encoded columns."""
+
+    event: np.ndarray          # int32 [n]
+    entity_type: np.ndarray    # int32 [n]
+    entity_id: np.ndarray      # int32 [n]
+    target_type: np.ndarray    # int32 [n], -1 = None
+    target_id: np.ndarray      # int32 [n], -1 = None
+    event_time: np.ndarray     # int64 [n] epoch ms
+    props_offsets: np.ndarray  # int64 [n+1]
+    props_blob: np.ndarray     # uint8 [total]
+    float_props: Dict[str, np.ndarray]  # name -> float64 [n], NaN missing
+    dicts: ColumnarDicts
+
+    def __len__(self) -> int:
+        return len(self.event)
+
+    @property
+    def n(self) -> int:
+        return len(self.event)
+
+    # -- filter pushdown (vectorized EventFilter) --------------------------
+    def mask(self, f: EventFilter) -> np.ndarray:
+        m = np.ones(self.n, dtype=bool)
+        if f.start_time is not None:
+            m &= self.event_time >= to_millis(f.start_time)
+        if f.until_time is not None:
+            m &= self.event_time < to_millis(f.until_time)
+        if f.event_names is not None:
+            codes = [self.dicts.event_names.index.get(nm, -2)
+                     for nm in f.event_names]
+            m &= np.isin(self.event, np.asarray(codes, dtype=np.int32))
+        if f.entity_type is not None:
+            c = self.dicts.entity_types.index.get(f.entity_type, -2)
+            m &= self.entity_type == c
+        if f.entity_id is not None:
+            c = self.dicts.entity_ids.index.get(f.entity_id, -2)
+            m &= self.entity_id == c
+        if f.target_entity_type is not ANY:
+            if f.target_entity_type is None:
+                m &= self.target_type == -1
+            else:
+                c = self.dicts.target_types.index.get(
+                    f.target_entity_type, -2)
+                m &= self.target_type == c
+        if f.target_entity_id is not ANY:
+            if f.target_entity_id is None:
+                m &= self.target_id == -1
+            else:
+                c = self.dicts.target_ids.index.get(f.target_entity_id, -2)
+                m &= self.target_id == c
+        return m
+
+    def take(self, idx: np.ndarray,
+             with_props: bool = True) -> "ColumnarBatch":
+        """Row subset (indices or bool mask). ``with_props=False`` skips
+        the property-byte repack — the training read path extracts its
+        numeric columns at encode time and never touches the raw JSON."""
+        if idx.dtype == bool:
+            idx = np.flatnonzero(idx)
+        if with_props:
+            lens = self.props_offsets[1:] - self.props_offsets[:-1]
+            sel_lens = lens[idx]
+            offs = np.zeros(len(idx) + 1, dtype=np.int64)
+            np.cumsum(sel_lens, out=offs[1:])
+            total = int(offs[-1])
+            if total == 0:
+                blob = np.empty(0, dtype=np.uint8)
+            else:
+                # vectorized gather: each output byte's source index is the
+                # selected row's start plus the byte's offset within it
+                ramp = np.arange(total, dtype=np.int64) \
+                    - np.repeat(offs[:-1], sel_lens)
+                src = np.repeat(self.props_offsets[:-1][idx],
+                                sel_lens) + ramp
+                blob = np.asarray(self.props_blob)[src]
+        else:
+            offs = np.zeros(len(idx) + 1, dtype=np.int64)
+            blob = np.empty(0, dtype=np.uint8)
+        return ColumnarBatch(
+            event=self.event[idx], entity_type=self.entity_type[idx],
+            entity_id=self.entity_id[idx], target_type=self.target_type[idx],
+            target_id=self.target_id[idx], event_time=self.event_time[idx],
+            props_offsets=offs, props_blob=blob,
+            float_props={k: v[idx] for k, v in self.float_props.items()},
+            dicts=self.dicts)
+
+    def select(self, f: EventFilter, ordered: bool = True,
+               with_props: bool = True) -> "ColumnarBatch":
+        """Apply an :class:`EventFilter`. ``ordered=False`` skips the
+        event-time sort (an O(n log n) argsort a bulk training read does
+        not need); limit/reversed force ordering."""
+        m = self.mask(f)
+        need_order = ordered or f.reversed \
+            or (f.limit is not None and f.limit >= 0)
+        if not need_order and m.all():
+            if with_props:
+                return self
+            # zero-copy view minus the property bytes — the bulk training
+            # read's hot case (homogeneous rate/buy logs)
+            return ColumnarBatch(
+                event=self.event, entity_type=self.entity_type,
+                entity_id=self.entity_id, target_type=self.target_type,
+                target_id=self.target_id, event_time=self.event_time,
+                props_offsets=np.zeros(self.n + 1, dtype=np.int64),
+                props_blob=np.empty(0, dtype=np.uint8),
+                float_props=self.float_props, dicts=self.dicts)
+        idx = np.flatnonzero(m)
+        if need_order:
+            order = np.argsort(self.event_time[idx], kind="stable")
+            if f.reversed:
+                order = order[::-1]
+            idx = idx[order]
+        if f.limit is not None and f.limit >= 0:
+            idx = idx[: f.limit]
+        return self.take(idx, with_props=with_props)
+
+    def shard(self, index: int, count: int) -> "ColumnarBatch":
+        """Contiguous host shard ``index`` of ``count`` — the role of
+        ``PEvents``' RDD partitions for multi-host feeding."""
+        if not 0 <= index < count:
+            raise ValueError(f"shard {index} of {count}")
+        bounds = np.linspace(0, self.n, count + 1).astype(np.int64)
+        return self.take(np.arange(bounds[index], bounds[index + 1]))
+
+    # -- property access ---------------------------------------------------
+    def props_json(self, i: int) -> dict:
+        s, e = int(self.props_offsets[i]), int(self.props_offsets[i + 1])
+        if e == s:
+            return {}
+        return json.loads(self.props_blob[s:e].tobytes().decode("utf-8"))
+
+    def float_prop(self, name: str) -> np.ndarray:
+        """Numeric property column; lazily parsed from the raw JSON bytes
+        when it wasn't extracted at encode time."""
+        col = self.float_props.get(name)
+        if col is not None:
+            return col
+        out = _EMPTY_F64(self.n)
+        offs = self.props_offsets
+        nonempty = np.flatnonzero(offs[1:] > offs[:-1])
+        for i in nonempty:
+            v = self.props_json(int(i)).get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[i] = float(v)
+        self.float_props[name] = out
+        return out
+
+    # -- compat ------------------------------------------------------------
+    def to_events(self) -> Iterator[Event]:
+        """Reconstruct :class:`Event` objects (bulk-projection fields only:
+        no event ids / tags / prId — see module docstring)."""
+        d = self.dicts
+        ev, et, ei = d.event_names.values, d.entity_types.values, \
+            d.entity_ids.values
+        tt, ti = d.target_types.values, d.target_ids.values
+        for i in range(self.n):
+            tc = int(self.target_type[i])
+            yield Event(
+                event=ev[self.event[i]],
+                entity_type=et[self.entity_type[i]],
+                entity_id=ei[self.entity_id[i]],
+                target_entity_type=tt[tc] if tc >= 0 else None,
+                target_entity_id=(ti[int(self.target_id[i])]
+                                  if self.target_id[i] >= 0 else None),
+                properties=self.props_json(i),
+                event_time=from_millis(int(self.event_time[i])))
+
+    @staticmethod
+    def empty(dicts: Optional[ColumnarDicts] = None,
+              float_props: Sequence[str] = ()) -> "ColumnarBatch":
+        return ColumnarBatch(
+            event=np.empty(0, np.int32), entity_type=np.empty(0, np.int32),
+            entity_id=np.empty(0, np.int32),
+            target_type=np.empty(0, np.int32),
+            target_id=np.empty(0, np.int32),
+            event_time=np.empty(0, np.int64),
+            props_offsets=np.zeros(1, np.int64),
+            props_blob=np.empty(0, np.uint8),
+            float_props={k: _EMPTY_F64(0) for k in float_props},
+            dicts=dicts or ColumnarDicts())
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":
+        """Concatenate same-dict batches (segments of one log)."""
+        batches = [b for b in batches if b.n > 0]
+        if not batches:
+            return ColumnarBatch.empty()
+        if len(batches) == 1:
+            return batches[0]
+        d = batches[0].dicts
+        prop_names = set()
+        for b in batches:
+            prop_names |= set(b.float_props)
+        offs = [np.zeros(1, dtype=np.int64)]
+        total = 0
+        for b in batches:
+            offs.append(b.props_offsets[1:] + total)
+            total += int(b.props_offsets[-1])
+        return ColumnarBatch(
+            event=np.concatenate([b.event for b in batches]),
+            entity_type=np.concatenate([b.entity_type for b in batches]),
+            entity_id=np.concatenate([b.entity_id for b in batches]),
+            target_type=np.concatenate([b.target_type for b in batches]),
+            target_id=np.concatenate([b.target_id for b in batches]),
+            event_time=np.concatenate([b.event_time for b in batches]),
+            props_offsets=np.concatenate(offs),
+            props_blob=np.concatenate([b.props_blob for b in batches]),
+            float_props={k: np.concatenate([
+                b.float_props.get(k, _EMPTY_F64(b.n)) for b in batches])
+                for k in prop_names},
+            dicts=d)
+
+
+# ---------------------------------------------------------------------------
+# Encoding
+# ---------------------------------------------------------------------------
+
+def columnar_from_columns(
+        dicts: ColumnarDicts,
+        event: Sequence[str],
+        entity_type: Sequence[str],
+        entity_id: Sequence[str],
+        target_type: Sequence[Optional[str]],
+        target_id: Sequence[Optional[str]],
+        event_time_ms: np.ndarray,
+        props_json: Optional[Sequence[Optional[str]]] = None,
+        float_props: Sequence[str] = ("rating",),
+        float_prop_values: Optional[Dict[str, np.ndarray]] = None,
+) -> ColumnarBatch:
+    """Encode already-columnar host data (the fast path backends use:
+    one bulk dictionary-encode per column, no per-event Python objects).
+
+    ``float_prop_values`` supplies pre-extracted numeric property columns
+    (e.g. SQLite's ``json_extract`` pushdown); missing ones are parsed
+    from ``props_json``.
+    """
+    n = len(event)
+    times = np.ascontiguousarray(event_time_ms, dtype=np.int64)
+    if props_json is None:
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        blob = np.empty(0, dtype=np.uint8)
+    else:
+        encoded = [(p.encode("utf-8") if isinstance(p, str) and p
+                    and p != "{}" else b"") for p in props_json]
+        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
+                           count=n)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        blob = (np.frombuffer(b"".join(encoded), dtype=np.uint8).copy()
+                if int(offsets[-1]) else np.empty(0, dtype=np.uint8))
+    fp: Dict[str, np.ndarray] = {}
+    for name in float_props:
+        if float_prop_values and name in float_prop_values:
+            fp[name] = np.ascontiguousarray(float_prop_values[name],
+                                            dtype=np.float64)
+        else:
+            fp[name] = None  # type: ignore[assignment]  # filled below
+    batch = ColumnarBatch(
+        event=dicts.event_names.encode(event),
+        entity_type=dicts.entity_types.encode(entity_type),
+        entity_id=dicts.entity_ids.encode(entity_id),
+        target_type=dicts.target_types.encode(target_type),
+        target_id=dicts.target_ids.encode(target_id),
+        event_time=times, props_offsets=offsets, props_blob=blob,
+        float_props={k: v for k, v in fp.items() if v is not None},
+        dicts=dicts)
+    for name in float_props:
+        if name not in batch.float_props:
+            batch.float_prop(name)  # parse from the blob once, cache
+    return batch
+
+
+def columnar_from_events(events: Iterable[Event],
+                         dicts: Optional[ColumnarDicts] = None,
+                         float_props: Sequence[str] = ("rating",),
+                         ) -> ColumnarBatch:
+    """Encode an event iterator (the correct-everywhere fallback path)."""
+    dicts = dicts or ColumnarDicts()
+    ev: List[str] = []
+    et: List[str] = []
+    ei: List[str] = []
+    tt: List[Optional[str]] = []
+    ti: List[Optional[str]] = []
+    tms: List[int] = []
+    pj: List[Optional[str]] = []
+    for e in events:
+        ev.append(e.event)
+        et.append(e.entity_type)
+        ei.append(e.entity_id)
+        tt.append(e.target_entity_type)
+        ti.append(e.target_entity_id)
+        tms.append(e.event_time_millis)
+        pj.append(e.properties.to_json() if len(e.properties) else None)
+    return columnar_from_columns(
+        dicts, ev, et, ei, tt, ti,
+        np.asarray(tms, dtype=np.int64), pj, float_props=float_props)
+
+
+# ---------------------------------------------------------------------------
+# On-disk segment log (the persistent sidecar backends cache into)
+# ---------------------------------------------------------------------------
+
+_COLS = ("event", "entity_type", "entity_id", "target_type", "target_id",
+         "event_time", "props_offsets", "props_blob")
+_DICTS = ("event_names", "entity_types", "entity_ids", "target_types",
+          "target_ids")
+
+
+class SegmentLog:
+    """Immutable columnar segments + manifest for one event log.
+
+    Directory layout::
+
+        <dir>/manifest.json        {"watermark": ..., "count": N,
+                                    "float_props": [...], "segments": [...]}
+        <dir>/dict_<name>.txt      newline-separated dictionary values
+        <dir>/seg-<k>/<col>.npy    one numpy file per column (mmap-read)
+
+    Appends are atomic: segment dir + dicts written first, the manifest
+    (the commit point) replaced last. Readers mmap columns, so loading a
+    20M-event log costs page-cache reads, not JSON parsing.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @contextlib.contextmanager
+    def lock(self):
+        """Cross-process exclusive lock over sidecar mutation (append /
+        rebuild): two processes syncing the same delta must not interleave
+        dict appends or claim the same segment name."""
+        os.makedirs(self.path, exist_ok=True)
+        if fcntl is None:
+            yield
+            return
+        with open(os.path.join(self.path, ".lock"), "a") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+
+    # -- manifest ----------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.path, "manifest.json")
+
+    def read_manifest(self) -> Optional[dict]:
+        try:
+            with open(self._manifest_path(), "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _write_manifest(self, manifest: dict) -> None:
+        tmp = self._manifest_path() + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self._manifest_path())
+
+    # -- dicts -------------------------------------------------------------
+    def _read_dicts(self) -> ColumnarDicts:
+        d = ColumnarDicts()
+        for name in _DICTS:
+            p = os.path.join(self.path, f"dict_{name}.txt")
+            if os.path.exists(p):
+                with open(p, "r", encoding="utf-8") as f:
+                    raw = f.read()
+                values = raw.split("\n")[:-1] if raw else []
+                # one JSON string per line: unambiguous for values
+                # containing newlines/backslashes
+                setattr(d, name, StringDict([json.loads(v)
+                                             for v in values]))
+        return d
+
+    def _write_dicts(self, dicts: ColumnarDicts,
+                     prev_counts: Dict[str, int]) -> None:
+        """Append-only dict growth: only new values are written."""
+        for name in _DICTS:
+            sd: StringDict = getattr(dicts, name)
+            start = prev_counts.get(name, 0)
+            if len(sd) == start:
+                continue
+            p = os.path.join(self.path, f"dict_{name}.txt")
+            with open(p, "a", encoding="utf-8") as f:
+                for v in sd.values[start:]:
+                    f.write(json.dumps(v) + "\n")
+
+    # -- segments ----------------------------------------------------------
+    def append(self, batch: ColumnarBatch, watermark,
+               prev_dict_counts: Dict[str, int]) -> None:
+        """Write ``batch`` as a new segment and commit the manifest."""
+        os.makedirs(self.path, exist_ok=True)
+        manifest = self.read_manifest() or {
+            "count": 0, "segments": [], "float_props": [],
+            "watermark": None}
+        seg_name = f"seg-{len(manifest['segments']):06d}"
+        seg_dir = os.path.join(self.path, seg_name)
+        os.makedirs(seg_dir, exist_ok=True)
+        for col in _COLS:
+            np.save(os.path.join(seg_dir, f"{col}.npy"),
+                    getattr(batch, col), allow_pickle=False)
+        for name, arr in batch.float_props.items():
+            np.save(os.path.join(seg_dir, f"prop_{name}.npy"), arr,
+                    allow_pickle=False)
+        self._write_dicts(batch.dicts, prev_dict_counts)
+        manifest["segments"].append({"name": seg_name, "n": batch.n})
+        manifest["count"] += batch.n
+        manifest["watermark"] = watermark
+        manifest["float_props"] = sorted(
+            set(manifest["float_props"]) | set(batch.float_props))
+        self._write_manifest(manifest)
+
+    def load(self, mmap: bool = True) -> Tuple[Optional[ColumnarBatch],
+                                               Optional[dict]]:
+        """(batch, manifest) — batch columns mmap the segment files."""
+        manifest = self.read_manifest()
+        if manifest is None:
+            return None, None
+        dicts = self._read_dicts()
+        mode = "r" if mmap else None
+        parts: List[ColumnarBatch] = []
+        for seg in manifest["segments"]:
+            seg_dir = os.path.join(self.path, seg["name"])
+
+            def col(name: str) -> np.ndarray:
+                return np.load(os.path.join(seg_dir, f"{name}.npy"),
+                               mmap_mode=mode, allow_pickle=False)
+
+            parts.append(ColumnarBatch(
+                event=col("event"), entity_type=col("entity_type"),
+                entity_id=col("entity_id"), target_type=col("target_type"),
+                target_id=col("target_id"), event_time=col("event_time"),
+                props_offsets=col("props_offsets"),
+                props_blob=col("props_blob"),
+                float_props={name: col(f"prop_{name}")
+                             for name in manifest["float_props"]
+                             if os.path.exists(os.path.join(
+                                 seg_dir, f"prop_{name}.npy"))},
+                dicts=dicts))
+        if not parts:
+            return ColumnarBatch.empty(dicts), manifest
+        return ColumnarBatch.concat(parts), manifest
+
+    def dicts_and_counts(self) -> Tuple[ColumnarDicts, Dict[str, int]]:
+        d = self._read_dicts()
+        return d, d.counts()
+
+    def invalidate(self) -> None:
+        """Drop the sidecar's contents (deletes/compaction changed
+        history). The manifest — the commit point — goes first; the
+        ``.lock`` file stays so waiters keep a valid inode."""
+        import shutil
+        if not os.path.isdir(self.path):
+            return
+        with contextlib.suppress(OSError):
+            os.remove(self._manifest_path())
+        for name in os.listdir(self.path):
+            if name == ".lock":
+                continue
+            p = os.path.join(self.path, name)
+            with contextlib.suppress(OSError):
+                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)

@@ -137,6 +137,21 @@ class EventStore(abc.ABC):
         """Stream events matching the filter, in event-time order
         (reversed when ``filter.reversed``)."""
 
+    def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
+                      filter: EventFilter = EventFilter(),
+                      float_props: Sequence[str] = ("rating",),
+                      ordered: bool = True, with_props: bool = True):
+        """Bulk columnar read — the ``PEvents`` role
+        (``data/.../storage/PEvents.scala:38-189``): the whole matching log
+        as dictionary-encoded numpy columns ready for device transfer,
+        instead of a per-event Python object stream. Backends with a
+        persistent columnar sidecar (SQLite) override this; the default
+        encodes from :meth:`find`, which is correct everywhere.
+        """
+        from ..columnar import columnar_from_events
+        return columnar_from_events(self.find(app_id, channel_id, filter),
+                                    float_props=float_props)
+
     def aggregate_properties(
             self, app_id: int, channel_id: Optional[int] = None,
             *, entity_type: str, start_time: Optional[datetime] = None,

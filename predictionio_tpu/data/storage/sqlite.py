@@ -49,6 +49,9 @@ class SQLiteClient:
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.lock = threading.RLock()
+        #: in-process columnar sidecar cache: table → (batch, watermark,
+        #: count) — revalidated against the row store on every bulk read
+        self.columnar_cache: dict = {}
 
     def close(self) -> None:
         with self.lock:
@@ -72,11 +75,20 @@ class SQLiteEventStore(EventStore):
     def _conn(self) -> sqlite3.Connection:
         return self.client.conn
 
+    #: the event columns in canonical order (queries never SELECT * — the
+    #: leading ``seq`` column is bookkeeping, not event data)
+    EVENT_COLS = ("id, event, entity_type, entity_id, target_entity_type, "
+                  "target_entity_id, properties, event_time, tags, pr_id, "
+                  "creation_time")
+
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
+            table = _table(app_id, channel_id)
+            self._migrate_legacy(table)
             self._conn.execute(f"""
-                CREATE TABLE IF NOT EXISTS {_table(app_id, channel_id)} (
-                    id TEXT PRIMARY KEY,
+                CREATE TABLE IF NOT EXISTS {table} (
+                    seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                    id TEXT UNIQUE NOT NULL,
                     event TEXT NOT NULL,
                     entity_type TEXT NOT NULL,
                     entity_id TEXT NOT NULL,
@@ -89,16 +101,64 @@ class SQLiteEventStore(EventStore):
                     creation_time INTEGER NOT NULL
                 )""")
             self._conn.execute(
-                f"CREATE INDEX IF NOT EXISTS idx_{_table(app_id, channel_id)}_t "
-                f"ON {_table(app_id, channel_id)} (event_time)")
+                f"CREATE INDEX IF NOT EXISTS idx_{table}_t "
+                f"ON {table} (event_time)")
             self._conn.commit()
         return True
+
+    def _migrate_legacy(self, table: str) -> None:
+        """Round-1 tables used the implicit rowid, which SQLite *reuses*
+        after deletes — that falsifies the columnar sidecar's monotonic
+        watermark (a reused rowid can make a changed prefix look
+        unchanged). Rebuild such tables around an AUTOINCREMENT ``seq``,
+        which is guaranteed never to be reused."""
+        row = self._conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
+            (table,)).fetchone()
+        if row is None:
+            return
+        cols = [r[1] for r in
+                self._conn.execute(f"PRAGMA table_info({table})")]
+        if "seq" in cols:
+            return
+        tmp = f"{table}_legacy"
+        self._conn.execute(f"ALTER TABLE {table} RENAME TO {tmp}")
+        self._conn.execute(f"""
+            CREATE TABLE {table} (
+                seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                id TEXT UNIQUE NOT NULL,
+                event TEXT NOT NULL,
+                entity_type TEXT NOT NULL,
+                entity_id TEXT NOT NULL,
+                target_entity_type TEXT,
+                target_entity_id TEXT,
+                properties TEXT,
+                event_time INTEGER NOT NULL,
+                tags TEXT,
+                pr_id TEXT,
+                creation_time INTEGER NOT NULL
+            )""")
+        self._conn.execute(
+            f"INSERT INTO {table} ({self.EVENT_COLS}) "
+            f"SELECT {self.EVENT_COLS} FROM {tmp} ORDER BY rowid")
+        self._conn.execute(f"DROP TABLE {tmp}")
+        self._conn.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{table}_t "
+            f"ON {table} (event_time)")
+        self._conn.commit()
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
             self._conn.execute(
                 f"DROP TABLE IF EXISTS {_table(app_id, channel_id)}")
             self._conn.commit()
+            self.client.columnar_cache.pop(_table(app_id, channel_id), None)
+        d = self._columnar_dir(app_id, channel_id)
+        if d is not None:
+            from ..columnar import SegmentLog
+            log = SegmentLog(d)
+            with log.lock():
+                log.invalidate()
         return True
 
     def close(self) -> None:
@@ -120,27 +180,198 @@ class SQLiteEventStore(EventStore):
                 e.properties.to_json(), to_millis(e.event_time),
                 json.dumps(list(e.tags)), e.pr_id,
                 to_millis(e.creation_time)))
+        sql = (f"INSERT OR REPLACE INTO {_table(app_id, channel_id)} "
+               f"({self.EVENT_COLS}) VALUES (?,?,?,?,?,?,?,?,?,?,?)")
         with self.client.lock:
             try:
-                self._conn.executemany(
-                    f"INSERT OR REPLACE INTO {_table(app_id, channel_id)} "
-                    f"VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+                self._conn.executemany(sql, rows)
             except sqlite3.OperationalError as e:
                 if "no such table" not in str(e):
                     raise
                 self.init(app_id, channel_id)
-                self._conn.executemany(
-                    f"INSERT OR REPLACE INTO {_table(app_id, channel_id)} "
-                    f"VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+                self._conn.executemany(sql, rows)
             self._conn.commit()
         return ids
+
+    # -- columnar bulk reads (PEvents role) --------------------------------
+    #: rows per columnar segment during sidecar sync
+    COLUMNAR_CHUNK = 2_000_000
+
+    def _columnar_dir(self, app_id: int,
+                      channel_id: Optional[int]) -> Optional[str]:
+        if self.client.path == ":memory:":
+            return None
+        return os.path.join(f"{self.client.path}.columnar",
+                            _table(app_id, channel_id))
+
+    def _scalar(self, sql: str, *params) -> Optional[int]:
+        with self.client.lock:
+            try:
+                row = self._conn.execute(sql, params).fetchone()
+            except sqlite3.OperationalError as e:
+                if "no such table" in str(e):
+                    return None
+                raise
+        return row[0] if row else None
+
+    def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
+                      filter: EventFilter = EventFilter(),
+                      float_props=("rating",),
+                      ordered: bool = True, with_props: bool = True):
+        """Columnar bulk read backed by a persistent segment sidecar
+        (``<db>.columnar/<table>/``): the row store stays authoritative;
+        immutable numpy segments are synced forward by rowid watermark and
+        mmap-loaded, so training-scale scans run at memory bandwidth
+        instead of per-row Python (the ``JDBCPEvents.scala:49-89``
+        partitioned-scan role)."""
+        d = self._columnar_dir(app_id, channel_id)
+        if d is None:  # :memory: database — encode per call
+            return super().find_columnar(app_id, channel_id, filter,
+                                         float_props)
+        batch = self._sync_columnar(d, app_id, channel_id,
+                                    tuple(float_props))
+        return batch.select(filter, ordered=ordered, with_props=with_props)
+
+    def _change_stamp(self) -> tuple:
+        """(data_version, total_changes): moves whenever this connection —
+        or any other process — writes the database. Stable stamp ⇒ the
+        cached columnar view is provably current without paying the O(n)
+        prefix-count validity query per read."""
+        with self.client.lock:
+            dv = self._conn.execute("PRAGMA data_version").fetchone()[0]
+            return dv, self._conn.total_changes
+
+    def _sync_columnar(self, sidecar_dir: str, app_id: int,
+                       channel_id: Optional[int], float_props: tuple):
+        from ..columnar import (
+            ColumnarBatch,
+            SegmentLog,
+            columnar_from_columns,
+        )
+
+        table = _table(app_id, channel_id)
+        stamp = self._change_stamp()
+        cached = self.client.columnar_cache.get(table)
+        if cached is not None and cached[2] == stamp:
+            return cached[1]
+        with self.client.lock:
+            self._migrate_legacy(table)  # watermark needs AUTOINCREMENT seq
+        log = SegmentLog(sidecar_dir)
+        with log.lock():
+            manifest = log.read_manifest()
+            wm = int((manifest or {}).get("watermark") or 0)
+            count = int((manifest or {}).get("count") or 0)
+            if manifest is not None:
+                # deletes / REPLACEd rows below the watermark falsify the
+                # segments; rebuild from scratch when the prefix changed
+                # (seq is AUTOINCREMENT: never reused, so this check is
+                # sound against delete-then-reinsert races)
+                prefix = self._scalar(
+                    f"SELECT COUNT(*) FROM {table} WHERE seq<=?", wm)
+                if prefix != count:
+                    log.invalidate()
+                    manifest, wm, count = None, 0, 0
+            max_seq = self._scalar(
+                f"SELECT COALESCE(MAX(seq),0) FROM {table}")
+            if max_seq is None:  # table never created
+                return ColumnarBatch.empty()
+            if max_seq > wm:
+                self._encode_delta(log, table, wm, float_props)
+            manifest = log.read_manifest()
+            key = ((manifest or {}).get("watermark"),
+                   (manifest or {}).get("count"),
+                   len((manifest or {}).get("segments") or ()))
+            # stamp taken BEFORE the validity queries: a write racing the
+            # sync makes the stamp stale, forcing revalidation next call
+            if cached is not None and cached[0] == key:
+                batch = cached[1]
+            else:
+                batch, _ = log.load()
+                if batch is None:
+                    batch = ColumnarBatch.empty()
+            self.client.columnar_cache[table] = (key, batch, stamp)
+            return batch
+
+    def _encode_delta(self, log, table: str, watermark: int,
+                      float_props: tuple) -> None:
+        """Encode rows above ``watermark`` into new segments. Numeric
+        property extraction is pushed into SQL (``json_extract``)."""
+        import numpy as np
+
+        from ..columnar import columnar_from_columns
+
+        safe_props = [p for p in float_props
+                      if p.replace("_", "").isalnum()]
+        # json_type gate: only real JSON numbers become ratings — a string
+        # "N/A" or a bool must come back NULL (matching the lazy-parse
+        # path's isinstance check), never be CAST-coerced to 0.0/1.0
+        prop_sql = "".join(
+            f", CASE WHEN json_type(properties, '$.{p}') IN "
+            f"('integer','real') THEN "
+            f"json_extract(properties, '$.{p}') END"
+            for p in safe_props)
+        dicts, prev_counts = log.dicts_and_counts()
+        while True:
+            with self.client.lock:
+                rows = self._conn.execute(
+                    f"SELECT seq, event, entity_type, entity_id, "
+                    f"target_entity_type, target_entity_id, properties, "
+                    f"event_time{prop_sql} FROM {table} "
+                    f"WHERE seq>? ORDER BY seq LIMIT ?",
+                    (watermark, self.COLUMNAR_CHUNK)).fetchall()
+            if not rows:
+                return
+            cols = list(zip(*rows))
+            watermark = int(cols[0][-1])
+            fpv = {}
+            for j, p in enumerate(safe_props):
+                raw = cols[8 + j]
+                fpv[p] = np.array(
+                    [v if isinstance(v, (int, float)) else np.nan
+                     for v in raw], dtype=np.float64)
+            batch = columnar_from_columns(
+                dicts, cols[1], cols[2], cols[3], cols[4], cols[5],
+                np.asarray(cols[7], dtype=np.int64), cols[6],
+                float_props=tuple(safe_props), float_prop_values=fpv)
+            log.append(batch, watermark=watermark,
+                       prev_dict_counts=prev_counts)
+            prev_counts = dicts.counts()
+            if len(rows) < self.COLUMNAR_CHUNK:
+                return
+
+    def aggregate_properties(self, app_id: int,
+                             channel_id: Optional[int] = None, *,
+                             entity_type: str, start_time=None,
+                             until_time=None, required=None):
+        """Columnar aggregation: filter pushdown runs as vectorized masks
+        over the sidecar; only surviving ``$set/$unset/$delete`` rows pay
+        Python-level merges (``PEventAggregator.scala:196-210`` role)."""
+        d = self._columnar_dir(app_id, channel_id)
+        if d is None:
+            return super().aggregate_properties(
+                app_id, channel_id, entity_type=entity_type,
+                start_time=start_time, until_time=until_time,
+                required=required)
+        from ..aggregation import AGGREGATION_EVENTS, aggregate_from_columnar
+        batch = self._sync_columnar(d, app_id, channel_id, ("rating",))
+        sub = batch.select(EventFilter(
+            entity_type=entity_type, start_time=start_time,
+            until_time=until_time,
+            event_names=list(AGGREGATION_EVENTS)), ordered=False)
+        result = aggregate_from_columnar(sub)
+        if required:
+            req = set(required)
+            result = {k: v for k, v in result.items()
+                      if req <= set(v.keys())}
+        return result
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         with self.client.lock:
             try:
                 cur = self._conn.execute(
-                    f"SELECT * FROM {_table(app_id, channel_id)} WHERE id=?",
+                    f"SELECT {self.EVENT_COLS} FROM "
+                    f"{_table(app_id, channel_id)} WHERE id=?",
                     (event_id,))
                 row = cur.fetchone()
             except sqlite3.OperationalError as e:
@@ -197,7 +428,8 @@ class SQLiteEventStore(EventStore):
         if filter.limit is not None and filter.limit >= 0:
             lim = " LIMIT ?"
             params.append(filter.limit)
-        sql = f"SELECT * FROM {_table(app_id, channel_id)}{where}{order}{lim}"
+        sql = (f"SELECT {self.EVENT_COLS} FROM "
+               f"{_table(app_id, channel_id)}{where}{order}{lim}")
         with self.client.lock:
             try:
                 cur = self._conn.execute(sql, params)

@@ -66,6 +66,32 @@ class EventStoreFacade:
             target_entity_id=target_entity_id, limit=limit,
             reversed=reversed))
 
+    # -- columnar bulk reads (PEventStore.find as RDD, PEvents.scala:38) ---
+    def find_columnar(self, app_name: str,
+                      channel_name: Optional[str] = None,
+                      start_time: Optional[datetime] = None,
+                      until_time: Optional[datetime] = None,
+                      entity_type: Optional[str] = None,
+                      entity_id: Optional[str] = None,
+                      event_names: Optional[Sequence[str]] = None,
+                      target_entity_type=ANY, target_entity_id=ANY,
+                      float_props: Sequence[str] = ("rating",),
+                      ordered: bool = True, with_props: bool = True):
+        """The training-read path: the matching events as a
+        :class:`~predictionio_tpu.data.columnar.ColumnarBatch` (dict-encoded
+        numpy columns, vectorized filter pushdown) instead of an ``Event``
+        stream — what ``PEventStore.find``'s RDD was to the reference."""
+        app_id, channel_id = self.resolve(app_name, channel_name)
+        return self.storage.events().find_columnar(
+            app_id, channel_id, EventFilter(
+                start_time=start_time, until_time=until_time,
+                entity_type=entity_type, entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id),
+            float_props=float_props, ordered=ordered,
+            with_props=with_props)
+
     # -- property aggregation (PEventStore.aggregateProperties, :99) -------
     def aggregate_properties(
             self, app_name: str, entity_type: str,

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.ragged import PaddedHistories
+from ..ops.ragged import PaddedHistories, SplitHistories
 from ..ops.solve import gramian, solve_spd_batch
 
 #: PartitionSpec sharding rows over every mesh axis (ALS flattens the
@@ -52,7 +52,10 @@ class ALSParams:
 
     rank: int = 10
     num_iterations: int = 10
-    reg: float = 0.01          # "lambda" in engine.json
+    #: regularization — "lambda" in the reference's engine.json; the wire
+    #: alias keeps those variant files working verbatim
+    reg: float = field(default=0.01,
+                       metadata={"aliases": ("lambda", "lambda_")})
     alpha: float = 1.0         # implicit confidence scale
     implicit_prefs: bool = False
     seed: int = 3
@@ -63,12 +66,24 @@ class ALSParams:
     #: with f32 accumulation (the TPU-native mixed-precision idiom);
     #: factors and solves stay f32.
     matmul_dtype: str = "float32"
+    #: History layout. "pad": one [n_rows, L] padded matrix per side
+    #: (entries beyond L are DROPPED — round-1 semantics). "split": rows
+    #: longer than L become multiple virtual rows whose normal-equation
+    #: partials are scatter-added back, so every rating trains (MLlib
+    #: parity — ``ALSAlgorithm.scala:75-85``). "auto": pad when nothing
+    #: would be dropped (or when max_history explicitly caps), split
+    #: otherwise.
+    history_mode: str = "auto"
 
     def __post_init__(self):
         if self.matmul_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"matmul_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.matmul_dtype!r}")
+        if self.history_mode not in ("auto", "pad", "split"):
+            raise ValueError(
+                f"history_mode must be 'auto', 'pad' or 'split', got "
+                f"{self.history_mode!r}")
 
 
 @jax.tree_util.register_dataclass
@@ -153,6 +168,105 @@ def _update_block(fixed: jax.Array, G, indices: jax.Array,
 _gramian_jit = jax.jit(gramian)
 
 
+@functools.partial(jax.jit, static_argnames=("implicit", "bf16"),
+                   donate_argnums=(5, 6))
+def _partials_block(fixed: jax.Array, indices: jax.Array,
+                    values: jax.Array, counts: jax.Array,
+                    row_ids: jax.Array, A_acc: jax.Array,
+                    b_acc: jax.Array, alpha: float, implicit: bool,
+                    bf16: bool = False):
+    """Split-mode half of :func:`_update_block`: per-VIRTUAL-row partials
+    Σ w·ffᵀ and Σ w·f, scatter-added onto the owning real rows.
+    Sentinel/padding virtual rows contribute exactly zero (their valid
+    mask is all-zero), so out-of-range ids are safe under mode="drop"."""
+    r = fixed.shape[-1]
+    L = indices.shape[-1]
+    valid = (jnp.arange(L)[None, None, :]
+             < counts[:, :, None]).astype(jnp.float32)
+    F = fixed[indices]  # [d, B, L, r]
+
+    def outer(Fm, w):
+        if bf16:
+            Fw = (Fm * w[..., None]).astype(jnp.bfloat16)
+            Fc = Fm.astype(jnp.bfloat16)
+            return jnp.einsum("dnlr,dnls->dnrs", Fw, Fc,
+                              preferred_element_type=jnp.float32)
+        return jnp.einsum("dnlr,dnls,dnl->dnrs", Fm, Fm, w)
+
+    if implicit:
+        c1 = alpha * values * valid
+        A_v = outer(F, c1)
+        b_v = jnp.einsum("dnlr,dnl->dnr", F, (c1 + 1.0) * valid)
+    else:
+        A_v = outer(F, valid)
+        b_v = jnp.einsum("dnlr,dnl->dnr", F, values * valid)
+    ids = row_ids.reshape(-1)
+    A_acc = A_acc.at[ids].add(A_v.reshape(-1, r, r), mode="drop")
+    b_acc = b_acc.at[ids].add(b_v.reshape(-1, r), mode="drop")
+    return A_acc, b_acc
+
+
+@functools.partial(jax.jit, static_argnames=("implicit", "scale_reg"))
+def _solve_accumulated(A_acc: jax.Array, b_acc: jax.Array,
+                       G, real_counts: jax.Array, reg: float,
+                       implicit: bool, scale_reg: bool) -> jax.Array:
+    """Finish a split-mode half-step: implicit baseline Gramian (added
+    once per real row, after accumulation), ALS-WR regularization from
+    TRUE row totals, one batched SPD solve. Rows with no ratings keep
+    b=0 and solve to exactly 0 — identical to the pad path's padding."""
+    r = A_acc.shape[-1]
+    A = A_acc + G[None] if implicit else A_acc
+    reg_n = reg * jnp.maximum(real_counts.astype(jnp.float32), 1.0) \
+        if scale_reg else jnp.full(real_counts.shape, reg,
+                                   dtype=jnp.float32)
+    A = A + reg_n[:, None, None] * jnp.eye(r, dtype=A.dtype)
+    return solve_spd_batch(A, b_acc)
+
+
+_zeros_factories: dict = {}
+
+
+def _zeros_sharded(shape, mesh: Optional[Mesh], spec: P) -> jax.Array:
+    """Device-side zeros with the right sharding, via a cached compiled
+    factory — a fresh ``jax.jit(lambda)`` per call would re-trace (and
+    re-compile) the allocation on every half-iteration."""
+    key = (shape, mesh, spec if mesh is not None else None)
+    fn = _zeros_factories.get(key)
+    if fn is None:
+        if mesh is None:
+            fn = jax.jit(lambda: jnp.zeros(shape, jnp.float32))
+        else:
+            fn = jax.jit(lambda: jnp.zeros(shape, jnp.float32),
+                         out_shardings=NamedSharding(mesh, spec))
+        _zeros_factories[key] = fn
+    return fn()
+
+
+def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
+                       block_rows: int) -> jax.Array:
+    """One half-iteration in split mode. Accumulators live row-sharded
+    like the factors; virtual-row blocks bound the [B, L, r] gather temp
+    exactly as the pad path does."""
+    implicit = params.implicit_prefs
+    G = _gramian_jit(fixed) if implicit else None
+    d, n_vper, L = sh["idx"].shape
+    n_pad = sh["real_cnt"].shape[0]
+    r = fixed.shape[-1]
+    A_acc = _zeros_sharded((n_pad, r, r), sh["mesh"], ROWS)
+    b_acc = _zeros_sharded((n_pad, r), sh["mesh"], ROWS)
+    for s in range(0, n_vper, block_rows):
+        e = min(s + block_rows, n_vper)
+        A_acc, b_acc = _partials_block(
+            fixed, sh["idx"][:, s:e], sh["val"][:, s:e],
+            sh["cnt"][:, s:e], sh["rid"][:, s:e], A_acc, b_acc,
+            params.alpha, implicit,
+            bf16=(params.matmul_dtype == "bfloat16"))
+    if G is None:
+        G = jnp.zeros((r, r), jnp.float32)  # static arg shape filler
+    return _solve_accumulated(A_acc, b_acc, G, sh["real_cnt"], params.reg,
+                              implicit, params.scale_reg_by_count)
+
+
 def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
                  counts: jax.Array, params: "ALSParams",
                  block_rows: int) -> jax.Array:
@@ -216,19 +330,79 @@ def _blocked(h: PaddedHistories, n_dev: int, mesh: Optional[Mesh]) -> dict:
     }
 
 
+def _blocked_split(sh: SplitHistories, n_dev: int,
+                   mesh: Optional[Mesh]) -> dict:
+    """Split-mode device layout: virtual-row arrays blocked like
+    :func:`_blocked`; real-row accumulator metadata stays flat+sharded."""
+    n_vper = sh.n_virtual // n_dev
+    spec = P(("data", "model"))
+    return {
+        "mode": "split",
+        "mesh": mesh,
+        "idx": _shard(sh.indices.reshape(n_dev, n_vper, sh.max_len),
+                      mesh, spec),
+        "val": _shard(sh.values.reshape(n_dev, n_vper, sh.max_len),
+                      mesh, spec),
+        "cnt": _shard(sh.counts.reshape(n_dev, n_vper), mesh, spec),
+        "rid": _shard(sh.row_ids.reshape(n_dev, n_vper), mesh, spec),
+        "real_cnt": _shard(sh.real_counts, mesh, ROWS),
+    }
+
+
+def auto_split_len(counts: np.ndarray) -> int:
+    """Pick the split-mode padded length: the power-of-two L in [32, 8192]
+    minimizing total padded entries Σ ⌈c/L⌉·L (padding waste vs
+    virtual-row count both fall out of this objective; ties → larger L =
+    fewer scatter rows)."""
+    best_L, best_total = 32, None
+    c = counts[counts > 0]
+    if c.size == 0:
+        return 32
+    for p in range(5, 14):  # 32 .. 8192
+        L = 1 << p
+        total = int((-(-c // L) * L).sum())
+        if best_total is None or total <= best_total:
+            best_L, best_total = L, total
+    return best_L
+
+
 def _pack(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
-          n_rows: int, max_history, n_dev: int) -> PaddedHistories:
+          n_rows: int, params: "ALSParams", n_dev: int):
     """History packing for one side; the sort/scatter runs on device
     (host numpy packing costs ~10s at MovieLens-20M scale — hard part 2
     of SURVEY §7 is exactly this host round-trip, so it's eliminated).
-    The padded length is resolved host-side from a cheap bincount when no
-    cap is set (same auto-cap policy as the host packer)."""
-    from ..ops.ragged import pack_histories_device, resolve_max_len
+    Layout choice (``history_mode``): pad when nothing would drop, split
+    when skew would otherwise truncate entries (drop-free, MLlib parity).
+    """
+    from ..ops.ragged import (
+        AUTO_CAP_ENTRIES,
+        pack_histories_device,
+        pack_histories_split_device,
+        resolve_max_len,
+    )
 
+    max_history = params.max_history
+    mode = params.history_mode
+    counts = None
+    if mode == "auto":
+        if max_history is not None:
+            mode = "pad"  # an explicit cap keeps round-1 semantics
+        else:
+            counts = np.bincount(rows, minlength=n_rows)
+            L_full = int(counts.max(initial=1))
+            mode = "pad" if n_rows * L_full <= AUTO_CAP_ENTRIES else "split"
+    if mode == "split":
+        if counts is None:
+            counts = np.bincount(rows, minlength=n_rows)
+        L = int(max_history) if max_history is not None \
+            else auto_split_len(counts)
+        return pack_histories_split_device(rows, cols, vals, n_rows,
+                                           max(L, 1), pad_rows_to=n_dev)
     if max_history is not None:
         L = int(max_history)
     else:
-        counts = np.bincount(rows, minlength=n_rows)
+        counts = np.bincount(rows, minlength=n_rows) if counts is None \
+            else counts
         L = resolve_max_len(counts, n_rows, None)
     return pack_histories_device(rows, cols, vals, n_rows, max(L, 1),
                                  pad_rows_to=n_dev)
@@ -244,9 +418,9 @@ def pack_ratings(ratings: RatingsCOO, params: ALSParams,
     ``train_als`` call so retrains skip the transfer + sort."""
     n_dev = 1 if mesh is None else mesh.devices.size
     user_h = _pack(ratings.users, ratings.items, ratings.ratings,
-                   ratings.n_users, params.max_history, n_dev)
+                   ratings.n_users, params, n_dev)
     item_h = _pack(ratings.items, ratings.users, ratings.ratings,
-                   ratings.n_items, params.max_history, n_dev)
+                   ratings.n_items, params, n_dev)
     return user_h, item_h
 
 
@@ -278,18 +452,27 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     user_h, item_h = packed if packed is not None else pack_ratings(
         ratings, params, mesh)
 
+    u_split = isinstance(user_h, SplitHistories)
+    i_split = isinstance(item_h, SplitHistories)
+    u_rows_pad = user_h.n_rows_padded if u_split else user_h.n_rows
+    i_rows_pad = item_h.n_rows_padded if i_split else item_h.n_rows
+
     ku, ki = jax.random.split(jax.random.key(params.seed))
-    U = _shard(_init_factors(ku, ratings.n_users, user_h.n_rows, params.rank),
+    U = _shard(_init_factors(ku, ratings.n_users, u_rows_pad, params.rank),
                mesh, ROWS)
-    V = _shard(_init_factors(ki, ratings.n_items, item_h.n_rows, params.rank),
+    V = _shard(_init_factors(ki, ratings.n_items, i_rows_pad, params.rank),
                mesh, ROWS)
-    uh = _blocked(user_h, n_dev, mesh)
-    ih = _blocked(item_h, n_dev, mesh)
+    uh = _blocked_split(user_h, n_dev, mesh) if u_split \
+        else _blocked(user_h, n_dev, mesh)
+    ih = _blocked_split(item_h, n_dev, mesh) if i_split \
+        else _blocked(item_h, n_dev, mesh)
 
     bu = params.block_rows or _auto_block_rows(
-        user_h.n_rows // n_dev, user_h.max_len, params.rank)
+        (user_h.n_virtual if u_split else user_h.n_rows) // n_dev,
+        user_h.max_len, params.rank)
     bi = params.block_rows or _auto_block_rows(
-        item_h.n_rows // n_dev, item_h.max_len, params.rank)
+        (item_h.n_virtual if i_split else item_h.n_rows) // n_dev,
+        item_h.max_len, params.rank)
 
     ckpt = None
     start = 0
@@ -315,24 +498,32 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             content.update(np.ascontiguousarray(arr[:k]).tobytes())
             content.update(np.ascontiguousarray(arr[-k:]).tobytes())
             content.update(np.float64(arr.sum(dtype=np.float64)).tobytes())
-        base = [
+        legacy_base = [
             params.rank, params.reg, params.alpha, params.implicit_prefs,
             params.seed, params.scale_reg_by_count, params.matmul_dtype,
             params.max_history,  # affects history truncation → trajectory
             ratings.n_users, ratings.n_items, len(ratings.users),
         ]
+        base = legacy_base + [params.history_mode]
         fingerprint = hashlib.sha256(_json.dumps(
             base + [content.hexdigest()]).encode()).hexdigest()[:16]
-        # pre-content-digest dirs (round-1 scheme) stay resumable: accept a
-        # legacy match once and upgrade the metadata in place
-        legacy = hashlib.sha256(_json.dumps(base).encode()).hexdigest()[:16]
+        # pre-content-digest dirs (round-1 scheme, no history_mode field)
+        # stay resumable — but ONLY when this run resolved to round-1 pad
+        # semantics on both sides: resuming a pad-trained checkpoint under
+        # the new drop-free split layout would silently continue a
+        # different objective
+        accepted = (fingerprint,)
+        if not (u_split or i_split):
+            accepted += (hashlib.sha256(
+                _json.dumps(legacy_base).encode()).hexdigest()[:16],)
         ckpt = Checkpointer(checkpoint_dir)
         meta = ckpt.get_metadata()
         if meta is not None \
-                and meta.get("fingerprint") not in (fingerprint, legacy):
+                and meta.get("fingerprint") not in accepted:
             raise ValueError(
                 f"checkpoint dir {checkpoint_dir} belongs to a different "
-                f"ALS run (params/dataset mismatch); use a fresh dir")
+                f"ALS run (params/dataset/history-layout mismatch); use "
+                f"a fresh dir")
         ckpt.set_metadata({"fingerprint": fingerprint})
         # resume from the largest step within this run's iteration budget
         steps = [s for s in ckpt.all_steps()
@@ -346,8 +537,12 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
 
     try:
         for it in range(start, params.num_iterations):
-            U = _update_side(V, uh["idx"], uh["val"], uh["cnt"], params, bu)
-            V = _update_side(U, ih["idx"], ih["val"], ih["cnt"], params, bi)
+            U = _update_side_split(V, uh, params, bu) if u_split \
+                else _update_side(V, uh["idx"], uh["val"], uh["cnt"],
+                                  params, bu)
+            V = _update_side_split(U, ih, params, bi) if i_split \
+                else _update_side(U, ih["idx"], ih["val"], ih["cnt"],
+                                  params, bi)
             if ckpt is not None:
                 ckpt.maybe_save(it + 1, {"U": U, "V": V},
                                 every=checkpoint_every)

@@ -82,6 +82,177 @@ def transpose_coo(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray
     return cols, rows, vals
 
 
+@dataclass(frozen=True)
+class SplitHistories:
+    """Row-split packing: every real row longer than ``max_len`` becomes
+    ⌈count/L⌉ *virtual rows* of up to L entries each, so **no entry is
+    ever dropped** regardless of skew (MLlib uses every rating —
+    ``ALSAlgorithm.scala:75-85``; a zipf item catalog must too). The ALS
+    update computes per-virtual-row normal-equation partials and
+    scatter-adds them onto the owning real row before solving.
+
+    ``indices/values`` are ``[n_virtual_pad, L]`` like
+    :class:`PaddedHistories`; ``counts`` holds per-*virtual*-row entry
+    counts; ``row_ids[v]`` is the real row owning virtual row v
+    (``n_rows`` sentinel on padding rows — scatter mode="drop" territory);
+    ``real_counts`` are true per-real-row totals (regularization scaling).
+    """
+
+    indices: np.ndarray      # [n_virtual_pad, L] int32
+    values: np.ndarray       # [n_virtual_pad, L] float32
+    counts: np.ndarray       # [n_virtual_pad] int32 (per virtual row)
+    row_ids: np.ndarray      # [n_virtual_pad] int32 → real row (or n_rows)
+    real_counts: np.ndarray  # [n_rows_pad] int32
+    n_rows: int              # real rows (unpadded)
+
+    @property
+    def n_virtual(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def n_rows_padded(self) -> int:
+        return self.real_counts.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.indices.shape[1]
+
+
+def split_layout(counts: np.ndarray, max_len: int,
+                 pad_rows_to: int = 1) -> Tuple[np.ndarray, int, int]:
+    """Host-side split bookkeeping: per-real-row virtual-row counts, the
+    total virtual rows, and the padded virtual row count. Split shapes are
+    data-dependent, so this must run on the host before the static-shape
+    device pack."""
+    groups = -(-counts // max_len)  # ceil; 0-count rows get 0 virtual rows
+    n_virtual = int(groups.sum())
+    n_vpad = max(((n_virtual + pad_rows_to - 1) // pad_rows_to)
+                 * pad_rows_to, pad_rows_to)
+    return groups.astype(np.int64), n_virtual, n_vpad
+
+
+def pack_histories_split(rows: np.ndarray, cols: np.ndarray,
+                         vals: np.ndarray, n_rows: int, max_len: int,
+                         pad_rows_to: int = 1) -> SplitHistories:
+    """Host-numpy split packing (see :class:`SplitHistories`)."""
+    L = max(int(max_len), 1)
+    order = np.argsort(rows, kind="stable")
+    rs, cs, vs = rows[order], cols[order], vals[order]
+    counts = np.bincount(rs, minlength=n_rows).astype(np.int64)
+    groups, n_virtual, n_vpad = split_layout(counts, L, pad_rows_to)
+    gstarts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(groups, out=gstarts[1:])
+
+    starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(len(rs)) - starts[rs]
+    vrow = gstarts[rs] + pos // L
+    vpos = pos % L
+
+    indices = np.zeros((n_vpad, L), dtype=np.int32)
+    values = np.zeros((n_vpad, L), dtype=np.float32)
+    indices[vrow, vpos] = cs
+    values[vrow, vpos] = vs
+
+    row_ids = np.full(n_vpad, n_rows, dtype=np.int32)
+    row_ids[:n_virtual] = np.repeat(
+        np.arange(n_rows, dtype=np.int32), groups)
+    vcounts = np.zeros(n_vpad, dtype=np.int32)
+    # entries in virtual row v of row r: min(L, count_r - k·L)
+    k_within = np.arange(n_virtual) - gstarts[row_ids[:n_virtual]]
+    vcounts[:n_virtual] = np.minimum(
+        counts[row_ids[:n_virtual]] - k_within * L, L).astype(np.int32)
+
+    n_rows_pad = max(((n_rows + pad_rows_to - 1) // pad_rows_to)
+                     * pad_rows_to, pad_rows_to)
+    real_counts = np.zeros(n_rows_pad, dtype=np.int32)
+    real_counts[:n_rows] = counts
+    return SplitHistories(indices=indices, values=values, counts=vcounts,
+                          row_ids=row_ids, real_counts=real_counts,
+                          n_rows=n_rows)
+
+
+def pack_histories_split_device(rows: np.ndarray, cols: np.ndarray,
+                                vals: np.ndarray, n_rows: int,
+                                max_len: int,
+                                pad_rows_to: int = 1) -> SplitHistories:
+    """Device-side split packing: the host computes only the cheap
+    bincount-derived layout (shapes must be static); the heavy sort +
+    scatters run as one jitted XLA program, mirroring
+    :func:`pack_histories_device`."""
+    import jax.numpy as jnp
+
+    L = max(int(max_len), 1)
+    counts_h = np.bincount(np.asarray(rows), minlength=n_rows)
+    groups, n_virtual, n_vpad = split_layout(counts_h, L, pad_rows_to)
+    n_rows_pad = max(((n_rows + pad_rows_to - 1) // pad_rows_to)
+                     * pad_rows_to, pad_rows_to)
+    idx, val, vcnt, row_ids, real_counts = _pack_split_on_device(
+        jnp.asarray(rows, dtype=jnp.int32),
+        jnp.asarray(cols, dtype=jnp.int32),
+        jnp.asarray(vals, dtype=jnp.float32),
+        jnp.asarray(groups, dtype=jnp.int32),
+        n_rows=n_rows, L=L, n_vpad=n_vpad, n_virtual=n_virtual,
+        n_rows_pad=n_rows_pad)
+    return SplitHistories(indices=idx, values=val, counts=vcnt,
+                          row_ids=row_ids, real_counts=real_counts,
+                          n_rows=n_rows)
+
+
+def _pack_split_on_device(r, c, v, groups, *, n_rows: int, L: int,
+                          n_vpad: int, n_virtual: int, n_rows_pad: int):
+    import jax
+
+    global _pack_split_jit
+    if _pack_split_jit is None:
+        import jax.numpy as jnp
+
+        def pack(r, c, v, groups, n_rows, L, n_vpad, n_virtual,
+                 n_rows_pad):
+            nnz = r.shape[0]
+            order = jnp.argsort(r, stable=True)
+            rs, cs, vs = r[order], c[order], v[order]
+            counts = jnp.bincount(rs, length=n_rows).astype(jnp.int32)
+            starts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(counts, dtype=jnp.int32)])
+            gstarts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(groups, dtype=jnp.int32)])
+            pos = jnp.arange(nnz, dtype=jnp.int32) - starts[rs]
+            vrow = gstarts[rs] + pos // L
+            vpos = pos % L
+            flat = vrow * jnp.int32(L) + vpos
+            idx = jnp.zeros(n_vpad * L, jnp.int32).at[flat].set(
+                cs, mode="drop")
+            val = jnp.zeros(n_vpad * L, jnp.float32).at[flat].set(
+                vs, mode="drop")
+            owners = jnp.repeat(jnp.arange(n_rows, dtype=jnp.int32),
+                                groups, total_repeat_length=n_virtual)
+            row_ids = jnp.full(n_vpad, n_rows, jnp.int32) \
+                .at[jnp.arange(n_virtual)].set(owners)
+            k_within = jnp.arange(n_vpad, dtype=jnp.int32) \
+                - gstarts[jnp.minimum(row_ids, n_rows - 1)]
+            vcnt = jnp.where(
+                row_ids < n_rows,
+                jnp.minimum(counts[jnp.minimum(row_ids, n_rows - 1)]
+                            - k_within * L, L), 0).astype(jnp.int32)
+            real_counts = jnp.zeros(n_rows_pad, jnp.int32).at[:n_rows].set(
+                counts)
+            return (idx.reshape(n_vpad, L), val.reshape(n_vpad, L), vcnt,
+                    row_ids, real_counts)
+
+        _pack_split_jit = jax.jit(
+            pack, static_argnames=("n_rows", "L", "n_vpad", "n_virtual",
+                                   "n_rows_pad"))
+    return _pack_split_jit(r, c, v, groups, n_rows=n_rows, L=L,
+                           n_vpad=n_vpad, n_virtual=n_virtual,
+                           n_rows_pad=n_rows_pad)
+
+
+_pack_split_jit = None
+
+
 def resolve_max_len(counts: np.ndarray, n_rows: int,
                     max_len: Optional[int]) -> int:
     """Padded history length: the explicit cap, or the longest row with

@@ -39,7 +39,7 @@ from ..models.als import (
     recommend_products,
     train_als,
 )
-from ..models.data import kfold_split, ratings_from_events
+from ..models.data import kfold_split, ratings_from_columnar
 
 
 # -- query/result schema (reference Query.scala / PredictedResult) ----------
@@ -122,13 +122,15 @@ class RecommendationDataSource(DataSource):
 
     def _read_ratings(self, ctx: Context):
         weights = self.params.event_weights
-        events = ctx.event_store.find(
+        batch = ctx.event_store.find_columnar(
             self.params.app_name or ctx.app_name,
             channel_name=self.params.channel_name,
             entity_type="user", target_entity_type="item",
             event_names=(list(weights) if weights is not None
-                         else ["rate", "buy"]))
-        return ratings_from_events(events, event_weights=weights)
+                         else ["rate", "buy"]),
+            # a bulk COO build needs neither time order nor raw JSON
+            ordered=False, with_props=False)
+        return ratings_from_columnar(batch, event_weights=weights)
 
     def read_training(self, ctx: Context) -> TrainingData:
         ratings, user_ids, item_ids = self._read_ratings(ctx)

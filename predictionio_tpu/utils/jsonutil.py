@@ -47,11 +47,17 @@ def from_jsonable(cls: Optional[Type], obj: Any) -> Any:
         raise ValueError(f"expected JSON object for {cls.__name__}, "
                          f"got {type(obj).__name__}")
     fields = {f.name: f for f in dataclasses.fields(cls)}
+    # declared wire aliases, e.g. ALSParams.reg carries
+    # metadata={"aliases": ("lambda",)} for engine.json compatibility
+    aliases = {a: f.name for f in dataclasses.fields(cls)
+               for a in f.metadata.get("aliases", ())}
     # the reference's wire format is camelCase (e.g. whiteList) while the
     # dataclasses are snake_case; accept both spellings on input
     normalized = {}
     for key, value in obj.items():
         name = key if key in fields else _snake_case(key)
+        if name in aliases:
+            name = aliases[name]
         if name not in fields and f"{name}_" in fields:
             name = f"{name}_"  # python-keyword fields, e.g. lambda → lambda_
         if name not in fields:

@@ -1,0 +1,453 @@
+"""Columnar bulk-read path (the PEvents analogue): encode/select/shard
+equivalence with the row path, the on-disk segment sidecar, and the
+SQLite-backed delta sync."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.columnar import (
+    ColumnarBatch,
+    ColumnarDicts,
+    SegmentLog,
+    StringDict,
+    columnar_from_events,
+)
+from predictionio_tpu.data.storage import App, EventFilter, Storage
+from predictionio_tpu.data.store import EventStoreFacade
+from predictionio_tpu.models.data import (
+    ratings_from_columnar,
+    ratings_from_events,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def synth_events(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    events = []
+    for k in range(n):
+        kind = rng.integers(0, 4)
+        t = T0 + timedelta(seconds=int(rng.integers(0, 100000)))
+        if kind == 0:
+            events.append(Event(
+                event="rate", entity_type="user",
+                entity_id=f"u{rng.integers(0, 40)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 30)}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                event_time=t))
+        elif kind == 1:
+            events.append(Event(
+                event="buy", entity_type="user",
+                entity_id=f"u{rng.integers(0, 40)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 30)}", event_time=t))
+        elif kind == 2:
+            events.append(Event(
+                event="$set", entity_type="item",
+                entity_id=f"i{rng.integers(0, 30)}",
+                properties=DataMap({"categories": ["c1"],
+                                    "price": float(rng.integers(1, 50))}),
+                event_time=t))
+        else:
+            events.append(Event(
+                event="view", entity_type="user",
+                entity_id=f"u{rng.integers(0, 40)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 30)}", event_time=t))
+    return events
+
+
+def proj(e: Event):
+    """The columnar projection of an event (no ids/tags/prId)."""
+    return (e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, tuple(sorted(e.properties.to_dict().items(),
+                                             key=str)), e.event_time_millis)
+
+
+class TestColumnarBatch:
+    def test_roundtrip(self):
+        events = synth_events(300)
+        batch = columnar_from_events(events)
+        back = list(batch.to_events())
+        assert [proj(e) for e in back] == [proj(e) for e in events]
+
+    def test_select_matches_row_filter(self):
+        events = synth_events(400, seed=1)
+        batch = columnar_from_events(events)
+        filters = [
+            EventFilter(event_names=["rate", "buy"]),
+            EventFilter(entity_type="user", target_entity_type="item"),
+            EventFilter(entity_id="u3"),
+            EventFilter(start_time=T0 + timedelta(seconds=20000),
+                        until_time=T0 + timedelta(seconds=80000)),
+            EventFilter(target_entity_type=None),
+            EventFilter(target_entity_id="i7"),
+            EventFilter(event_names=["rate"], reversed=True, limit=5),
+        ]
+        for f in filters:
+            rows = [e for e in events if f.matches(e)]
+            rows.sort(key=lambda e: e.event_time_millis,
+                      reverse=f.reversed)
+            if f.limit is not None:
+                rows = rows[: f.limit]
+            got = list(batch.select(f).to_events())
+            assert [proj(e) for e in got] == [proj(e) for e in rows], f
+
+    def test_unknown_filter_values_match_nothing(self):
+        batch = columnar_from_events(synth_events(50))
+        assert batch.select(EventFilter(entity_id="nope")).n == 0
+        assert batch.select(EventFilter(event_names=["ghost"])).n == 0
+
+    def test_shards_cover_everything(self):
+        batch = columnar_from_events(synth_events(101))
+        parts = [batch.shard(i, 4) for i in range(4)]
+        assert sum(p.n for p in parts) == batch.n
+        merged = ColumnarBatch.concat(parts)
+        assert [proj(e) for e in merged.to_events()] \
+            == [proj(e) for e in batch.to_events()]
+
+    def test_float_prop_extracted_and_lazy(self):
+        events = synth_events(200, seed=2)
+        batch = columnar_from_events(events, float_props=("rating",))
+        col = batch.float_props["rating"]
+        for i, e in enumerate(events):
+            want = e.properties.to_dict().get("rating")
+            if want is None:
+                assert np.isnan(col[i])
+            else:
+                assert col[i] == want
+        # a prop not extracted at encode time parses lazily from the blob
+        price = batch.float_prop("price")
+        for i, e in enumerate(events):
+            want = e.properties.to_dict().get("price")
+            assert (np.isnan(price[i]) if want is None
+                    else price[i] == want)
+
+    def test_string_dict_stable_codes(self):
+        sd = StringDict()
+        a = sd.encode(["x", "y", "x", None])
+        b = sd.encode(["z", "y"])
+        assert a.tolist() == [0, 1, 0, -1]
+        assert b.tolist() == [2, 1]
+        assert sd.values == ["x", "y", "z"]
+
+
+class TestRatingsFromColumnar:
+    def trips(self, coo, user_ids, item_ids):
+        inv_u, inv_i = user_ids.inverse, item_ids.inverse
+        return sorted((inv_u[int(u)], inv_i[int(i)], float(v))
+                      for u, i, v in zip(coo.users, coo.items, coo.ratings))
+
+    def test_matches_row_path(self):
+        events = [e for e in synth_events(600, seed=3)
+                  if e.event in ("rate", "buy", "view")]
+        events.sort(key=lambda e: e.event_time_millis)
+        batch = columnar_from_events(events)
+        for weights in (None, {"rate": None, "buy": 4.0, "view": 1.0},
+                        {"view": 1.0}):
+            coo_r, u_r, i_r = ratings_from_events(
+                iter(events), event_weights=weights)
+            coo_c, u_c, i_c = ratings_from_columnar(
+                batch, event_weights=weights)
+            assert self.trips(coo_c, u_c, i_c) \
+                == self.trips(coo_r, u_r, i_r), weights
+            assert set(u_c.keys()) == set(u_r.keys())
+            assert set(i_c.keys()) == set(i_r.keys())
+
+    def test_fixed_bimaps_drop_unknowns(self):
+        from predictionio_tpu.data.bimap import BiMap
+
+        events = [Event(event="buy", entity_type="user", entity_id=u,
+                        target_entity_type="item", target_entity_id=i,
+                        event_time=T0)
+                  for u, i in [("a", "x"), ("b", "y"), ("c", "x")]]
+        batch = columnar_from_events(events)
+        user_ids = BiMap({"a": 0, "b": 1})
+        item_ids = BiMap({"x": 0})
+        coo, _, _ = ratings_from_columnar(batch, user_ids=user_ids,
+                                          item_ids=item_ids)
+        assert self.trips(coo, user_ids, item_ids) == [("a", "x", 4.0)]
+
+
+class TestSegmentLog:
+    def test_append_load_roundtrip(self, tmp_path):
+        events = synth_events(250, seed=4)
+        dicts = ColumnarDicts()
+        b1 = columnar_from_events(events[:100], dicts)
+        log = SegmentLog(str(tmp_path / "log"))
+        log.append(b1, watermark=100, prev_dict_counts={})
+        counts = dicts.counts()
+        b2 = columnar_from_events(events[100:], dicts)
+        log.append(b2, watermark=250, prev_dict_counts=counts)
+        loaded, manifest = log.load()
+        assert manifest["count"] == 250
+        assert manifest["watermark"] == 250
+        assert [proj(e) for e in loaded.to_events()] \
+            == [proj(e) for e in events]
+
+    def test_dict_values_with_newlines_and_backslashes(self, tmp_path):
+        dicts = ColumnarDicts()
+        weird = ["a\nb", "c\\n", "d\\", "plain"]
+        events = [Event(event="buy", entity_type="user", entity_id=w,
+                        target_entity_type="item", target_entity_id="i",
+                        event_time=T0) for w in weird]
+        log = SegmentLog(str(tmp_path / "log"))
+        log.append(columnar_from_events(events, dicts), watermark=4,
+                   prev_dict_counts={})
+        loaded, _ = log.load()
+        assert [e.entity_id for e in loaded.to_events()] == weird
+
+    def test_invalidate(self, tmp_path):
+        log = SegmentLog(str(tmp_path / "log"))
+        log.append(columnar_from_events(synth_events(20)), watermark=20,
+                   prev_dict_counts={})
+        log.invalidate()
+        batch, manifest = log.load()
+        assert batch is None and manifest is None
+
+
+@pytest.fixture
+def sq(tmp_path):
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    app_id = storage.apps().insert(App(0, "colapp"))
+    storage.events().init(app_id)
+    return storage, app_id
+
+
+class TestSQLiteSidecar:
+    def check_matches_rows(self, storage, app_id):
+        es = storage.events()
+        rows = sorted(proj(e) for e in es.find(app_id))
+        cols = sorted(proj(e) for e in
+                      es.find_columnar(app_id).to_events())
+        assert cols == rows
+
+    def test_sync_delta_and_cache(self, sq, tmp_path):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(synth_events(120, seed=5), app_id)
+        self.check_matches_rows(storage, app_id)
+        sidecar = tmp_path / "pio.db.columnar"
+        assert sidecar.is_dir()
+        n_segs = len(list(sidecar.glob("*/seg-*")))
+        # new events -> one more segment, not a rebuild
+        es.insert_batch(synth_events(30, seed=6), app_id)
+        self.check_matches_rows(storage, app_id)
+        assert len(list(sidecar.glob("*/seg-*"))) == n_segs + 1
+
+    def test_delete_invalidates(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        ids = es.insert_batch(synth_events(50, seed=7), app_id)
+        self.check_matches_rows(storage, app_id)
+        es.delete(ids[3], app_id)
+        self.check_matches_rows(storage, app_id)
+
+    def test_replace_invalidates(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        ids = es.insert_batch(synth_events(50, seed=8), app_id)
+        self.check_matches_rows(storage, app_id)
+        # INSERT OR REPLACE of an existing id rewrites history
+        es.insert(Event(event="buy", entity_type="user", entity_id="uX",
+                        target_entity_type="item", target_entity_id="iX",
+                        event_time=T0, event_id=ids[0]), app_id)
+        self.check_matches_rows(storage, app_id)
+
+    def test_fresh_process_reuses_segments(self, sq, tmp_path):
+        storage, app_id = sq
+        storage.events().insert_batch(synth_events(80, seed=9), app_id)
+        self.check_matches_rows(storage, app_id)
+        # a second client (fresh process role) must load, not re-encode
+        cold = Storage(env={
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        })
+        sidecar = tmp_path / "pio.db.columnar"
+        segs_before = sorted(str(p) for p in sidecar.glob("*/seg-*"))
+        self.check_matches_rows(cold, app_id)
+        assert sorted(str(p) for p in sidecar.glob("*/seg-*")) \
+            == segs_before
+
+    def test_rating_prop_pushed_down(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(synth_events(60, seed=10), app_id)
+        batch = es.find_columnar(app_id)
+        assert "rating" in batch.float_props  # json_extract path
+        coo_c, u_c, i_c = ratings_from_columnar(
+            batch.select(EventFilter(event_names=["rate", "buy"],
+                                     entity_type="user")))
+        coo_r, u_r, i_r = ratings_from_events(
+            es.find(app_id, filter=EventFilter(
+                event_names=["rate", "buy"], entity_type="user")))
+        t = TestRatingsFromColumnar()
+        assert t.trips(coo_c, u_c, i_c) == t.trips(coo_r, u_r, i_r)
+
+    def test_facade_find_columnar(self, sq):
+        storage, app_id = sq
+        storage.events().insert_batch(synth_events(40, seed=11), app_id)
+        fac = EventStoreFacade(storage)
+        batch = fac.find_columnar("colapp", entity_type="user",
+                                  target_entity_type="item",
+                                  event_names=["rate", "buy"])
+        rows = list(fac.find("colapp", entity_type="user",
+                             target_entity_type="item",
+                             event_names=["rate", "buy"]))
+        assert sorted(proj(e) for e in batch.to_events()) \
+            == sorted(proj(e) for e in rows)
+
+
+class TestMemoryFallback:
+    def test_memory_backend_columnar(self):
+        storage = Storage(env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        app_id = storage.apps().insert(App(0, "memapp"))
+        es = storage.events()
+        es.init(app_id)
+        es.insert_batch(synth_events(70, seed=12), app_id)
+        rows = sorted(proj(e) for e in es.find(app_id))
+        cols = sorted(proj(e) for e in
+                      es.find_columnar(app_id).to_events())
+        assert cols == rows
+
+
+class TestColumnarAggregation:
+    def test_matches_row_aggregation(self, sq):
+        from datetime import timedelta
+
+        storage, app_id = sq
+        es = storage.events()
+        events = synth_events(300, seed=13)
+        # add $unset/$delete traffic so every op type is exercised
+        events += [
+            Event(event="$unset", entity_type="item", entity_id="i1",
+                  properties=DataMap({"price": None}),
+                  event_time=T0 + timedelta(days=40)),
+            Event(event="$delete", entity_type="item", entity_id="i2",
+                  event_time=T0 + timedelta(days=41)),
+            Event(event="$set", entity_type="item", entity_id="i2",
+                  properties=DataMap({"price": 9.0}),
+                  event_time=T0 + timedelta(days=42)),
+        ]
+        es.insert_batch(events, app_id)
+        from predictionio_tpu.data.aggregation import (
+            AGGREGATION_EVENTS,
+            aggregate_properties,
+        )
+        rows = aggregate_properties(es.find(app_id, None, EventFilter(
+            entity_type="item", event_names=list(AGGREGATION_EVENTS))))
+        cols = es.aggregate_properties(app_id, entity_type="item")
+        assert set(cols) == set(rows)
+        for k in rows:
+            assert cols[k].to_dict() == rows[k].to_dict()
+            assert cols[k].first_updated == rows[k].first_updated
+            assert cols[k].last_updated == rows[k].last_updated
+
+
+class TestSeqWatermarkSoundness:
+    """AUTOINCREMENT seq vs SQLite rowid reuse (review r2 finding): a
+    delete-then-reinsert at the old max rowid must not fool the sidecar
+    into serving stale events."""
+
+    def test_replace_newest_row_is_seen(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        ids = es.insert_batch(synth_events(30, seed=20), app_id)
+        _ = es.find_columnar(app_id)  # sync at watermark
+        # REPLACE the newest row: old schema would reuse its rowid and the
+        # prefix count would look unchanged
+        es.insert(Event(event="buy", entity_type="user",
+                        entity_id="replaced", target_entity_type="item",
+                        target_entity_id="X", event_time=T0,
+                        event_id=ids[-1]), app_id)
+        got = {e.entity_id for e in es.find_columnar(app_id).to_events()}
+        assert "replaced" in got
+
+    def test_delete_then_insert_at_tail(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        ids = es.insert_batch(synth_events(20, seed=21), app_id)
+        _ = es.find_columnar(app_id)
+        es.delete(ids[-1], app_id)
+        es.insert(Event(event="buy", entity_type="user",
+                        entity_id="fresh", target_entity_type="item",
+                        target_entity_id="Y", event_time=T0), app_id)
+        rows = sorted(proj(e) for e in es.find(app_id))
+        cols = sorted(proj(e) for e in
+                      es.find_columnar(app_id).to_events())
+        assert cols == rows
+
+    def test_legacy_rowid_table_migrates(self, tmp_path):
+        import sqlite3 as s3
+
+        db = str(tmp_path / "legacy.db")
+        conn = s3.connect(db)
+        conn.execute("""
+            CREATE TABLE events_1 (
+                id TEXT PRIMARY KEY, event TEXT NOT NULL,
+                entity_type TEXT NOT NULL, entity_id TEXT NOT NULL,
+                target_entity_type TEXT, target_entity_id TEXT,
+                properties TEXT, event_time INTEGER NOT NULL,
+                tags TEXT, pr_id TEXT, creation_time INTEGER NOT NULL)""")
+        conn.execute(
+            "INSERT INTO events_1 VALUES ('e1','rate','user','u0','item',"
+            "'i0','{\"rating\": 3.0}',1760000000000,'[]',NULL,"
+            "1760000000000)")
+        conn.commit()
+        conn.close()
+        storage = Storage(env={
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": db,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        })
+        es = storage.events()
+        batch = es.find_columnar(1)  # triggers migration
+        assert batch.n == 1
+        assert list(batch.to_events())[0].entity_id == "u0"
+        # old data + new writes coexist after migration
+        es.insert(Event(event="buy", entity_type="user", entity_id="u1",
+                        target_entity_type="item", target_entity_id="i1",
+                        event_time=T0), app_id=1)
+        assert es.find_columnar(1).n == 2
+
+    def test_non_numeric_rating_not_coerced(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch([
+            Event(event="rate", entity_type="user", entity_id="u0",
+                  target_entity_type="item", target_entity_id="i0",
+                  properties=DataMap({"rating": 4.0}), event_time=T0),
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": "N/A"}), event_time=T0),
+            Event(event="rate", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i2",
+                  properties=DataMap({"rating": True}), event_time=T0),
+        ], app_id)
+        batch = es.find_columnar(app_id)
+        col = batch.float_props["rating"]
+        by_user = {batch.dicts.entity_ids.values[batch.entity_id[i]]:
+                   col[i] for i in range(batch.n)}
+        assert by_user["u0"] == 4.0
+        assert np.isnan(by_user["u1"])  # string must NOT become 0.0
+        assert np.isnan(by_user["u2"])  # bool must NOT become 1.0

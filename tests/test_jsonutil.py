@@ -67,3 +67,16 @@ def test_python_keyword_field_alias():
 
     p = from_jsonable(NaiveBayesParams, {"lambda": 2.0})
     assert p.lambda_ == 2.0
+
+
+def test_als_lambda_alias_from_engine_json():
+    """The reference's engine.json spells regularization "lambda"
+    (recommendation-engine/engine.json); ALSParams.reg must accept it."""
+    from predictionio_tpu.models.als import ALSParams
+    from predictionio_tpu.utils.jsonutil import from_jsonable
+
+    p = from_jsonable(ALSParams, {"rank": 4, "numIterations": 2,
+                                  "lambda": 0.25, "seed": 3})
+    assert p.reg == 0.25
+    p2 = from_jsonable(ALSParams, {"lambda_": 0.5})
+    assert p2.reg == 0.5
