@@ -73,7 +73,7 @@ def build_db(path: str, n_events: int, seed: int = 7) -> Storage:
         ]
         with es.client.lock:
             conn.executemany(
-                f"INSERT INTO events_{app_id} VALUES "
+                f"INSERT INTO events_{app_id} ({es.EVENT_COLS}) VALUES "
                 "(?,?,?,?,?,?,?,?,?,?,?)", rows)
             conn.commit()
         written += m

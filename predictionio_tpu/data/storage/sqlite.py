@@ -112,40 +112,53 @@ class SQLiteEventStore(EventStore):
         watermark (a reused rowid can make a changed prefix look
         unchanged). Rebuild such tables around an AUTOINCREMENT ``seq``,
         which is guaranteed never to be reused."""
-        row = self._conn.execute(
-            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
-            (table,)).fetchone()
-        if row is None:
+        tmp = f"{table}_legacy"
+        names = {r[0] for r in self._conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' "
+            "AND name IN (?, ?)", (table, tmp))}
+        if not names:
             return
         cols = [r[1] for r in
-                self._conn.execute(f"PRAGMA table_info({table})")]
-        if "seq" in cols:
-            return
-        tmp = f"{table}_legacy"
-        self._conn.execute(f"ALTER TABLE {table} RENAME TO {tmp}")
-        self._conn.execute(f"""
-            CREATE TABLE {table} (
-                seq INTEGER PRIMARY KEY AUTOINCREMENT,
-                id TEXT UNIQUE NOT NULL,
-                event TEXT NOT NULL,
-                entity_type TEXT NOT NULL,
-                entity_id TEXT NOT NULL,
-                target_entity_type TEXT,
-                target_entity_id TEXT,
-                properties TEXT,
-                event_time INTEGER NOT NULL,
-                tags TEXT,
-                pr_id TEXT,
-                creation_time INTEGER NOT NULL
-            )""")
-        self._conn.execute(
-            f"INSERT INTO {table} ({self.EVENT_COLS}) "
-            f"SELECT {self.EVENT_COLS} FROM {tmp} ORDER BY rowid")
-        self._conn.execute(f"DROP TABLE {tmp}")
-        self._conn.execute(
-            f"CREATE INDEX IF NOT EXISTS idx_{table}_t "
-            f"ON {table} (event_time)")
+                self._conn.execute(f"PRAGMA table_info({table})")] \
+            if table in names else []
+        if "seq" in cols and tmp not in names:
+            return  # already migrated
+        # one explicit transaction: SQLite DDL is transactional, and the
+        # Python driver autocommits DDL otherwise — a crash mid-migration
+        # must never strand events in the _legacy table
         self._conn.commit()
+        self._conn.execute("BEGIN IMMEDIATE")
+        try:
+            if table in names and "seq" not in cols:
+                self._conn.execute(f"ALTER TABLE {table} RENAME TO {tmp}")
+            # (re)create the new-schema table; on crash recovery
+            # (tmp left over by a pre-atomic version) it may exist already
+            self._conn.execute(f"""
+                CREATE TABLE IF NOT EXISTS {table} (
+                    seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                    id TEXT UNIQUE NOT NULL,
+                    event TEXT NOT NULL,
+                    entity_type TEXT NOT NULL,
+                    entity_id TEXT NOT NULL,
+                    target_entity_type TEXT,
+                    target_entity_id TEXT,
+                    properties TEXT,
+                    event_time INTEGER NOT NULL,
+                    tags TEXT,
+                    pr_id TEXT,
+                    creation_time INTEGER NOT NULL
+                )""")
+            self._conn.execute(
+                f"INSERT OR IGNORE INTO {table} ({self.EVENT_COLS}) "
+                f"SELECT {self.EVENT_COLS} FROM {tmp} ORDER BY rowid")
+            self._conn.execute(f"DROP TABLE {tmp}")
+            self._conn.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{table}_t "
+                f"ON {table} (event_time)")
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock:

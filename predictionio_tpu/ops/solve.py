@@ -1,15 +1,151 @@
 """Batched small linear solves for alternating least squares.
 
-The per-row normal equations of ALS are rank×rank SPD systems — thousands
-of them per update. Batched Cholesky maps them onto the MXU as one fused
-kernel (vmapped ``cho_factor``/``cho_solve``), replacing the per-user
-LAPACK calls MLlib's ALS makes inside each Spark task.
+The per-row normal equations of ALS are rank×rank SPD systems — hundreds
+of thousands of them per half-iteration (the role of the per-user LAPACK
+calls MLlib's ALS makes inside each Spark task,
+``ALSAlgorithm.scala:75-85``). XLA's batched Cholesky lowers each tiny
+factorization to a serial column loop that leaves the chip almost idle
+(measured: 1.15s for 138k×64×64 on a v5e — ~20 GFLOP/s). The Pallas
+kernel here instead lays the batch out **along the 128 vector lanes**
+(``[col, row, batch]``) so one program factors 128 matrices in lockstep:
+every Cholesky column step is a full-width VPU op, and storing L by
+columns makes both triangular sweeps column-access-only (the backward
+substitution against L^T reads columns of L, not rows).
 """
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
+
+#: batch lanes per Pallas program — the TPU vector lane width.
+_LANES = 128
+
+
+def _chol_solve_kernel(a_ref, b_ref, x_ref, A, acc):
+    """Factor + solve 128 SPD systems in lockstep.
+
+    a_ref: [r, r, B] (column, row, batch-in-lanes); b_ref/x_ref: [r, B].
+    ``A`` scratch holds the in-place factorization: after step k its
+    leading index k is column k of L (zeros above the diagonal). Both
+    substitution sweeps are formulated column-access-only (forward
+    right-looking, backward left-looking), so L is never transposed.
+    """
+    r = a_ref.shape[0]
+    B = a_ref.shape[2]
+    A[:] = a_ref[:]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (r, B), 0)
+
+    def at_row(v, k):
+        # extract row k of a [r, B] VALUE as [1, B] — Pallas TPU has no
+        # value-level dynamic_slice, so use a masked lane reduction
+        return jnp.sum(v * (rows == k), axis=0, keepdims=True)
+
+    def factor_step(k, carry):
+        colk = A[k]  # [r, B]
+        piv = at_row(colk, k)  # [1, B]
+        inv_sqrt = jax.lax.rsqrt(jnp.maximum(piv, 1e-30))
+        l = colk * inv_sqrt * (rows >= k)
+        A[:] = A[:] - l[:, None, :] * l[None, :, :]
+        A[k] = l
+        return carry
+
+    jax.lax.fori_loop(0, r, factor_step, 0, unroll=False)
+
+    # forward substitution: L y = b  (acc morphs b → y)
+    acc[:] = b_ref[:]
+
+    def fwd_step(k, carry):
+        Lk = A[k]  # [r, B] — column k of L
+        lkk = at_row(Lk, k)
+        yk = at_row(acc[:], k) / jnp.maximum(lkk, 1e-30)
+        acc[:] = jnp.where(rows == k, yk,
+                           acc[:] - Lk * yk * (rows > k))
+        return carry
+
+    jax.lax.fori_loop(0, r, fwd_step, 0, unroll=False)
+
+    # backward substitution, left-looking: x_k = (y_k - Σ_{j>k} L[j,k]·x_j)
+    # / L[k,k]. The sum runs over COLUMN k of L — exactly what the column
+    # storage indexes. ``acc`` rows > k already hold x, rows ≤ k still y.
+    def bwd_step(i, carry):
+        k = r - 1 - i
+        Lk = A[k]  # [r, B] — column k of L
+        lkk = at_row(Lk, k)
+        s = jnp.sum(Lk * acc[:] * (rows > k), axis=0, keepdims=True)
+        xk = (at_row(acc[:], k) - s) / jnp.maximum(lkk, 1e-30)
+        acc[:] = jnp.where(rows == k, xk, acc[:])
+        return carry
+
+    jax.lax.fori_loop(0, r, bwd_step, 0, unroll=False)
+    x_ref[:] = acc[:]
+
+
+try:  # pallas import kept lazy-safe: CPU-only installs still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _solve_spd_pallas(A: jax.Array, b: jax.Array,
+                      interpret: bool = False) -> jax.Array:
+    """Pallas path: A [n, r, r] SPD (jitter already applied), b [n, r]."""
+    n, r = A.shape[0], A.shape[-1]
+    rp = max(((r + 7) // 8) * 8, 8)
+    np_ = ((n + _LANES - 1) // _LANES) * _LANES
+    # pad rank with identity (keeps matrices SPD) and batch with identity
+    if rp != r or np_ != n:
+        eye = jnp.eye(rp, dtype=A.dtype)
+        Ap = jnp.zeros((np_, rp, rp), A.dtype) + eye
+        Ap = Ap.at[:n, :r, :r].set(A)
+        bp = jnp.zeros((np_, rp), b.dtype).at[:n, :r].set(b)
+    else:
+        Ap, bp = A, b
+    # batch-in-lanes layout: [col, row, batch] (A is symmetric, so the
+    # (row, col) vs (col, row) choice is immaterial on input)
+    At = jnp.transpose(Ap, (2, 1, 0))
+    bt = jnp.transpose(bp, (1, 0))
+    xt = pl.pallas_call(
+        _chol_solve_kernel,
+        grid=(np_ // _LANES,),
+        in_specs=[
+            pl.BlockSpec((rp, rp, _LANES), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rp, _LANES), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rp, _LANES), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rp, np_), A.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rp, rp, _LANES), jnp.float32),
+            pltpu.VMEM((rp, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(At, bt)
+    return jnp.transpose(xt, (1, 0))[:n, :r]
+
+
+def _use_pallas() -> bool:
+    if not _HAVE_PALLAS:
+        return False
+    mode = os.environ.get("PTPU_SPD_SOLVER", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    try:
+        # Mosaic lowers on TPU only — a GPU backend must fall back to XLA
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
 
 
 def solve_spd_batch(A: jax.Array, b: jax.Array,
@@ -18,9 +154,15 @@ def solve_spd_batch(A: jax.Array, b: jax.Array,
 
     A: [n, r, r], b: [n, r] → x: [n, r]. A small diagonal jitter keeps
     Cholesky stable for rows with empty histories (A = λI only).
+
+    On TPU this dispatches to the lane-batched Pallas Cholesky kernel;
+    on CPU (tests) it uses XLA's ``cho_factor``/``cho_solve``. Override
+    with ``PTPU_SPD_SOLVER={auto,pallas,xla}``.
     """
     r = A.shape[-1]
     A = A + jitter * jnp.eye(r, dtype=A.dtype)
+    if _use_pallas():
+        return _solve_spd_pallas(A, b)
     chol, lower = jax.scipy.linalg.cho_factor(A)
     return jax.scipy.linalg.cho_solve((chol, lower), b[..., None])[..., 0]
 
