@@ -1,0 +1,113 @@
+"""Eval-sweep benchmark: 8-point hyperparameter grid, k-fold, measuring
+the effect of (a) per-fold pack reuse (``pack_ratings_cached``) and
+(b) the thread-parallel grid walk (``MetricEvaluator(parallelism=)``,
+the reference's ``.par`` map — ``MetricEvaluator.scala:224-231``).
+
+Usage: python benchmarks/eval_sweep_bench.py [n_events]
+Prints one JSON line with sequential-cold vs parallel-warm sweep times.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    n_events = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.controller.context import Context
+    from predictionio_tpu.controller.evaluation import (
+        Evaluation,
+        MetricEvaluator,
+    )
+    from predictionio_tpu.controller.params import EngineParams
+    from predictionio_tpu.models import als as als_mod
+    from predictionio_tpu.models.als import ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        PrecisionAtK,
+        recommendation_engine,
+    )
+
+    rng = np.random.default_rng(0)
+    n_users, n_items = 800, 300
+    events = [
+        {"user": f"u{rng.integers(n_users)}", "item": f"i{rng.integers(n_items)}",
+         "rating": float(rng.integers(1, 6))}
+        for _ in range(n_events)
+    ]
+
+    # feed events through an in-memory store so the DataSource reads the
+    # real path
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.registry import Storage
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                           "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                           "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+                           "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM"})
+    from predictionio_tpu.data.storage.base import App
+
+    app_id = storage.apps().insert(App(id=0, name="sweepapp"))
+    storage.events().init(app_id)
+    storage.events().insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=e["user"],
+               target_entity_type="item", target_entity_id=e["item"],
+               properties={"rating": e["rating"]}) for e in events], app_id)
+
+    engine = recommendation_engine()
+    grid = [
+        EngineParams(
+            datasource=("", DataSourceParams(app_name="sweepapp", eval_k=3)),
+            algorithms=[("als", ALSParams(rank=r, num_iterations=5,
+                                          reg=reg, seed=3))])
+        for r in (4, 8) for reg in (0.01, 0.05, 0.1, 0.3)
+    ]
+    ctx = Context(app_name="sweepapp", _storage=storage)
+    ev = Evaluation(engine=engine, metric=PrecisionAtK(k=5))
+
+    def run(parallelism):
+        als_mod._pack_cache.clear()
+        t0 = time.monotonic()
+        res = MetricEvaluator(ev, parallelism=parallelism).evaluate(ctx, grid)
+        return time.monotonic() - t0, res
+
+    run(parallelism=1)  # warm jit caches so the comparison is fair
+
+    # round-1 equivalent: every retrain re-packs (no pack_ratings_cached)
+    import predictionio_tpu.templates.recommendation as rec_mod
+    real_cached = als_mod.pack_ratings_cached
+    als_mod_pack = als_mod.pack_ratings
+    try:
+        rec_mod.pack_ratings_cached = lambda r, p, mesh=None: \
+            als_mod_pack(r, p, mesh)
+        t_nopack, _ = run(parallelism=1)
+    finally:
+        rec_mod.pack_ratings_cached = real_cached
+
+    t_seq, r_seq = run(parallelism=1)
+    t_par, r_par = run(parallelism=4)
+    assert [s.score for s in r_seq.scores] == [s.score for s in r_par.scores]
+
+    print(json.dumps({
+        "grid_points": len(grid),
+        "folds": 3,
+        "n_events": n_events,
+        "sweep_round1_nopack_s": round(t_nopack, 2),
+        "sweep_sequential_s": round(t_seq, 2),
+        "sweep_parallel4_s": round(t_par, 2),
+        "speedup_vs_round1": round(t_nopack / t_par, 2),
+        "best_index": r_par.best_index,
+    }))
+
+
+if __name__ == "__main__":
+    main()

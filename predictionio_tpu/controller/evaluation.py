@@ -21,9 +21,11 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .context import Context
 from .engine import Engine
@@ -31,6 +33,43 @@ from .metric import Metric
 from .params import EngineParams, params_to_json
 
 log = logging.getLogger(__name__)
+
+
+class _Memo:
+    """Thread-safe compute-once cache: the first caller of a key runs the
+    thunk, concurrent callers for the same key block on its Future — the
+    concurrent analogue of the sequential prefix caches, so a parallel
+    sweep still trains each (datasource, preparator, algorithm) prefix
+    exactly once (the FastEvalEngine property,
+    ``controller/FastEvalEngine.scala:87-210``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futs: Dict[str, Future] = {}
+
+    def get(self, key: str, fn: Callable[[], Any]) -> Any:
+        return self.get_timed(key, fn)[0]
+
+    def get_timed(self, key: str, fn: Callable[[], Any]
+                  ) -> Tuple[Any, float]:
+        """Like :meth:`get`, additionally returning the seconds THIS
+        caller spent computing (0.0 for cache hits and waiters — time
+        spent blocked on another thread's training is not this grid
+        point's training time)."""
+        with self._lock:
+            fut = self._futs.get(key)
+            owner = fut is None
+            if owner:
+                fut = self._futs[key] = Future()
+        spent = 0.0
+        if owner:
+            t0 = time.monotonic()
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — propagate to waiters
+                fut.set_exception(e)
+            spent = time.monotonic() - t0
+        return fut.result(), spent
 
 
 class EngineParamsGenerator:
@@ -111,37 +150,39 @@ def _key(pair: Any) -> str:
 
 
 class MetricEvaluator:
-    """Scores every engine-params set; memoizes shared pipeline prefixes."""
+    """Scores every engine-params set; memoizes shared pipeline prefixes
+    and walks the grid with a thread pool (the reference's ``.par`` map,
+    ``MetricEvaluator.scala:224-231`` — device work serializes on the
+    accelerator anyway, but host-side packing, prediction decoding and
+    metric math overlap across grid points)."""
 
-    def __init__(self, evaluation: Evaluation):
+    def __init__(self, evaluation: Evaluation,
+                 parallelism: Optional[int] = None):
         self.evaluation = evaluation
+        self.parallelism = parallelism
 
     def evaluate(self, ctx: Context,
                  params_list: Sequence[EngineParams]) -> MetricEvaluatorResult:
         engine = self.evaluation.engine
         metric = self.evaluation.metric
-        fold_cache: Dict[str, list] = {}
-        prep_cache: Dict[str, list] = {}
-        model_cache: Dict[str, list] = {}
-        scores: List[MetricScores] = []
+        fold_cache = _Memo()
+        prep_cache = _Memo()
+        model_cache = _Memo()
 
-        for idx, ep in enumerate(params_list):
+        def score_one(idx: int, ep: EngineParams) -> MetricScores:
             t0 = time.monotonic()
             ds_key = _key(ep.datasource)
-            if ds_key not in fold_cache:
-                fold_cache[ds_key] = engine.make_datasource(ep).read_eval(ctx)
-            folds = fold_cache[ds_key]
+            folds = fold_cache.get(
+                ds_key, lambda: engine.make_datasource(ep).read_eval(ctx))
             if not folds:
                 raise ValueError(
                     "DataSource.read_eval returned no folds; evaluation "
                     "requires read_eval to be implemented")
 
             prep_key = ds_key + "|" + _key(ep.preparator)
-            if prep_key not in prep_cache:
-                preparator = engine.make_preparator(ep)
-                prep_cache[prep_key] = [
-                    preparator.prepare(ctx, td) for td, _, _ in folds]
-            prepared = prep_cache[prep_key]
+            prepared = prep_cache.get(prep_key, lambda: [
+                engine.make_preparator(ep).prepare(ctx, td)
+                for td, _, _ in folds])
 
             serving = engine.make_serving(ep)
             eval_data = []
@@ -153,12 +194,10 @@ class MetricEvaluator:
                 for algo_pair, algo in zip(ep.algorithms,
                                            engine.make_algorithms(ep)):
                     m_key = prep_key + f"|f{fold_i}|" + _key(algo_pair)
-                    if m_key not in model_cache:
-                        tt = time.monotonic()
-                        model_cache[m_key] = algo.train(ctx, pd)
-                        t_train += time.monotonic() - tt
-                    per_algo.append(
-                        algo.batch_predict(model_cache[m_key], queries))
+                    model, spent = model_cache.get_timed(
+                        m_key, lambda: algo.train(ctx, pd))
+                    t_train += spent
+                    per_algo.append(algo.batch_predict(model, queries))
                 served = [serving.serve(q, [p[i] for p in per_algo])
                           for i, q in enumerate(queries)]
                 eval_data.append((ei, list(zip(queries, served, actuals))))
@@ -166,11 +205,19 @@ class MetricEvaluator:
             score = metric.calculate(eval_data)
             others = [m.calculate(eval_data)
                       for m in self.evaluation.other_metrics]
-            scores.append(MetricScores(
-                engine_params=ep, score=score, other_scores=others,
-                train_s=t_train, eval_s=time.monotonic() - t0))
             log.info("params %d/%d: %s = %f", idx + 1, len(params_list),
                      metric.header, score)
+            return MetricScores(
+                engine_params=ep, score=score, other_scores=others,
+                train_s=t_train, eval_s=time.monotonic() - t0)
+
+        workers = self.parallelism or min(4, max(len(params_list), 1))
+        if workers <= 1 or len(params_list) <= 1:
+            scores = [score_one(i, ep) for i, ep in enumerate(params_list)]
+        else:
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                scores = list(pool.map(score_one, range(len(params_list)),
+                                       params_list))
 
         best_index = 0
         for i in range(1, len(scores)):

@@ -253,6 +253,162 @@ def _pack_split_on_device(r, c, v, groups, *, n_rows: int, L: int,
 _pack_split_jit = None
 
 
+@dataclass(frozen=True)
+class HistoryBucket:
+    """One length class of a :class:`BucketedHistories` layout: all rows
+    whose history fits L (and not L/2). ``row_ids[j]`` is the real row
+    that bucket-row j belongs to (``n_rows_padded`` sentinel on padding
+    rows); each real row appears in AT MOST ONE bucket, so writing the
+    per-bucket solve results back is a unique-index scatter — no
+    duplicate-index scatter-add anywhere (TPU serializes those)."""
+
+    length: int
+    indices: np.ndarray   # [n_bk_pad, L] int32
+    values: np.ndarray    # [n_bk_pad, L] float32
+    counts: np.ndarray    # [n_bk_pad] int32 (true history length)
+    row_ids: np.ndarray   # [n_bk_pad] int32
+
+    @property
+    def n_rows(self) -> int:
+        return self.indices.shape[0]
+
+
+@dataclass(frozen=True)
+class BucketedHistories:
+    """Drop-free dense layout for skewed histories: each row is padded to
+    the next power of two of its own length (≤2× padding waste) instead
+    of a single global ``max_len``. Besides never dropping entries (MLlib
+    parity — ``ALSAlgorithm.scala:75-85``), per-bucket updates give every
+    normal-equation einsum a contraction depth K = L_bucket, where the
+    single-L split layout forced the small L that minimizes padding —
+    and tiny K starves the MXU."""
+
+    buckets: tuple          # of HistoryBucket, ascending length
+    n_rows: int
+    n_rows_padded: int
+
+    @property
+    def padded_entries(self) -> int:
+        return sum(b.n_rows * b.length for b in self.buckets)
+
+    @property
+    def max_len(self) -> int:
+        return max((b.length for b in self.buckets), default=1)
+
+
+def bucket_layout(counts: np.ndarray, min_len: int = 8,
+                  pad_rows_to: int = 1, max_len: Optional[int] = None):
+    """Host-side bucket planning: per-row bucket length (next pow2 of the
+    row's count, floored at ``min_len``, optionally capped at
+    ``max_len`` — capped rows TRUNCATE like the pad layout), member rows
+    per bucket, and the flat destination offset of every row's first
+    slot."""
+    n_rows = len(counts)
+    if max_len is not None:
+        counts = np.minimum(counts, max_len)
+    lengths = np.maximum(min_len, 1 << np.int64(
+        np.ceil(np.log2(np.maximum(counts, 1)))))
+    lengths[counts == 0] = 0  # empty rows join no bucket
+    plan = []
+    row_base = np.zeros(n_rows, dtype=np.int64)
+    off = 0
+    for L in np.unique(lengths):
+        if L == 0:
+            continue
+        rows_k = np.flatnonzero(lengths == L)
+        n_bk = len(rows_k)
+        n_bk_pad = max(-(-n_bk // pad_rows_to) * pad_rows_to, pad_rows_to)
+        row_base[rows_k] = off + np.arange(n_bk, dtype=np.int64) * int(L)
+        plan.append((int(L), rows_k, n_bk_pad, off))
+        off += n_bk_pad * int(L)
+    return plan, row_base, off  # off == total flat slots S
+
+
+def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
+                                   vals: np.ndarray, n_rows: int,
+                                   pad_rows_to: int = 1,
+                                   min_len: int = 8,
+                                   max_len: Optional[int] = None
+                                   ) -> BucketedHistories:
+    """Pack COO triples into the bucketed layout with ONE compiled
+    scatter (host work is bincount + per-row offset arithmetic): sort by
+    row on device, scatter each entry to ``row_base[row] + pos_in_row``
+    in a flat buffer, then carve per-bucket views. ``max_len`` caps each
+    row's history (truncating in input order, pad-layout semantics);
+    without it the layout is drop-free."""
+    import jax.numpy as jnp
+
+    counts = np.bincount(np.asarray(rows), minlength=n_rows)
+    if max_len is not None:
+        counts = np.minimum(counts, int(max_len))
+    plan, row_base, S = bucket_layout(counts, min_len, pad_rows_to)
+    n_rows_pad = max(-(-n_rows // pad_rows_to) * pad_rows_to, pad_rows_to)
+    if S == 0:
+        return BucketedHistories(buckets=(), n_rows=n_rows,
+                                 n_rows_padded=n_rows_pad)
+    if S >= 2 ** 31:  # pragma: no cover — would need >1B ratings
+        raise ValueError(f"bucketed layout needs {S} slots (> int32); "
+                         "shard the dataset across hosts first")
+    flat_idx, flat_val = _pack_flat_on_device(
+        jnp.asarray(rows, dtype=jnp.int32),
+        jnp.asarray(cols, dtype=jnp.int32),
+        jnp.asarray(vals, dtype=jnp.float32),
+        jnp.asarray(row_base, dtype=jnp.int32),
+        jnp.asarray(counts, dtype=jnp.int32),  # post-cap per-row budget
+        n_rows=n_rows, S=S)
+    buckets = []
+    for L, rows_k, n_bk_pad, off in plan:
+        n_bk = len(rows_k)
+        row_ids = np.full(n_bk_pad, n_rows_pad, dtype=np.int32)
+        row_ids[:n_bk] = rows_k
+        cnt = np.zeros(n_bk_pad, dtype=np.int32)
+        cnt[:n_bk] = counts[rows_k]
+        buckets.append(HistoryBucket(
+            length=L,
+            indices=flat_idx[off:off + n_bk_pad * L].reshape(n_bk_pad, L),
+            values=flat_val[off:off + n_bk_pad * L].reshape(n_bk_pad, L),
+            counts=cnt, row_ids=row_ids))
+    return BucketedHistories(buckets=tuple(buckets), n_rows=n_rows,
+                             n_rows_padded=n_rows_pad)
+
+
+def _pack_flat_on_device(r, c, v, row_base, row_cap, *, n_rows: int,
+                         S: int):
+    import jax
+
+    global _pack_flat_jit
+    if _pack_flat_jit is None:
+        import jax.numpy as jnp
+
+        def pack(r, c, v, row_base, row_cap, n_rows, S):
+            # int32 throughout: S and nnz stay < 2^31 (S ≤ ~2·nnz by the
+            # ≤2× pow2-padding bound; the flat buffer is range-checked on
+            # the host before this program is built)
+            nnz = r.shape[0]
+            order = jnp.argsort(r, stable=True)
+            rs, cs, vs = r[order], c[order], v[order]
+            counts = jnp.bincount(rs, length=n_rows).astype(jnp.int32)
+            starts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(counts, dtype=jnp.int32)])
+            pos = jnp.arange(nnz, dtype=jnp.int32) - starts[rs]
+            # entries past a row's (possibly max_len-capped) budget drop;
+            # without a cap pos < row_cap always holds
+            dest = jnp.where(pos < row_cap[rs], row_base[rs] + pos,
+                             jnp.int32(S))
+            # no unique_indices promise: capped entries all alias the
+            # OOB sentinel S (they drop, but the promise would be a lie)
+            idx = jnp.zeros(S, jnp.int32).at[dest].set(cs, mode="drop")
+            val = jnp.zeros(S, jnp.float32).at[dest].set(vs, mode="drop")
+            return idx, val
+
+        _pack_flat_jit = jax.jit(pack, static_argnames=("n_rows", "S"))
+    return _pack_flat_jit(r, c, v, row_base, row_cap, n_rows=n_rows, S=S)
+
+
+_pack_flat_jit = None
+
+
 def resolve_max_len(counts: np.ndarray, n_rows: int,
                     max_len: Optional[int]) -> int:
     """Padded history length: the explicit cap, or the longest row with

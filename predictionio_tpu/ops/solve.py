@@ -142,8 +142,12 @@ def _use_pallas() -> bool:
     if mode == "xla":
         return False
     try:
-        # Mosaic lowers on TPU only — a GPU backend must fall back to XLA
-        return jax.default_backend() == "tpu"
+        # Mosaic lowers on TPU only — a GPU backend must fall back to
+        # XLA. Match on the device kind, not the backend name: TPU
+        # tunnel/plugin platforms (e.g. "axon") report kinds like
+        # "TPU v5 lite" while default_backend() returns the plugin name.
+        dev = jax.devices()[0]
+        return dev.platform == "tpu" or dev.device_kind.startswith("TPU")
     except Exception:  # pragma: no cover
         return False
 
@@ -162,7 +166,9 @@ def solve_spd_batch(A: jax.Array, b: jax.Array,
     r = A.shape[-1]
     A = A + jitter * jnp.eye(r, dtype=A.dtype)
     if _use_pallas():
-        return _solve_spd_pallas(A, b)
+        lead = A.shape[:-2]  # arbitrary leading batch dims, like LAPACK's
+        x = _solve_spd_pallas(A.reshape(-1, r, r), b.reshape(-1, r))
+        return x.reshape(*lead, r)
     chol, lower = jax.scipy.linalg.cho_factor(A)
     return jax.scipy.linalg.cho_solve((chol, lower), b[..., None])[..., 0]
 

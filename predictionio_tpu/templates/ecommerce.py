@@ -37,7 +37,7 @@ from ..controller import (
     SanityCheck,
 )
 from ..data.bimap import BiMap
-from ..models.als import ALSParams, RatingsCOO, train_als
+from ..models.als import ALSParams, RatingsCOO, pack_ratings_cached, train_als
 from ._common import candidate_mask, dedup_view_ratings, top_scores
 
 log = logging.getLogger(__name__)
@@ -191,7 +191,8 @@ class ECommAlgorithm(Algorithm):
         als = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
                         reg=p.lambda_, implicit_prefs=True, alpha=1.0,
                         seed=p.seed if p.seed is not None else 0)
-        U, V = train_als(ratings, als, mesh=ctx.mesh)
+        packed = pack_ratings_cached(ratings, als, mesh=ctx.mesh)
+        U, V = train_als(ratings, als, mesh=ctx.mesh, packed=packed)
         U = np.asarray(U)[:len(user_ids)]
         V = np.asarray(V)[:len(item_ids)]
         has_user = np.zeros(len(user_ids), dtype=bool)

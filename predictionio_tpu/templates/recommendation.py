@@ -35,6 +35,7 @@ from ..models.als import (
     ALSModel,
     ALSParams,
     RatingsCOO,
+    pack_ratings_cached,
     recommend_batch,
     recommend_products,
     train_als,
@@ -183,7 +184,8 @@ class ALSAlgorithm(Algorithm):
 
     def train(self, ctx: Context, td: TrainingData) -> ALSModel:
         mesh = ctx.mesh
-        U, V = train_als(td.ratings, self.params, mesh=mesh)
+        packed = pack_ratings_cached(td.ratings, self.params, mesh=mesh)
+        U, V = train_als(td.ratings, self.params, mesh=mesh, packed=packed)
         return ALSModel(user_factors=U, item_factors=V,
                         n_users=td.ratings.n_users,
                         n_items=td.ratings.n_items,

@@ -32,7 +32,7 @@ from ..controller import (
     Serving,
 )
 from ..data.bimap import BiMap
-from ..models.als import ALSParams, RatingsCOO, train_als
+from ..models.als import ALSParams, RatingsCOO, pack_ratings_cached, train_als
 from ..models.cooccurrence import CooccurrenceModel, train_cooccurrence
 from ._common import candidate_mask, dedup_view_ratings, top_scores
 
@@ -189,7 +189,8 @@ class SPALSAlgorithm(Algorithm):
         user_ids = BiMap.string_int(td.users.keys())
         item_ids = BiMap.string_int(td.items.keys())
         ratings = self._ratings(td, user_ids, item_ids)
-        _, V = train_als(ratings, self.params, mesh=ctx.mesh)
+        packed = pack_ratings_cached(ratings, self.params, mesh=ctx.mesh)
+        _, V = train_als(ratings, self.params, mesh=ctx.mesh, packed=packed)
         V = np.asarray(V)[:len(item_ids)]
         has = np.zeros(len(item_ids), dtype=bool)
         has[np.unique(ratings.items)] = True

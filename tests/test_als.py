@@ -408,23 +408,26 @@ class TestSplitHistories:
                                    np.asarray(V_1)[:24], rtol=2e-3,
                                    atol=2e-4)
 
-    def test_auto_mode_prefers_split_under_skew(self, monkeypatch):
+    def test_auto_mode_drops_nothing_under_skew(self, monkeypatch):
         import predictionio_tpu.ops.ragged as ragged
         from predictionio_tpu.models.als import _pack
 
-        # shrink the auto-cap so the skewed side must split
+        # shrink the auto-cap so the skewed side can't use a flat pad
         monkeypatch.setattr(ragged, "AUTO_CAP_ENTRIES", 2000)
         rng = np.random.default_rng(3)
         rows = np.concatenate([np.zeros(900, np.int32),
                                rng.integers(1, 100, 300).astype(np.int32)])
         cols = rng.integers(0, 50, 1200).astype(np.int32)
         vals = rng.random(1200).astype(np.float32)
-        from predictionio_tpu.ops.ragged import SplitHistories
+        from predictionio_tpu.ops.ragged import BucketedHistories
 
         h = _pack(rows, cols, vals, 100, ALSParams(history_mode="auto"), 1)
-        assert isinstance(h, SplitHistories)
-        # nothing dropped: per-virtual-row counts sum to nnz
-        assert int(np.asarray(h.counts).sum()) == 1200
+        assert isinstance(h, BucketedHistories)
+        # nothing dropped: bucket counts sum to nnz
+        total = sum(int(np.asarray(b.counts).sum()) for b in h.buckets)
+        assert total == 1200
+        # pow2 padding bound: at most 2x + the min-length floor
+        assert h.padded_entries <= 2 * 1200 + 8 * 100
 
     def test_auto_split_len_minimizes_padding(self):
         from predictionio_tpu.models.als import auto_split_len
@@ -434,3 +437,169 @@ class TestSplitHistories:
         padded = (-(-counts // L) * L).sum()
         for cand in (32, 64, 128, 4096, 8192):
             assert padded <= (-(-counts // cand) * cand).sum()
+
+
+class TestBucketedHistories:
+    """Bucket mode: drop-free pow2 length buckets (the TPU-fast drop-free
+    layout — unique-index scatters only, MXU-deep contractions)."""
+
+    def test_pack_covers_every_entry_once(self):
+        from predictionio_tpu.ops.ragged import (
+            BucketedHistories,
+            pack_histories_bucketed_device,
+        )
+
+        rng = np.random.default_rng(5)
+        rows = np.concatenate([np.zeros(500, np.int32),
+                               rng.integers(1, 40, 700).astype(np.int32)])
+        cols = rng.integers(0, 64, 1200).astype(np.int32)
+        vals = rng.random(1200).astype(np.float32)
+        h = pack_histories_bucketed_device(rows, cols, vals, 40,
+                                           pad_rows_to=4)
+        assert isinstance(h, BucketedHistories)
+        # every (row, col, val) triple appears exactly once across buckets
+        seen = []
+        for b in h.buckets:
+            idx = np.asarray(b.indices)
+            val = np.asarray(b.values)
+            for j in range(idx.shape[0]):
+                rid = int(b.row_ids[j])
+                c = int(b.counts[j])
+                if rid >= h.n_rows_padded or c == 0:
+                    continue
+                for k in range(c):
+                    seen.append((rid, int(idx[j, k]), float(val[j, k])))
+        assert len(seen) == 1200
+        expect = sorted(zip(rows.tolist(), cols.tolist(),
+                            [float(v) for v in vals]))
+        assert sorted(seen) == expect
+        # each real row appears in at most one bucket
+        owners = [int(r) for b in h.buckets for r in b.row_ids
+                  if int(r) < h.n_rows_padded]
+        assert len(owners) == len(set(owners))
+
+    def test_bucket_matches_pad_explicit(self):
+        ratings, _, _ = make_synthetic(n_users=25, n_items=18, rank=3,
+                                       seed=11)
+        base = dict(rank=3, num_iterations=4, reg=0.05, seed=5)
+        U_p, V_p = train_als(ratings, ALSParams(**base,
+                                                history_mode="pad"))
+        U_b, V_b = train_als(ratings, ALSParams(**base,
+                                                history_mode="bucket"))
+        np.testing.assert_allclose(np.asarray(U_b)[:25],
+                                   np.asarray(U_p)[:25], rtol=2e-3,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(V_b)[:18],
+                                   np.asarray(V_p)[:18], rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_bucket_matches_pad_implicit(self):
+        ratings, _, _ = make_synthetic(n_users=22, n_items=16, rank=3,
+                                       seed=12)
+        ratings = RatingsCOO(ratings.users, ratings.items,
+                             np.abs(ratings.ratings) + 0.1,
+                             ratings.n_users, ratings.n_items)
+        base = dict(rank=3, num_iterations=4, reg=0.05, seed=5,
+                    implicit_prefs=True, alpha=2.0)
+        U_p, V_p = train_als(ratings, ALSParams(**base,
+                                                history_mode="pad"))
+        U_b, V_b = train_als(ratings, ALSParams(**base,
+                                                history_mode="bucket"))
+        np.testing.assert_allclose(np.asarray(U_b)[:22],
+                                   np.asarray(U_p)[:22], rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_bucket_matches_split_on_skew(self):
+        # zipf-ish skew: one mega row + many small rows
+        rng = np.random.default_rng(9)
+        rows = np.concatenate([np.zeros(600, np.int32),
+                               rng.integers(1, 60, 400).astype(np.int32)])
+        cols = rng.integers(0, 40, 1000).astype(np.int32)
+        vals = np.ones(1000, np.float32)
+        ratings = RatingsCOO(rows, cols, vals, 60, 40)
+        base = dict(rank=3, num_iterations=3, reg=0.05, seed=5,
+                    implicit_prefs=True, alpha=5.0)
+        U_s, V_s = train_als(ratings, ALSParams(**base, max_history=8,
+                                                history_mode="split"))
+        U_b, V_b = train_als(ratings, ALSParams(**base,
+                                                history_mode="bucket"))
+        np.testing.assert_allclose(np.asarray(U_b)[:60],
+                                   np.asarray(U_s)[:60], rtol=2e-3,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(V_b)[:40],
+                                   np.asarray(V_s)[:40], rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_bucket_sharded_matches_single_device(self, mesh8):
+        # includes a mega row (thinner than the mesh -> L-axis sharding)
+        rng = np.random.default_rng(13)
+        rows = np.concatenate([np.zeros(500, np.int32),
+                               rng.integers(1, 32, 300).astype(np.int32)])
+        cols = rng.integers(0, 24, 800).astype(np.int32)
+        vals = np.ones(800, np.float32)
+        ratings = RatingsCOO(rows, cols, vals, 32, 24)
+        params = ALSParams(rank=3, num_iterations=3, reg=0.05, seed=5,
+                           implicit_prefs=True, alpha=3.0,
+                           history_mode="bucket")
+        U_1, V_1 = train_als(ratings, params)
+        U_8, V_8 = train_als(ratings, params, mesh=mesh8)
+        np.testing.assert_allclose(np.asarray(U_8)[:32],
+                                   np.asarray(U_1)[:32], rtol=2e-3,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(V_8)[:24],
+                                   np.asarray(V_1)[:24], rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_flops_model_counts_buckets(self):
+        from predictionio_tpu.models.als import als_flops_per_iter
+        from predictionio_tpu.models.als import pack_ratings
+
+        ratings, _, _ = make_synthetic(n_users=16, n_items=12, rank=3,
+                                       seed=2)
+        p = ALSParams(rank=4, history_mode="bucket")
+        packed = pack_ratings(ratings, p)
+        f = als_flops_per_iter(packed.user_h, packed.item_h, p)
+        # lower bound: both sides' A-outer products over real entries
+        nnz = len(ratings.users)
+        assert f >= 2 * (2 * nnz * 16)
+
+    def test_bucket_honors_max_history(self):
+        # bucket + max_history truncates like pad (same factors)
+        ratings, _, _ = make_synthetic(n_users=20, n_items=14, rank=3,
+                                       seed=21)
+        base = dict(rank=3, num_iterations=3, reg=0.05, seed=5)
+        U_p, V_p = train_als(ratings, ALSParams(**base, max_history=4,
+                                                history_mode="pad"))
+        U_b, V_b = train_als(ratings, ALSParams(**base, max_history=4,
+                                                history_mode="bucket"))
+        np.testing.assert_allclose(np.asarray(U_b)[:20],
+                                   np.asarray(U_p)[:20], rtol=2e-3,
+                                   atol=2e-4)
+        # and the packing itself kept no more than max_history per row
+        from predictionio_tpu.ops.ragged import (
+            pack_histories_bucketed_device,
+        )
+
+        h = pack_histories_bucketed_device(
+            ratings.users, ratings.items, ratings.ratings,
+            ratings.n_users, max_len=4)
+        assert all(int(np.asarray(b.counts).max(initial=0)) <= 4
+                   for b in h.buckets)
+
+    def test_mega_row_bucket_shards_history_axis(self, mesh8):
+        # a 1-real-row bucket on an 8-device mesh must shard L, not rows
+        from predictionio_tpu.models.als import _blocked_bucket
+        from predictionio_tpu.ops.ragged import (
+            pack_histories_bucketed_device,
+        )
+
+        rows = np.zeros(512, np.int32)  # one mega row, L=512
+        cols = np.arange(512, dtype=np.int32) % 40
+        vals = np.ones(512, np.float32)
+        h = pack_histories_bucketed_device(rows, cols, vals, 1,
+                                           pad_rows_to=8)
+        bk = _blocked_bucket(h, 8, mesh8)
+        mega = [b for b in bk["buckets"] if b["idx"].shape[-1] >= 512]
+        assert mega, [b["idx"].shape for b in bk["buckets"]]
+        # L-sharded layout keeps the row axes unsharded: [1, n_bk, L]
+        assert mega[0]["idx"].shape[0] == 1

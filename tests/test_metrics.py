@@ -87,3 +87,58 @@ class TestRankingHelpers:
         got = ndcg_at_k(["x", "a"], {"a"}, 2)
         assert got == pytest.approx((1 / math.log2(3)) / 1.0)
         assert ndcg_at_k(["x"], set(), 2) is None
+
+
+class TestParallelSweep:
+    """MetricEvaluator's thread-parallel grid walk (the reference's .par
+    map, MetricEvaluator.scala:224-231) must be deterministic: same
+    scores, same order, same winner as the sequential walk."""
+
+    def test_parallel_matches_sequential(self):
+        import numpy as np
+
+        from predictionio_tpu.controller.context import Context
+        from predictionio_tpu.controller.evaluation import (
+            Evaluation,
+            MetricEvaluator,
+        )
+        from predictionio_tpu.controller.params import EngineParams
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.models.als import ALSParams
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams,
+            PrecisionAtK,
+            recommendation_engine,
+        )
+
+        storage = Storage(env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM"})
+        app_id = storage.apps().insert(App(id=0, name="papp"))
+        storage.events().init(app_id)
+        rng = np.random.default_rng(1)
+        storage.events().insert_batch(
+            [Event(event="rate", entity_type="user",
+                   entity_id=f"u{rng.integers(30)}",
+                   target_entity_type="item",
+                   target_entity_id=f"i{rng.integers(20)}",
+                   properties={"rating": float(rng.integers(1, 6))})
+             for _ in range(600)], app_id)
+
+        grid = [EngineParams(
+            datasource=("", DataSourceParams(app_name="papp", eval_k=2)),
+            algorithms=[("als", ALSParams(rank=r, num_iterations=3,
+                                          reg=reg, seed=3))])
+            for r in (3, 5) for reg in (0.05, 0.2)]
+        ctx = Context(app_name="papp", _storage=storage)
+        ev = Evaluation(engine=recommendation_engine(),
+                        metric=PrecisionAtK(k=3))
+        seq = MetricEvaluator(ev, parallelism=1).evaluate(ctx, grid)
+        par = MetricEvaluator(ev, parallelism=4).evaluate(ctx, grid)
+        assert [s.score for s in seq.scores] == [s.score for s in par.scores]
+        assert seq.best_index == par.best_index
+        assert seq.best_score == par.best_score
