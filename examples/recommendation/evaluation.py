@@ -1,0 +1,54 @@
+"""Shipped evaluation for the recommendation template — the reference's
+``Evaluation.scala:62-107`` (RecommendationEvaluation +
+ComprehensiveRecommendationEvaluation + EngineParamsList).
+
+Run:  ptpu eval examples.recommendation.evaluation:evaluation \
+          examples.recommendation.evaluation:engine_params_generator
+(with the repo root on PYTHONPATH and an app named like APP_NAME below).
+"""
+
+import os
+
+from predictionio_tpu.controller import Evaluation
+from predictionio_tpu.controller.evaluation import EngineParamsGenerator
+from predictionio_tpu.controller.params import EngineParams
+from predictionio_tpu.models.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    DataSourceParams,
+    NDCGAtK,
+    PositiveCount,
+    PrecisionAtK,
+    recommendation_engine,
+)
+
+APP_NAME = os.environ.get("PTPU_EVAL_APP", "MyApp1")
+
+#: Precision@10 (threshold 4.0) as the optimized metric; the full
+#: reference grid k∈{1,3,10} × thresholds {0,2,4} + PositiveCount and
+#: the BASELINE.md NDCG@10 as side metrics.
+evaluation = Evaluation(
+    engine=recommendation_engine(),
+    metric=PrecisionAtK(k=10, rating_threshold=4.0),
+    other_metrics=[
+        *(PrecisionAtK(k=k, rating_threshold=t)
+          for t in (0.0, 2.0, 4.0) for k in (1, 3, 10)
+          if not (k == 10 and t == 4.0)),
+        NDCGAtK(k=10, rating_threshold=2.0),
+        PositiveCount(rating_threshold=2.0),
+    ],
+)
+
+
+class _Gen(EngineParamsGenerator):
+    """rank × numIterations grid (``Evaluation.scala:92-107``)."""
+
+    engine_params_list = [
+        EngineParams(
+            datasource=("", DataSourceParams(app_name=APP_NAME, eval_k=3)),
+            algorithms=[("als", ALSParams(rank=rank, num_iterations=it,
+                                          reg=0.01, seed=3))])
+        for rank in (8, 16) for it in (5, 10)
+    ]
+
+
+engine_params_generator = _Gen()

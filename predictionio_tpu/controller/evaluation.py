@@ -21,11 +21,10 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .context import Context
 from .engine import Engine
@@ -35,41 +34,11 @@ from .params import EngineParams, params_to_json
 log = logging.getLogger(__name__)
 
 
-class _Memo:
-    """Thread-safe compute-once cache: the first caller of a key runs the
-    thunk, concurrent callers for the same key block on its Future — the
-    concurrent analogue of the sequential prefix caches, so a parallel
-    sweep still trains each (datasource, preparator, algorithm) prefix
-    exactly once (the FastEvalEngine property,
-    ``controller/FastEvalEngine.scala:87-210``)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._futs: Dict[str, Future] = {}
-
-    def get(self, key: str, fn: Callable[[], Any]) -> Any:
-        return self.get_timed(key, fn)[0]
-
-    def get_timed(self, key: str, fn: Callable[[], Any]
-                  ) -> Tuple[Any, float]:
-        """Like :meth:`get`, additionally returning the seconds THIS
-        caller spent computing (0.0 for cache hits and waiters — time
-        spent blocked on another thread's training is not this grid
-        point's training time)."""
-        with self._lock:
-            fut = self._futs.get(key)
-            owner = fut is None
-            if owner:
-                fut = self._futs[key] = Future()
-        spent = 0.0
-        if owner:
-            t0 = time.monotonic()
-            try:
-                fut.set_result(fn())
-            except BaseException as e:  # noqa: BLE001 — propagate to waiters
-                fut.set_exception(e)
-            spent = time.monotonic() - t0
-        return fut.result(), spent
+#: compute-once prefix caches — the concurrent analogue of sequential
+#: memoization, so a parallel sweep still trains each (datasource,
+#: preparator, algorithm) prefix exactly once (the FastEvalEngine
+#: property, ``controller/FastEvalEngine.scala:87-210``)
+from ..utils.memo import ComputeOnce as _Memo  # noqa: E402
 
 
 class EngineParamsGenerator:
@@ -150,16 +119,18 @@ def _key(pair: Any) -> str:
 
 
 class MetricEvaluator:
-    """Scores every engine-params set; memoizes shared pipeline prefixes
-    and walks the grid with a thread pool (the reference's ``.par`` map,
-    ``MetricEvaluator.scala:224-231`` — device work serializes on the
-    accelerator anyway, but host-side packing, prediction decoding and
-    metric math overlap across grid points)."""
+    """Scores every engine-params set; memoizes shared pipeline
+    prefixes. ``parallelism>1`` walks the grid with a thread pool (the
+    reference's ``.par`` map, ``MetricEvaluator.scala:224-231`` — device
+    work serializes on the accelerator anyway, but host-side packing,
+    prediction decoding and metric math overlap across grid points).
+    Opt-in: user DataSource/Algorithm/storage code written for the
+    sequential contract must not be run concurrently by default."""
 
     def __init__(self, evaluation: Evaluation,
                  parallelism: Optional[int] = None):
         self.evaluation = evaluation
-        self.parallelism = parallelism
+        self.parallelism = parallelism if parallelism is not None else 1
 
     def evaluate(self, ctx: Context,
                  params_list: Sequence[EngineParams]) -> MetricEvaluatorResult:
@@ -187,6 +158,7 @@ class MetricEvaluator:
             serving = engine.make_serving(ep)
             eval_data = []
             t_train = 0.0
+            t_blocked = 0.0  # waiting on another thread's memoized work
             for fold_i, (pd, (td, ei, qa)) in enumerate(zip(prepared, folds)):
                 queries = [serving.supplement(q) for q, _ in qa]
                 actuals = [a for _, a in qa]
@@ -194,9 +166,11 @@ class MetricEvaluator:
                 for algo_pair, algo in zip(ep.algorithms,
                                            engine.make_algorithms(ep)):
                     m_key = prep_key + f"|f{fold_i}|" + _key(algo_pair)
+                    w0 = time.monotonic()
                     model, spent = model_cache.get_timed(
                         m_key, lambda: algo.train(ctx, pd))
                     t_train += spent
+                    t_blocked += (time.monotonic() - w0) - spent
                     per_algo.append(algo.batch_predict(model, queries))
                 served = [serving.serve(q, [p[i] for p in per_algo])
                           for i, q in enumerate(queries)]
@@ -209,7 +183,8 @@ class MetricEvaluator:
                      metric.header, score)
             return MetricScores(
                 engine_params=ep, score=score, other_scores=others,
-                train_s=t_train, eval_s=time.monotonic() - t0)
+                train_s=t_train,
+                eval_s=time.monotonic() - t0 - t_blocked)
 
         workers = self.parallelism or min(4, max(len(params_list), 1))
         if workers <= 1 or len(params_list) <= 1:

@@ -328,7 +328,8 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
                                    vals: np.ndarray, n_rows: int,
                                    pad_rows_to: int = 1,
                                    min_len: int = 8,
-                                   max_len: Optional[int] = None
+                                   max_len: Optional[int] = None,
+                                   counts: Optional[np.ndarray] = None
                                    ) -> BucketedHistories:
     """Pack COO triples into the bucketed layout with ONE compiled
     scatter (host work is bincount + per-row offset arithmetic): sort by
@@ -338,7 +339,8 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
     without it the layout is drop-free."""
     import jax.numpy as jnp
 
-    counts = np.bincount(np.asarray(rows), minlength=n_rows)
+    if counts is None:  # callers that already histogrammed pass it in
+        counts = np.bincount(np.asarray(rows), minlength=n_rows)
     if max_len is not None:
         counts = np.minimum(counts, int(max_len))
     plan, row_base, S = bucket_layout(counts, min_len, pad_rows_to)

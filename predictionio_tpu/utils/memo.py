@@ -1,0 +1,56 @@
+"""Thread-safe compute-once memoization.
+
+The first caller of a key runs the thunk; concurrent callers for the
+same key block on its Future. Used by the parallel eval sweep's pipeline
+prefix caches (``controller/evaluation.py``) and the ALS pack cache
+(``models/als.py``) — both would otherwise recompute expensive work in
+every worker thread that misses during the first computation's window.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Hashable, Tuple
+
+
+class ComputeOnce:
+    """Per-key first-caller-computes cache.
+
+    ``retry_on_failure=True`` drops a failed key so a later caller can
+    retry (transient failures — e.g. a device OOM during packing —
+    shouldn't poison the cache); waiters of the failing attempt still
+    see the exception.
+    """
+
+    def __init__(self, retry_on_failure: bool = False):
+        self._lock = threading.Lock()
+        self._futs: Dict[Hashable, Future] = {}
+        self._retry = retry_on_failure
+
+    def get(self, key: Hashable, fn: Callable[[], Any]) -> Any:
+        return self.get_timed(key, fn)[0]
+
+    def get_timed(self, key: Hashable, fn: Callable[[], Any]
+                  ) -> Tuple[Any, float]:
+        """Returns ``(value, seconds_this_caller_spent_computing)`` —
+        0.0 for cache hits and for waiters blocked on another thread's
+        computation (their blocked time is not their compute time)."""
+        with self._lock:
+            fut = self._futs.get(key)
+            owner = fut is None
+            if owner:
+                fut = self._futs[key] = Future()
+        spent = 0.0
+        if owner:
+            t0 = time.monotonic()
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — propagate to waiters
+                if self._retry:
+                    with self._lock:
+                        self._futs.pop(key, None)
+                fut.set_exception(e)
+            spent = time.monotonic() - t0
+        return fut.result(), spent
