@@ -227,7 +227,9 @@ def main() -> None:
 
         deltas = {}
         for key in m_f:
-            denom = max(abs(m_o[key]), 0.5)  # 1% of ≥0.005 absolute
+            # relative gate with a small absolute floor: near-zero
+            # metrics compare at 1% of 0.02 = 2e-4 absolute
+            denom = max(abs(m_o[key]), 0.02)
             d = abs(m_f[key] - m_o[key]) / denom
             deltas[key] = round(d, 5)
             worst = max(worst, d)

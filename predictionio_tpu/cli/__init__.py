@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import importlib
 import json
+import os
 import sys
 from typing import Any, List, Optional
 
@@ -740,6 +741,13 @@ def main(argv: Optional[List[str]] = None,
     if args.command == "version":
         _out(__version__)
         return 0
+    if os.environ.get("PIO_COORDINATOR") \
+            or os.environ.get("PIO_NUM_PROCESSES"):
+        # join the multi-controller system before any device use (the
+        # spark-submit --master role; TPU pods auto-detect without these)
+        from ..parallel.multihost import initialize_distributed
+
+        initialize_distributed()
     st = storage if storage is not None else get_storage()
     return COMMANDS[args.command](args, st)
 

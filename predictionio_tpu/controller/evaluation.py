@@ -186,7 +186,7 @@ class MetricEvaluator:
                 train_s=t_train,
                 eval_s=time.monotonic() - t0 - t_blocked)
 
-        workers = self.parallelism or min(4, max(len(params_list), 1))
+        workers = max(1, int(self.parallelism))
         if workers <= 1 or len(params_list) <= 1:
             scores = [score_one(i, ep) for i, ep in enumerate(params_list)]
         else:

@@ -76,13 +76,18 @@ class EventStoreFacade:
                       event_names: Optional[Sequence[str]] = None,
                       target_entity_type=ANY, target_entity_id=ANY,
                       float_props: Sequence[str] = ("rating",),
-                      ordered: bool = True, with_props: bool = True):
+                      ordered: bool = True, with_props: bool = True,
+                      host_sharded: bool = False):
         """The training-read path: the matching events as a
         :class:`~predictionio_tpu.data.columnar.ColumnarBatch` (dict-encoded
         numpy columns, vectorized filter pushdown) instead of an ``Event``
-        stream — what ``PEventStore.find``'s RDD was to the reference."""
+        stream — what ``PEventStore.find``'s RDD was to the reference.
+
+        ``host_sharded=True`` returns only THIS process's contiguous
+        slice under a multi-controller runtime (the RDD-partition-per-
+        executor role; single-process it is the identity)."""
         app_id, channel_id = self.resolve(app_name, channel_name)
-        return self.storage.events().find_columnar(
+        batch = self.storage.events().find_columnar(
             app_id, channel_id, EventFilter(
                 start_time=start_time, until_time=until_time,
                 entity_type=entity_type, entity_id=entity_id,
@@ -91,6 +96,17 @@ class EventStoreFacade:
                 target_entity_id=target_entity_id),
             float_props=float_props, ordered=ordered,
             with_props=with_props)
+        if host_sharded:
+            import jax
+
+            from ..parallel.multihost import host_shard_bounds
+
+            if jax.process_count() > 1:  # single-process: identity, free
+                import numpy as _np
+
+                start, stop = host_shard_bounds(batch.n)
+                batch = batch.take(_np.arange(start, stop))
+        return batch
 
     # -- property aggregation (PEventStore.aggregateProperties, :99) -------
     def aggregate_properties(

@@ -412,6 +412,28 @@ def _shard(x, mesh: Optional[Mesh], spec: P):
     return jax.device_put(x, NamedSharding(mesh, spec))
 
 
+_init_sharded_cache: dict = {}
+
+
+def _init_factors_sharded(key: jax.Array, n: int, n_padded: int,
+                          rank: int, mesh: Optional[Mesh]) -> jax.Array:
+    """Factor init with the output DIRECTLY computed into the row
+    sharding (jit ``out_shardings``) — under multi-controller JAX a
+    plain jit output lands on the local default device and a host-side
+    ``device_put`` to a cross-process sharding is not generally legal,
+    so the sharding must come out of the compiled program itself."""
+    if mesh is None:
+        return _init_factors(key, n=n, n_padded=n_padded, rank=rank)
+    ck = tuple(mesh.devices.flat)  # jit's static-arg cache handles shapes
+    fn = _init_sharded_cache.get(ck)
+    if fn is None:
+        fn = jax.jit(_init_factors.__wrapped__,
+                     static_argnames=("n", "n_padded", "rank"),
+                     out_shardings=NamedSharding(mesh, ROWS))
+        _init_sharded_cache[ck] = fn
+    return fn(key, n=n, n_padded=n_padded, rank=rank)
+
+
 def _auto_block_rows(n_per: int, L: int, rank: int) -> int:
     """Per-device rows per update block, targeting ~1GB for the [B, L, r]
     f32 gather temp. Fewer, bigger blocks matter more than temp memory:
@@ -608,7 +630,11 @@ def pack_ratings(ratings: RatingsCOO, params: ALSParams,
 
     Packing ships the COO to the device once; hyperparameter sweeps (and
     benchmarks) should pack once and pass ``packed=`` to every
-    ``train_als`` call so retrains skip the transfer + sort."""
+    ``train_als`` call so retrains skip the transfer + sort. Under a
+    multi-controller runtime this routes to
+    :func:`pack_ratings_multihost` (per-process device feeding)."""
+    if mesh is not None and jax.process_count() > 1:
+        return pack_ratings_multihost(ratings, params, mesh)
     n_dev = 1 if mesh is None else mesh.devices.size
     user_h = _pack(ratings.users, ratings.items, ratings.ratings,
                    ratings.n_users, params, n_dev)
@@ -652,6 +678,91 @@ def pack_ratings_cached(ratings: RatingsCOO, params: ALSParams,
     return memo.get(key, lambda: pack_ratings(ratings, params, mesh))
 
 
+def pack_ratings_multihost(ratings: RatingsCOO, params: ALSParams,
+                           mesh: Mesh, force: bool = False
+                           ) -> PackedRatings:
+    """Multi-controller packing (``jax.process_count() > 1``): every
+    process packs ONLY the history rows its local devices own and the
+    global blocked arrays are assembled from per-process shards
+    (``jax.make_array_from_process_local_data`` — the Spark-executor
+    feeding role, SURVEY §2.3). Single-process falls through to
+    :func:`pack_ratings`.
+
+    v1 contract: every process holds the same global COO (each host
+    reads the full event scan; the columnar reader makes that cheap) and
+    derives identical global layout metadata from it; only DEVICE memory
+    is sharded. Pad layout (per-side max_len) is used — the bucketed
+    layout's per-bucket shards don't split evenly across processes yet.
+    """
+    import jax
+
+    from ..ops.ragged import pack_histories, resolve_max_len
+
+    if jax.process_count() == 1 and not force:
+        return pack_ratings(ratings, params, mesh)
+
+    n_dev = mesh.devices.size
+    flat = list(mesh.devices.flat)
+    pid = jax.process_index()
+    mine = [i for i, d in enumerate(flat) if d.process_index == pid]
+    if not mine:
+        raise ValueError(f"process {pid} owns no devices in the mesh; "
+                         "build the mesh over every process's devices")
+    if mine != list(range(mine[0], mine[-1] + 1)):
+        raise ValueError("pack_ratings_multihost requires each process's "
+                         "devices to be contiguous in mesh order")
+
+    packed = PackedRatings(user_h=None, item_h=None, mesh=mesh)
+    sides = {
+        "user": (ratings.users, ratings.items, ratings.n_users),
+        "item": (ratings.items, ratings.users, ratings.n_items),
+    }
+    hs = {}
+    for side, (rows, cols, n_rows) in sides.items():
+        counts = np.bincount(rows, minlength=n_rows)
+        L = resolve_max_len(counts, n_rows,
+                            params.max_history and int(params.max_history))
+        n_pad = -(-n_rows // n_dev) * n_dev
+        n_per = n_pad // n_dev
+        start, stop = mine[0] * n_per, (mine[-1] + 1) * n_per
+        sel = (rows >= start) & (rows < min(stop, n_rows))
+        local = pack_histories(rows[sel] - start, cols[sel],
+                               ratings.ratings[sel],
+                               n_rows=stop - start, max_len=L,
+                               pad_rows_to=1)
+        d_loc = len(mine)
+        sharding = NamedSharding(mesh, ROWS)
+
+        def glob(arr, tail_shape):
+            return jax.make_array_from_process_local_data(
+                sharding, arr.reshape((d_loc,) + tail_shape),
+                (n_dev,) + tail_shape)
+
+        blocked = {
+            "idx": glob(local.indices, (n_per, L)),
+            "val": glob(local.values, (n_per, L)),
+            "cnt": glob(local.counts, (n_per,)),
+        }
+        key = (side, n_dev, tuple(mesh.devices.flat))
+        packed._blocked[key] = blocked
+        # n_rows/max_len drive factor sizing, _auto_block_rows and the
+        # flops model; the host-side padded matrices never exist globally
+        hs[side] = _LayoutOnlyHistories(n_rows=n_pad, max_len=L)
+    packed.user_h = hs["user"]
+    packed.item_h = hs["item"]
+    return packed
+
+
+@dataclass(frozen=True)
+class _LayoutOnlyHistories:
+    """Shape metadata standing in for a PaddedHistories whose blocked
+    device arrays were assembled directly from per-process shards (the
+    host-side padded matrices never exist globally)."""
+
+    n_rows: int
+    max_len: int
+
+
 def train_als(ratings: RatingsCOO, params: ALSParams,
               mesh: Optional[Mesh] = None,
               packed: Optional[Tuple[PaddedHistories, PaddedHistories]]
@@ -693,10 +804,10 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
         else item_h.n_rows
 
     ku, ki = jax.random.split(jax.random.key(params.seed))
-    U = _shard(_init_factors(ku, n=ratings.n_users, n_padded=u_rows_pad,
-                             rank=params.rank), mesh, ROWS)
-    V = _shard(_init_factors(ki, n=ratings.n_items, n_padded=i_rows_pad,
-                             rank=params.rank), mesh, ROWS)
+    U = _init_factors_sharded(ku, ratings.n_users, u_rows_pad,
+                              params.rank, mesh)
+    V = _init_factors_sharded(ki, ratings.n_items, i_rows_pad,
+                              params.rank, mesh)
     uh = packed.blocked("user", n_dev, mesh)
     ih = packed.blocked("item", n_dev, mesh)
 

@@ -58,17 +58,22 @@ def global_mesh(data: Optional[int] = None, model: int = 1):
     return make_mesh(data=data, model=model)
 
 
-def host_shard(array: np.ndarray, *, axis: int = 0) -> np.ndarray:
-    """This process's contiguous slice of a host-global array — what the
-    local event-store scan should yield before device feeding."""
+def host_shard_bounds(size: int) -> tuple:
+    """``(start, stop)`` of this process's contiguous slice of a
+    host-global axis of the given size."""
     import jax
 
     n = jax.process_count()
     i = jax.process_index()
-    size = array.shape[axis]
     per = (size + n - 1) // n
     start = min(i * per, size)
-    stop = min(start + per, size)
+    return start, min(start + per, size)
+
+
+def host_shard(array: np.ndarray, *, axis: int = 0) -> np.ndarray:
+    """This process's contiguous slice of a host-global array — what the
+    local event-store scan should yield before device feeding."""
+    start, stop = host_shard_bounds(array.shape[axis])
     return np.take(array, np.arange(start, stop), axis=axis)
 
 
