@@ -580,8 +580,15 @@ class LocalFSModels(ModelsDAO):
 
     def insert(self, model: Model) -> None:
         with self.c.lock:
-            with open(self._path(model.id), "wb") as f:
+            # temp + rename: a reader on another host/process must never
+            # see a truncated model blob mid-write
+            path = self._path(model.id)
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
                 f.write(model.models)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
 
     def get(self, model_id: str) -> Optional[Model]:
         path = self._path(model_id)

@@ -82,6 +82,23 @@ def _register_builtins() -> None:
 
     from . import localfs
 
+    from . import segmentfs
+
+    register_backend("SEGMENTFS", Backend(
+        make_client=lambda cfg: segmentfs.SegmentFSClient.from_config(cfg),
+        daos={
+            "events": lambda c: segmentfs.SegmentFSEventStore(c),
+            "apps": lambda c: segmentfs.SegmentFSApps(c),
+            "access_keys": lambda c: segmentfs.SegmentFSAccessKeys(c),
+            "channels": lambda c: segmentfs.SegmentFSChannels(c),
+            "engine_instances":
+                lambda c: segmentfs.SegmentFSEngineInstances(c),
+            "evaluation_instances":
+                lambda c: segmentfs.SegmentFSEvaluationInstances(c),
+            "models": lambda c: segmentfs.SegmentFSModels(c),
+        },
+        close=lambda c: c.close()))
+
     register_backend("LOCALFS", Backend(
         make_client=lambda cfg: localfs.LocalFSClient.from_config(cfg),
         daos={

@@ -1,0 +1,368 @@
+"""SEGMENTFS storage backend: content-addressed immutable segments +
+a manifest, laid out for SHARED filesystems (NFS, GCS/S3 fuse mounts,
+Lustre) where N pod hosts read the same event log concurrently.
+
+The role of the reference's network-capable backends (``storage/hbase``,
+``storage/jdbc``, ``storage/s3`` — every Spark executor could reach the
+store; ``JDBCPEvents.scala:49-89`` partitioned scans across them),
+re-designed for the object-store model instead of a database protocol:
+
+- **Segments are immutable and content-addressed** (name carries a
+  sha256 of the bytes). Once published they never change, so any number
+  of hosts read them lock-free and a per-process parse cache needs no
+  invalidation. This is the write-once layout object stores want.
+- **The manifest is the only mutable object**: an ordered list of
+  segment names, replaced atomically (write-temp + rename) under an
+  OS-level ``flock``. Readers never lock — they read whichever manifest
+  version is current and only ever see fully-published segments.
+- Deletes append tombstone segments; when tombstones outnumber live
+  events, writers compact (one merged segment, new manifest). Replaced
+  segments are garbage-collected only after a grace period so an
+  in-flight reader holding the previous manifest still finds its files.
+
+Metadata DAOs reuse the LOCALFS document implementations wrapped in the
+same cross-process lock, and model blobs are plain files — both are
+low-rate paths where a lock per mutation is fine.
+
+Caveat: ``flock`` coherence across hosts requires the shared filesystem
+to support POSIX locks (NFSv4 does; object-store fuse mounts usually do
+not). On lock-free mounts, run a single writer per (app, channel) —
+readers are always safe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from datetime import datetime
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..event import Event
+from . import localfs
+from .base import EventFilter, EventStore
+from .localfs import _flock
+
+#: compact when tombstoned/overwritten records outnumber live events
+_COMPACT_RATIO = 1.0
+#: seconds an unreferenced segment survives before gc (reader grace)
+_GC_GRACE_S = 300.0
+
+
+class SegmentFSClient(localfs.LocalFSClient):
+    """Root-directory handle + cross-process document locking.
+
+    Extends the LOCALFS client with (a) a per-process cache of PARSED
+    immutable segments and (b) a sequence allocator that holds the OS
+    lock across its read-modify-write (LOCALFS only held the in-process
+    lock — fine for one process, lost updates across many).
+    """
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        os.makedirs(os.path.join(path, "events"), exist_ok=True)
+        #: abs segment path → parsed records; immutable ⇒ never invalidated
+        self.segment_cache: Dict[str, List[dict]] = {}
+        #: log dir → (manifest segment tuple, live events, dead count) —
+        #: the manifest version fully determines the replay result, so a
+        #: serving-path get() must not rebuild 1M Event objects per call
+        self.replay_cache: Dict[str, tuple] = {}
+        self._seg_lock = threading.Lock()
+
+    @staticmethod
+    def from_config(cfg: dict) -> "SegmentFSClient":
+        path = cfg.get("PATH") or cfg.get("path")
+        if not path:
+            raise ValueError("SEGMENTFS source needs a PATH property "
+                             "(PIO_STORAGE_SOURCES_<NAME>_PATH)")
+        return SegmentFSClient(path)
+
+    def next_seq(self, name: str) -> int:
+        with self.lock, _flock(self.doc_path(f"{name}_seq")):
+            n = int(self.read_doc(f"{name}_seq", 0)) + 1
+            self.write_doc(f"{name}_seq", n)
+            return n
+
+    def parsed_segment(self, path: str) -> List[dict]:
+        with self._seg_lock:
+            recs = self.segment_cache.get(path)
+        if recs is not None:
+            return recs
+        with open(path, "r", encoding="utf-8") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        with self._seg_lock:
+            self.segment_cache[path] = recs
+        return recs
+
+
+def _log_dir(app_id: int, channel_id: Optional[int]) -> str:
+    return f"app_{app_id}" if channel_id is None \
+        else f"app_{app_id}_c{channel_id}"
+
+
+class SegmentFSEventStore(EventStore):
+    def __init__(self, client: SegmentFSClient):
+        self.c = client
+
+    # -- layout ------------------------------------------------------------
+    def _dir(self, app_id: int, channel_id: Optional[int]) -> str:
+        return os.path.join(self.c.root, "events",
+                            _log_dir(app_id, channel_id))
+
+    def _manifest_path(self, d: str) -> str:
+        return os.path.join(d, "manifest.json")
+
+    def _read_manifest(self, d: str) -> List[str]:
+        try:
+            with open(self._manifest_path(d), "r", encoding="utf-8") as f:
+                return json.load(f)["segments"]
+        except FileNotFoundError:
+            return []
+
+    def _write_manifest(self, d: str, segments: List[str]) -> None:
+        tmp = os.path.join(
+            d, f".manifest.tmp.{os.getpid()}.{threading.get_ident()}")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"segments": segments,
+                       "updated": time.time()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path(d))
+
+    def _write_segment(self, d: str, records: List[dict]) -> str:
+        payload = "".join(json.dumps(r) + "\n" for r in records)
+        data = payload.encode("utf-8")
+        digest = hashlib.sha256(data).hexdigest()[:20]
+        name = f"seg-{len(records)}-{digest}.jsonl"
+        path = os.path.join(d, name)
+        if not os.path.exists(path):  # content-addressed: idempotent
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        return name
+
+    def _publish(self, d: str, records: List[dict]) -> None:
+        """Write one immutable segment and link it into the manifest, both
+        under the cross-process lock — writing inside the critical section
+        closes the window where :meth:`gc` (which takes the same lock)
+        could collect a written-but-not-yet-linked segment. A crash before
+        the manifest write leaves an unreferenced file for gc, never a
+        torn log."""
+        with _flock(self._manifest_path(d)):
+            name = self._write_segment(d, records)
+            segments = self._read_manifest(d)
+            if name not in segments:
+                self._write_manifest(d, segments + [name])
+
+    # -- EventStore contract ----------------------------------------------
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        d = self._dir(app_id, channel_id)
+        os.makedirs(d, exist_ok=True)
+        if not os.path.exists(self._manifest_path(d)):
+            with _flock(self._manifest_path(d)):
+                if not os.path.exists(self._manifest_path(d)):
+                    self._write_manifest(d, [])
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        d = self._dir(app_id, channel_id)
+        if not os.path.isdir(d):
+            return False
+        # the lock sidecar (and the directory) must survive: unlinking a
+        # lockfile lets a process blocked on the old inode and one that
+        # re-creates it each hold an "exclusive" flock simultaneously
+        # (same invariant as localfs.remove)
+        with _flock(self._manifest_path(d)):
+            for name in os.listdir(d):
+                if name.startswith("seg-") or name == "manifest.json":
+                    p = os.path.join(d, name)
+                    with self.c._seg_lock:
+                        self.c.segment_cache.pop(p, None)
+                    if os.path.isfile(p):
+                        os.unlink(p)
+        with self.c._seg_lock:
+            self.c.replay_cache.pop(d, None)
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        if not events:
+            return []
+        d = self._dir(app_id, channel_id)
+        os.makedirs(d, exist_ok=True)
+        records, ids = [], []
+        for e in events:
+            eid = e.event_id or uuid.uuid4().hex
+            records.append({"op": "put", "event": e.copy(event_id=eid).to_json()})
+            ids.append(eid)
+        self._publish(d, records)
+        return ids
+
+    def _replay(self, app_id: int, channel_id: Optional[int],
+                deadline: Optional[float] = None
+                ) -> Tuple[Dict[str, Event], int]:
+        """live events (insertion-ordered) + dead-record count, from the
+        current manifest's immutable segments. Cached per manifest
+        version (the segment-name tuple fully determines the result);
+        ``deadline`` bounds a cold replay on the serving path
+        (``EventFilter.deadline`` contract, ``base.py``)."""
+        d = self._dir(app_id, channel_id)
+        segments = tuple(self._read_manifest(d))
+        with self.c._seg_lock:
+            cached = self.c.replay_cache.get(d)
+        if cached is not None and cached[0] == segments:
+            return cached[1], cached[2]
+        live: Dict[str, Event] = {}
+        dead = 0
+        n = 0
+        for name in segments:
+            for r in self.c.parsed_segment(os.path.join(d, name)):
+                n += 1
+                if deadline is not None and n % 4096 == 0 \
+                        and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "segment replay exceeded its deadline")
+                if r["op"] == "put":
+                    e = Event.from_json(r["event"])
+                    if e.event_id in live:
+                        dead += 1
+                    live[e.event_id] = e
+                elif r["op"] == "del":
+                    if live.pop(r["id"], None) is not None:
+                        dead += 1
+                    dead += 1
+        with self.c._seg_lock:
+            self.c.replay_cache[d] = (segments, live, dead)
+        return live, dead
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        live, _ = self._replay(app_id, channel_id)
+        return live.get(event_id)
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        live, dead = self._replay(app_id, channel_id)
+        if event_id not in live:
+            return False
+        d = self._dir(app_id, channel_id)
+        self._publish(d, [{"op": "del", "id": event_id}])
+        if dead + 2 > len(live):
+            self._compact(app_id, channel_id)
+        return True
+
+    def _compact(self, app_id: int, channel_id: Optional[int]) -> None:
+        """Merge the log into one segment. Old segments stay on disk for
+        a grace period (readers holding the previous manifest), then
+        :meth:`gc` removes them."""
+        d = self._dir(app_id, channel_id)
+        with _flock(self._manifest_path(d)):
+            live, dead = self._replay(app_id, channel_id)
+            if dead == 0:
+                return
+            records = [{"op": "put", "event": e.to_json()}
+                       for e in live.values()]
+            name = self._write_segment(d, records) if records else None
+            self._write_manifest(d, [name] if name else [])
+
+    def gc(self, app_id: int, channel_id: Optional[int] = None,
+           grace_s: float = _GC_GRACE_S) -> int:
+        """Delete unreferenced segment files older than ``grace_s``.
+
+        Holds the manifest lock: publishing writes the segment and links
+        it under the same lock, so gc can never collect a file between
+        its write and its manifest entry (and the referenced-set it reads
+        is the current one)."""
+        d = self._dir(app_id, channel_id)
+        if not os.path.isdir(d):
+            return 0
+        n = 0
+        now = time.time()
+        with _flock(self._manifest_path(d)):
+            referenced = set(self._read_manifest(d))
+            for name in os.listdir(d):
+                if not name.startswith("seg-") or name in referenced:
+                    continue
+                p = os.path.join(d, name)
+                try:
+                    if now - os.path.getmtime(p) >= grace_s:
+                        os.unlink(p)
+                        with self.c._seg_lock:
+                            self.c.segment_cache.pop(p, None)
+                        n += 1
+                except OSError:
+                    pass
+        return n
+
+    def find(self, app_id: int, channel_id: Optional[int] = None,
+             filter: EventFilter = EventFilter()) -> Iterator[Event]:
+        live, _ = self._replay(app_id, channel_id,
+                               deadline=filter.deadline)
+        # sort by epoch millis, not raw datetimes: naive and tz-aware
+        # event times must not TypeError against each other
+        events = sorted(live.values(), key=lambda e: e.event_time_millis,
+                        reverse=filter.reversed)
+        it = filter.apply(events)
+        if filter.limit is not None and filter.limit >= 0:
+            import itertools
+            it = itertools.islice(it, filter.limit)
+        return it
+
+
+def _locked(method_names):
+    """Class decorator: wrap mutating DAO methods in the cross-process
+    document lock (the LOCALFS implementations they inherit only hold
+    the in-process lock — lost updates across pod hosts otherwise)."""
+    def deco(cls):
+        for mname in method_names:
+            base = getattr(cls.__mro__[1], mname)
+
+            def wrapper(self, *a, __base=base, **kw):
+                with _flock(self.c.doc_path(self.DOC)):
+                    return __base(self, *a, **kw)
+            wrapper.__name__ = mname
+            setattr(cls, mname, wrapper)
+        return cls
+    return deco
+
+
+@_locked(["insert", "update", "delete"])
+class SegmentFSApps(localfs.LocalFSApps):
+    DOC = "apps"
+
+
+@_locked(["insert", "update", "delete"])
+class SegmentFSAccessKeys(localfs.LocalFSAccessKeys):
+    DOC = "access_keys"
+
+
+@_locked(["insert", "delete"])
+class SegmentFSChannels(localfs.LocalFSChannels):
+    DOC = "channels"
+
+
+@_locked(["insert", "update", "delete"])
+class SegmentFSEngineInstances(localfs.LocalFSEngineInstances):
+    DOC = "engine_instances"
+
+
+@_locked(["insert", "update", "delete"])
+class SegmentFSEvaluationInstances(localfs.LocalFSEvaluationInstances):
+    DOC = "evaluation_instances"
+
+
+class SegmentFSModels(localfs.LocalFSModels):
+    pass  # inherits the temp+rename atomic blob writes

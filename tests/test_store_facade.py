@@ -102,16 +102,24 @@ def test_find_by_entity_deadline_bounds_heavy_scan(tmp_path):
     })
     facade = EventStoreFacade(cold)
 
-    t0 = time.monotonic()
-    with pytest.raises(TimeoutError):
-        facade.find_by_entity("heavy", "user", "whale", timeout_ms=1)
-    elapsed_ms = (time.monotonic() - t0) * 1000
-    # deadline fires inside the scan; generous bound for slow CI hosts
-    assert elapsed_ms < 500
+    # min-of-3: a gen-2 GC pause in a long pytest session (jax keeps
+    # millions of heap objects live) can dwarf the actual bounded scan
+    t_bounded = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            facade.find_by_entity("heavy", "user", "whale", timeout_ms=1)
+        t_bounded = min(t_bounded, time.monotonic() - t0)
 
     # an adequate timeout still returns the full result set
+    t0 = time.monotonic()
     out = facade.find_by_entity("heavy", "user", "whale", timeout_ms=60000)
+    t_full = time.monotonic() - t0
     assert len(out) == 20000
+    # the deadline fired INSIDE the scan: bounded time must be well under
+    # the full materialization (relative bound — absolute ms limits flake
+    # under CI/host load), with a floor for very fast disks
+    assert t_bounded < max(0.5, 0.6 * t_full), (t_bounded, t_full)
 
 
 def test_aggregate_properties_by_name(env):
