@@ -63,6 +63,21 @@ from .base import (
 )
 
 
+def atomic_write(path: str, data, fsync: bool = True) -> None:
+    """Write-temp + rename publish: readers (on any host) see either the
+    old content or the new, never a torn file. ``data`` is str or bytes.
+    The one copy of a pattern that had grown four hand-rolled variants."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    kwargs = {} if "b" in mode else {"encoding": "utf-8"}
+    with open(tmp, mode, **kwargs) as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class LocalFSClient:
     """Owns the root directory + a process-wide mutation lock."""
 
@@ -97,11 +112,7 @@ class LocalFSClient:
             return json.load(f)
 
     def write_doc(self, name: str, value) -> None:
-        path = self.doc_path(name)
-        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(value, f)
-        os.replace(tmp, path)  # atomic on POSIX
+        atomic_write(self.doc_path(name), json.dumps(value))
 
     def next_seq(self, name: str) -> int:
         """Monotonic id sequence per entity kind — deleted rows never free
@@ -580,15 +591,9 @@ class LocalFSModels(ModelsDAO):
 
     def insert(self, model: Model) -> None:
         with self.c.lock:
-            # temp + rename: a reader on another host/process must never
-            # see a truncated model blob mid-write
-            path = self._path(model.id)
-            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-            with open(tmp, "wb") as f:
-                f.write(model.models)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            # a reader on another host/process must never see a
+            # truncated model blob mid-write
+            atomic_write(self._path(model.id), model.models)
 
     def get(self, model_id: str) -> Optional[Model]:
         path = self._path(model_id)

@@ -44,7 +44,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 from ..event import Event
 from . import localfs
 from .base import EventFilter, EventStore
-from .localfs import _flock
+from .localfs import _flock, atomic_write
 
 #: compact when tombstoned/overwritten records outnumber live events
 _COMPACT_RATIO = 1.0
@@ -86,13 +86,24 @@ class SegmentFSClient(localfs.LocalFSClient):
             self.write_doc(f"{name}_seq", n)
             return n
 
-    def parsed_segment(self, path: str) -> List[dict]:
+    def parsed_segment(self, path: str,
+                       deadline: Optional[float] = None) -> List[dict]:
         with self._seg_lock:
             recs = self.segment_cache.get(path)
         if recs is not None:
             return recs
+        recs = []
         with open(path, "r", encoding="utf-8") as f:
-            recs = [json.loads(line) for line in f if line.strip()]
+            for ln, line in enumerate(f):
+                # a compacted log is ONE big segment: the serving-path
+                # deadline must bound the parse itself, not just the
+                # replay loop over already-parsed records
+                if deadline is not None and ln % 4096 == 0 \
+                        and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "segment parse exceeded its deadline")
+                if line.strip():
+                    recs.append(json.loads(line))
         with self._seg_lock:
             self.segment_cache[path] = recs
         return recs
@@ -123,14 +134,9 @@ class SegmentFSEventStore(EventStore):
             return []
 
     def _write_manifest(self, d: str, segments: List[str]) -> None:
-        tmp = os.path.join(
-            d, f".manifest.tmp.{os.getpid()}.{threading.get_ident()}")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"segments": segments,
-                       "updated": time.time()}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._manifest_path(d))
+        atomic_write(self._manifest_path(d),
+                     json.dumps({"segments": segments,
+                                 "updated": time.time()}))
 
     def _write_segment(self, d: str, records: List[dict]) -> str:
         payload = "".join(json.dumps(r) + "\n" for r in records)
@@ -139,12 +145,7 @@ class SegmentFSEventStore(EventStore):
         name = f"seg-{len(records)}-{digest}.jsonl"
         path = os.path.join(d, name)
         if not os.path.exists(path):  # content-addressed: idempotent
-            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-            with open(tmp, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            atomic_write(path, data)
         return name
 
     def _publish(self, d: str, records: List[dict]) -> None:
@@ -229,7 +230,8 @@ class SegmentFSEventStore(EventStore):
         dead = 0
         n = 0
         for name in segments:
-            for r in self.c.parsed_segment(os.path.join(d, name)):
+            for r in self.c.parsed_segment(os.path.join(d, name),
+                                           deadline=deadline):
                 n += 1
                 if deadline is not None and n % 4096 == 0 \
                         and time.monotonic() > deadline:
@@ -260,7 +262,7 @@ class SegmentFSEventStore(EventStore):
             return False
         d = self._dir(app_id, channel_id)
         self._publish(d, [{"op": "del", "id": event_id}])
-        if dead + 2 > len(live):
+        if dead + 2 > _COMPACT_RATIO * len(live):
             self._compact(app_id, channel_id)
         return True
 
@@ -270,6 +272,7 @@ class SegmentFSEventStore(EventStore):
         :meth:`gc` removes them."""
         d = self._dir(app_id, channel_id)
         with _flock(self._manifest_path(d)):
+            old = self._read_manifest(d)
             live, dead = self._replay(app_id, channel_id)
             if dead == 0:
                 return
@@ -277,6 +280,16 @@ class SegmentFSEventStore(EventStore):
                        for e in live.values()]
             name = self._write_segment(d, records) if records else None
             self._write_manifest(d, [name] if name else [])
+            # restart the gc grace clock from the moment a segment became
+            # UNREFERENCED (not from its creation): a reader holding the
+            # pre-compaction manifest must keep finding these files
+            now = time.time()
+            for n in old:
+                if n != name:
+                    try:
+                        os.utime(os.path.join(d, n), (now, now))
+                    except OSError:
+                        pass
 
     def gc(self, app_id: int, channel_id: Optional[int] = None,
            grace_s: float = _GC_GRACE_S) -> int:
@@ -294,7 +307,11 @@ class SegmentFSEventStore(EventStore):
         with _flock(self._manifest_path(d)):
             referenced = set(self._read_manifest(d))
             for name in os.listdir(d):
-                if not name.startswith("seg-") or name in referenced:
+                # unreferenced segments AND crashed-writer temp files
+                sweepable = (name.startswith("seg-")
+                             and name not in referenced) \
+                    or ".tmp." in name
+                if not sweepable:
                     continue
                 p = os.path.join(d, name)
                 try:

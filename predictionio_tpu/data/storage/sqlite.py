@@ -48,6 +48,10 @@ class SQLiteClient:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
+        # WAL + NORMAL: commits are durable against app crashes and only
+        # lose the tail on OS/power failure — the standard WAL trade, and
+        # ~10× fewer fsyncs on the per-event REST ingest path
+        self.conn.execute("PRAGMA synchronous=NORMAL")
         self.lock = threading.RLock()
         #: in-process columnar sidecar cache: table → (batch, watermark,
         #: count) — revalidated against the row store on every bulk read
@@ -197,13 +201,21 @@ class SQLiteEventStore(EventStore):
                f"({self.EVENT_COLS}) VALUES (?,?,?,?,?,?,?,?,?,?,?)")
         with self.client.lock:
             try:
-                self._conn.executemany(sql, rows)
-            except sqlite3.OperationalError as e:
-                if "no such table" not in str(e):
-                    raise
-                self.init(app_id, channel_id)
-                self._conn.executemany(sql, rows)
-            self._conn.commit()
+                try:
+                    self._conn.executemany(sql, rows)
+                except sqlite3.OperationalError as e:
+                    if "no such table" not in str(e):
+                        raise
+                    self.init(app_id, channel_id)
+                    self._conn.executemany(sql, rows)
+                self._conn.commit()
+            except BaseException:
+                # a failed executemany may have applied a prefix of the
+                # rows; roll it back so a caller's per-event retry (the
+                # event server's poison-batch fallback) cannot commit
+                # those rows alongside fresh duplicates
+                self._conn.rollback()
+                raise
         return ids
 
     # -- columnar bulk reads (PEvents role) --------------------------------
