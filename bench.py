@@ -24,6 +24,8 @@ Honesty model (BASELINE.md "bench accounting"):
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -123,6 +125,13 @@ def main():
 
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # The env var alone does not stop an installed TPU PJRT plugin
+        # from initializing (and hanging when the tunnel is down); the
+        # config update is authoritative. Lets CPU smoke runs of the
+        # bench work on a TPU-tunnel machine.
+        jax.config.update("jax_platforms", "cpu")
+
     from predictionio_tpu.models.als import (
         ALSParams,
         RatingsCOO,
@@ -202,5 +211,59 @@ def main():
     }))
 
 
+def supervise() -> int:
+    """Run the bench in child subprocesses with bounded retry + backoff.
+
+    The TPU tunnel is flaky at *backend init* time (round 2's driver run
+    died with "backend 'axon' failed to initialize" inside ``device_put``
+    and emitted nothing parseable). JAX caches a failed backend init for
+    the life of the process, so a retry must be a fresh process. Each
+    attempt also gets a hard timeout — the observed failure mode includes
+    indefinite hangs, not just fast errors.
+
+    On terminal failure this still prints the one JSON line, with
+    ``value: null`` and an ``error`` field, so the driver records *why*.
+    """
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1500"))
+    backoffs = [15.0, 45.0, 90.0]
+    last_err = "unknown"
+    for i in range(attempts):
+        env = dict(os.environ, BENCH_CHILD="1")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True,
+                timeout=attempt_timeout)
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {i + 1} timed out after {attempt_timeout}s"
+            sys.stderr.write(last_err + "\n")
+        else:
+            json_line = next(
+                (ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+            if proc.returncode == 0 and json_line is not None:
+                print(json_line)
+                return 0
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            last_err = (f"attempt {i + 1} rc={proc.returncode}: "
+                        + " | ".join(tail[-6:]))
+            sys.stderr.write(last_err + "\n")
+        if i < attempts - 1:
+            time.sleep(backoffs[min(i, len(backoffs) - 1)])
+    print(json.dumps({
+        "metric": "als_implicit_train_throughput",
+        "value": None,
+        "unit": "ratings/s/iter",
+        "vs_baseline": None,
+        "error": last_err[:2000],
+        "attempts": attempts,
+    }))
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        sys.exit(supervise())

@@ -287,7 +287,8 @@ def cmd_eval(args, storage: Storage) -> int:
     result = run_evaluation(
         ctx, evaluation, params_list,
         evaluation_class=args.evaluation,
-        params_generator_class=args.engine_params_generator or "")
+        params_generator_class=args.engine_params_generator or "",
+        parallelism=max(1, args.parallelism))
     _out(result.to_one_liner())
     return 0
 
@@ -642,6 +643,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="module.path:evaluation_object")
     s.add_argument("engine_params_generator", nargs="?", default="",
                    help="module.path:params_generator (optional)")
+    s.add_argument("--parallelism", type=int, default=1,
+                   help="grid-walk thread pool size (packing and fold "
+                        "prefixes are shared; >1 overlaps host work "
+                        "with device dispatches)")
 
     s = sub.add_parser("deploy", help="deploy the latest trained engine")
     add_engine_flags(s)

@@ -71,11 +71,12 @@ class StringDict:
     remapping (the property per-log dicts exist for).
     """
 
-    __slots__ = ("values", "index")
+    __slots__ = ("values", "index", "_pdidx")
 
     def __init__(self, values: Optional[List[str]] = None):
         self.values: List[str] = list(values or [])
         self.index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
+        self._pdidx = None  # lazy pandas Index for C-bulk lookups
 
     def __len__(self) -> int:
         return len(self.values)
@@ -88,9 +89,30 @@ class StringDict:
             self.values.append(s)
         return code
 
+    def _bulk_lookup(self, uniques) -> np.ndarray:
+        """Codes for a sequence of UNIQUE strings (appending unseen ones)
+        — one C-level hash join instead of n dict lookups; the Python
+        path only runs for genuinely-new values."""
+        if _pd is None or len(uniques) < 1024:
+            return np.fromiter((self.encode_one(u) for u in uniques),
+                               dtype=np.int32, count=len(uniques))
+        # the cached Index may be a STALE SNAPSHOT of values[:k] — codes
+        # never change, so its hits stay correct; misses (new since the
+        # snapshot, or genuinely new) take the dict path. Rebuild only
+        # when the dict has outgrown the snapshot enough that misses
+        # dominate — not on every append.
+        if self._pdidx is None or len(self.values) > 2 * len(self._pdidx):
+            self._pdidx = _pd.Index(self.values, dtype=object)
+        codes = self._pdidx.get_indexer(uniques).astype(np.int32)
+        for i in np.flatnonzero(codes < 0):
+            codes[i] = self.encode_one(uniques[i])
+        return codes
+
     def encode(self, strings: Sequence[Optional[str]],
                missing: int = -1) -> np.ndarray:
-        """Bulk-encode (appending unseen strings); None → ``missing``."""
+        """Bulk-encode (appending unseen strings); None → ``missing``.
+        ``bytes`` values are accepted (UTF-8) — bulk readers fetch raw
+        bytes so only the dictionary *uniques* pay a decode here."""
         n = len(strings)
         if n == 0:
             return np.empty(0, dtype=np.int32)
@@ -100,14 +122,17 @@ class StringDict:
             if len(uniques) == 0:  # every value None
                 return np.full(n, missing, dtype=np.int32)
             # map the batch-local codes onto the persistent dict
-            remap = np.fromiter((self.encode_one(u) for u in uniques),
-                                dtype=np.int32, count=len(uniques))
+            uniques = [u.decode("utf-8") if isinstance(u, bytes) else u
+                       for u in uniques.tolist()]
+            remap = self._bulk_lookup(uniques)
             out = np.where(codes >= 0, remap[np.maximum(codes, 0)],
                            np.int32(missing)).astype(np.int32)
             return out
         enc = self.encode_one
         return np.fromiter(
-            (missing if s is None else enc(s) for s in strings),
+            (missing if s is None else
+             enc(s.decode("utf-8") if isinstance(s, bytes) else s)
+             for s in strings),
             dtype=np.int32, count=n)
 
     def decode(self, codes: np.ndarray) -> List[Optional[str]]:
@@ -381,8 +406,11 @@ def columnar_from_columns(
         offsets = np.zeros(n + 1, dtype=np.int64)
         blob = np.empty(0, dtype=np.uint8)
     else:
-        encoded = [(p.encode("utf-8") if isinstance(p, str) and p
-                    and p != "{}" else b"") for p in props_json]
+        # props may arrive as str or raw utf-8 bytes (bulk readers fetch
+        # bytes to skip the per-row str decode)
+        encoded = [(b"" if not p or p == "{}" or p == b"{}"
+                    else p if isinstance(p, bytes)
+                    else p.encode("utf-8")) for p in props_json]
         lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
                            count=n)
         offsets = np.zeros(n + 1, dtype=np.int64)
@@ -528,8 +556,17 @@ class SegmentLog:
 
     # -- segments ----------------------------------------------------------
     def append(self, batch: ColumnarBatch, watermark,
-               prev_dict_counts: Dict[str, int]) -> None:
-        """Write ``batch`` as a new segment and commit the manifest."""
+               prev_dict_counts: Dict[str, int],
+               seq_range: Optional[Tuple[int, int]] = None,
+               has_props: bool = True) -> None:
+        """Write ``batch`` as a new segment and commit the manifest.
+
+        ``has_props=False`` defers the property-byte columns: the
+        training read never touches raw JSON, so the first encode can
+        skip fetching/concatenating it entirely; a later props-needing
+        reader upgrades the segment via :meth:`ensure_props` using the
+        recorded ``seq_range`` (source-row half-open range ``(lo, hi]``
+        in the backing store)."""
         os.makedirs(self.path, exist_ok=True)
         manifest = self.read_manifest() or {
             "count": 0, "segments": [], "float_props": [],
@@ -537,23 +574,55 @@ class SegmentLog:
         seg_name = f"seg-{len(manifest['segments']):06d}"
         seg_dir = os.path.join(self.path, seg_name)
         os.makedirs(seg_dir, exist_ok=True)
-        for col in _COLS:
+        cols = _COLS if has_props else tuple(
+            c for c in _COLS if not c.startswith("props_"))
+        for col in cols:
             np.save(os.path.join(seg_dir, f"{col}.npy"),
                     getattr(batch, col), allow_pickle=False)
         for name, arr in batch.float_props.items():
             np.save(os.path.join(seg_dir, f"prop_{name}.npy"), arr,
                     allow_pickle=False)
         self._write_dicts(batch.dicts, prev_dict_counts)
-        manifest["segments"].append({"name": seg_name, "n": batch.n})
+        entry = {"name": seg_name, "n": batch.n, "props": bool(has_props)}
+        if seq_range is not None:
+            entry["seq"] = [int(seq_range[0]), int(seq_range[1])]
+        manifest["segments"].append(entry)
         manifest["count"] += batch.n
         manifest["watermark"] = watermark
         manifest["float_props"] = sorted(
             set(manifest["float_props"]) | set(batch.float_props))
         self._write_manifest(manifest)
 
-    def load(self, mmap: bool = True) -> Tuple[Optional[ColumnarBatch],
-                                               Optional[dict]]:
-        """(batch, manifest) — batch columns mmap the segment files."""
+    def ensure_props(self, fetch) -> None:
+        """Upgrade props-deferred segments in place: ``fetch(lo, hi, n)``
+        must return ``(props_offsets [n+1] int64, props_blob uint8)`` for
+        the segment's recorded source range. Call under :meth:`lock`."""
+        manifest = self.read_manifest()
+        if manifest is None:
+            return
+        changed = False
+        for seg in manifest["segments"]:
+            if seg.get("props", True):
+                continue
+            lo, hi = seg["seq"]
+            offs, blob = fetch(lo, hi, seg["n"])
+            seg_dir = os.path.join(self.path, seg["name"])
+            np.save(os.path.join(seg_dir, "props_offsets.npy"), offs,
+                    allow_pickle=False)
+            np.save(os.path.join(seg_dir, "props_blob.npy"), blob,
+                    allow_pickle=False)
+            seg["props"] = True
+            changed = True
+        if changed:
+            self._write_manifest(manifest)
+
+    def load(self, mmap: bool = True, with_props: bool = True
+             ) -> Tuple[Optional[ColumnarBatch], Optional[dict]]:
+        """(batch, manifest) — batch columns mmap the segment files.
+
+        ``with_props=False`` skips the property-byte columns (and is the
+        only valid mode while any segment is still props-deferred —
+        callers wanting props run :meth:`ensure_props` first)."""
         manifest = self.read_manifest()
         if manifest is None:
             return None, None
@@ -567,12 +636,18 @@ class SegmentLog:
                 return np.load(os.path.join(seg_dir, f"{name}.npy"),
                                mmap_mode=mode, allow_pickle=False)
 
+            if with_props and not seg.get("props", True):
+                raise RuntimeError(
+                    f"segment {seg['name']} is props-deferred; call "
+                    f"ensure_props() before load(with_props=True)")
             parts.append(ColumnarBatch(
                 event=col("event"), entity_type=col("entity_type"),
                 entity_id=col("entity_id"), target_type=col("target_type"),
                 target_id=col("target_id"), event_time=col("event_time"),
-                props_offsets=col("props_offsets"),
-                props_blob=col("props_blob"),
+                props_offsets=(col("props_offsets") if with_props
+                               else np.zeros(seg["n"] + 1, np.int64)),
+                props_blob=(col("props_blob") if with_props
+                            else np.empty(0, np.uint8)),
                 float_props={name: col(f"prop_{name}")
                              for name in manifest["float_props"]
                              if os.path.exists(os.path.join(

@@ -118,8 +118,26 @@ class EventStore(abc.ABC):
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> List[str]:
         """Insert many events (``LEvents.futureInsertBatch``); backends may
-        override with a faster bulk path."""
-        return [self.insert(e, app_id, channel_id) for e in events]
+        override with a faster bulk path.
+
+        Contract: **all-or-nothing.** The event server's poison-batch
+        fallback retries per event after a failed batch, so a partial
+        commit would duplicate the committed prefix under fresh ids.
+        Transactional backends get this from their transaction; this
+        default compensates by deleting the already-inserted prefix
+        before re-raising."""
+        done: list = []
+        try:
+            for e in events:
+                done.append(self.insert(e, app_id, channel_id))
+        except Exception:
+            for eid in reversed(done):
+                try:
+                    self.delete(eid, app_id, channel_id)
+                except Exception:  # noqa: BLE001 — best-effort rollback
+                    pass
+            raise
+        return done
 
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int,

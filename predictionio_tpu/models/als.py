@@ -566,6 +566,14 @@ def _pack(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
             max_len=None if max_history is None else int(max_history),
             counts=counts)
     if mode == "split":
+        import warnings
+
+        warnings.warn(
+            "history_mode='split' scatter-adds duplicate row indices, "
+            "which TPUs serialize — measured ~5x slower than 'bucket' "
+            "at MovieLens-20M scale (BASELINE.md). 'bucket' is the "
+            "drop-free layout of choice; 'split' is kept for "
+            "comparison runs.", UserWarning, stacklevel=3)
         if counts is None:
             counts = np.bincount(rows, minlength=n_rows)
         L = int(max_history) if max_history is not None \

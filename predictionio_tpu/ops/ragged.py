@@ -361,7 +361,13 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
     buckets = []
     for L, rows_k, n_bk_pad, off in plan:
         n_bk = len(rows_k)
-        row_ids = np.full(n_bk_pad, n_rows_pad, dtype=np.int32)
+        # each padding row gets a DISTINCT out-of-range sentinel: the
+        # result-writeback scatter promises unique_indices=True, and a
+        # shared sentinel would make that promise false (UB per the JAX
+        # scatter contract) even though the rows drop
+        row_ids = (n_rows_pad
+                   + np.arange(n_bk_pad, dtype=np.int64) - n_bk
+                   ).astype(np.int32)
         row_ids[:n_bk] = rows_k
         cnt = np.zeros(n_bk_pad, dtype=np.int32)
         cnt[:n_bk] = counts[rows_k]

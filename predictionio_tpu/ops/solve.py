@@ -165,7 +165,9 @@ def solve_spd_batch(A: jax.Array, b: jax.Array,
     """
     r = A.shape[-1]
     A = A + jitter * jnp.eye(r, dtype=A.dtype)
-    if _use_pallas():
+    # the Pallas kernel's VMEM scratch is f32; non-f32 systems take the
+    # XLA path rather than hitting a dtype-mismatched kernel
+    if A.dtype == jnp.float32 and _use_pallas():
         lead = A.shape[:-2]  # arbitrary leading batch dims, like LAPACK's
         x = _solve_spd_pallas(A.reshape(-1, r, r), b.reshape(-1, r))
         return x.reshape(*lead, r)

@@ -101,8 +101,14 @@ def load_models_for_deploy(ctx: Context, engine: Engine,
 def run_evaluation(ctx: Context, evaluation: Evaluation,
                    params_list: Sequence[EngineParams],
                    evaluation_class: str = "",
-                   params_generator_class: str = "") -> MetricEvaluatorResult:
-    """Evaluate the search grid and record the winner."""
+                   params_generator_class: str = "",
+                   parallelism: int = 1) -> MetricEvaluatorResult:
+    """Evaluate the search grid and record the winner.
+
+    ``parallelism>1`` walks the grid with a thread pool (the reference's
+    ``.par`` grid walk, ``MetricEvaluator.scala:224-231``); packing and
+    fold prefixes are compute-once, so threads overlap host work with
+    device dispatches."""
     storage = ctx.storage
     instances = storage.evaluation_instances()
     instance_id = instances.insert(EvaluationInstance(
@@ -113,7 +119,7 @@ def run_evaluation(ctx: Context, evaluation: Evaluation,
     log.info("evaluation instance %s: started (%d params sets)",
              instance_id, len(params_list))
 
-    evaluator = MetricEvaluator(evaluation)
+    evaluator = MetricEvaluator(evaluation, parallelism=parallelism)
     result = evaluator.evaluate(ctx, params_list)
 
     done = instances.get(instance_id)

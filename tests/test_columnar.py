@@ -451,3 +451,46 @@ class TestSeqWatermarkSoundness:
         assert by_user["u0"] == 4.0
         assert np.isnan(by_user["u1"])  # string must NOT become 0.0
         assert np.isnan(by_user["u2"])  # bool must NOT become 1.0
+
+
+class TestPropsDeferredSidecar:
+    """Round-3: the first encode skips the property JSON (training never
+    reads it); props-needing readers upgrade segments in place."""
+
+    def test_first_encode_defers_props_then_upgrades(self, sq, tmp_path):
+        import json as _json
+
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(synth_events(150, seed=11), app_id)
+        # training-style first read: no props wanted
+        b = es.find_columnar(app_id, ordered=False, with_props=False)
+        assert b.n == 150
+        manifest = _json.loads(
+            (tmp_path / "pio.db.columnar" / "events_1" /
+             "manifest.json").read_text())
+        assert any(not s["props"] for s in manifest["segments"])
+        # props-wanting read upgrades segments and returns real props
+        bp = es.find_columnar(app_id)
+        rows = sorted(proj(e) for e in es.find(app_id))
+        cols = sorted(proj(e) for e in bp.to_events())
+        assert cols == rows
+        manifest = _json.loads(
+            (tmp_path / "pio.db.columnar" / "events_1" /
+             "manifest.json").read_text())
+        assert all(s["props"] for s in manifest["segments"])
+
+    def test_aggregation_after_deferred_encode(self, sq):
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.datamap import DataMap
+
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(
+            [Event(event="$set", entity_type="user", entity_id=f"u{i}",
+                   properties=DataMap({"plan": "pro", "k": i}))
+             for i in range(40)], app_id)
+        es.find_columnar(app_id, ordered=False, with_props=False)
+        props = es.aggregate_properties(app_id, entity_type="user")
+        assert props["u7"]["plan"] == "pro"
+        assert props["u7"]["k"] == 7
