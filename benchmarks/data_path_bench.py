@@ -83,26 +83,81 @@ def build_db(path: str, n_events: int, seed: int = 7) -> Storage:
     return storage
 
 
+def build_segmentfs(path: str, n_events: int, seed: int = 7) -> Storage:
+    """Same synthetic log via the shared-filesystem pod backend (events
+    ingested through the public insert_batch API — segmentfs has no
+    private fast lane)."""
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+
+    env = {
+        "PIO_STORAGE_SOURCES_FS_TYPE": "segmentfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": path,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+    }
+    storage = Storage(env=env)
+    if storage.apps().get_by_name("ml20m") is not None:
+        return storage
+    app_id = storage.apps().insert(App(0, "ml20m"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(seed)
+    t0 = time.monotonic()
+    chunk = 100_000
+    written = 0
+    while written < n_events:
+        m = min(chunk, n_events - written)
+        users = rng.integers(0, N_USERS, m)
+        items = (rng.zipf(1.3, m) - 1) % N_ITEMS
+        stars = rng.integers(1, 6, m).astype(np.float64)
+        es.insert_batch(
+            [Event(event="rate", entity_type="user",
+                   entity_id=f"u{users[j]}", target_entity_type="item",
+                   target_entity_id=f"i{items[j]}",
+                   properties=DataMap({"rating": float(stars[j])}))
+             for j in range(m)], app_id)
+        written += m
+        print(f"  ingest {written}/{n_events} "
+              f"({written / (time.monotonic() - t0):,.0f} ev/s)",
+              flush=True)
+    return storage
+
+
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000_000
     keep = "--keep" in sys.argv
+    backend = "segmentfs" if "--backend=segmentfs" in sys.argv else "sqlite"
     root = os.environ.get("PIO_BENCH_DIR", "/tmp/pio_datapath_bench")
     os.makedirs(root, exist_ok=True)
     db = os.path.join(root, f"bench_{n}.db")
 
-    print(f"== ingest ({n:,} events) ==", flush=True)
+    print(f"== ingest ({n:,} events, {backend}) ==", flush=True)
     t0 = time.monotonic()
-    storage = build_db(db, n)
+    if backend == "segmentfs":
+        storage = build_segmentfs(os.path.join(root, f"segfs_{n}"), n)
+    else:
+        storage = build_db(db, n)
     ingest_s = time.monotonic() - t0
     fac = EventStoreFacade(storage)
 
-    print("== first columnar read (sidecar encode) ==", flush=True)
+    print("== first columnar read (sidecar encode, training flags) ==",
+          flush=True)
     t0 = time.monotonic()
     batch = fac.find_columnar("ml20m", entity_type="user",
                               target_entity_type="item",
-                              event_names=["rate", "buy"])
+                              event_names=["rate", "buy"],
+                              ordered=False, with_props=False)
     encode_s = time.monotonic() - t0
     assert batch.n == n, (batch.n, n)
+
+    print("== props upgrade (first props-wanting read) ==", flush=True)
+    t0 = time.monotonic()
+    fac.find_columnar("ml20m", entity_type="user",
+                      target_entity_type="item",
+                      event_names=["rate", "buy"])
+    props_upgrade_s = time.monotonic() - t0
 
     print("== warm scans (steady-state training read) ==", flush=True)
     warm = []
@@ -127,16 +182,33 @@ def main() -> None:
     row_s_scaled = (time.monotonic() - t0) * (n / sub)
 
     result = {
+        "backend": backend,
         "n_events": n,
         "ingest_events_per_s": round(n / ingest_s),
         "encode_s": round(encode_s, 2),
         "encode_events_per_s": round(n / encode_s),
+        "props_upgrade_s": round(props_upgrade_s, 2),
         "warm_scan_s": round(warm_s, 3),
         "warm_scan_events_per_s": round(n / warm_s),
         "row_path_events_per_s": round(n / row_s_scaled),
         "speedup_vs_row_path": round(row_s_scaled / warm_s, 1),
         "nnz_check": int(len(coo.users)),
     }
+    if backend == "segmentfs":
+        # the pod payoff: a SECOND host mmaps the shared sidecar instead
+        # of re-parsing jsonl (fresh client = fresh process-local caches)
+        from predictionio_tpu.data.storage.segmentfs import (
+            SegmentFSClient,
+            SegmentFSEventStore,
+        )
+        es2 = SegmentFSEventStore(
+            SegmentFSClient(os.path.join(root, f"segfs_{n}")))
+        t0 = time.monotonic()
+        b2 = es2.find_columnar(1, ordered=False, with_props=False)
+        coo2, _, _ = ratings_from_columnar(b2)
+        result["second_host_first_read_s"] = round(
+            time.monotonic() - t0, 3)
+        assert len(coo2.users) == n
     print(json.dumps(result))
     if not keep:
         storage.close()

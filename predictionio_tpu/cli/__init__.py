@@ -383,6 +383,26 @@ def cmd_eventserver(args, storage: Storage) -> int:
     return 0
 
 
+def cmd_storageserver(args, storage: Storage) -> int:
+    """Serve this host's storage to REMOTE-backend clients (the pod
+    topology: TPU hosts → storage server for events/metadata/models, no
+    shared filesystem required)."""
+    from ..server.http import AppServer, ssl_context_from
+    from ..server.storageserver import build_app
+
+    ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
+    server = AppServer(build_app(storage, secret=args.secret or None),
+                       host=args.ip, port=args.port, ssl_context=ssl_ctx)
+    scheme = "https" if ssl_ctx else "http"
+    _out(f"Storage Server is listening at "
+         f"{scheme}://{args.ip}:{server.port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("Shutting down.")
+    return 0
+
+
 def cmd_adminserver(args, storage: Storage) -> int:
     from ..server.adminserver import create_admin_server
     from ..server.http import ssl_context_from
@@ -681,6 +701,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
     s.add_argument("--key", default="", help="PEM private key")
 
+    s = sub.add_parser("storageserver",
+                       help="serve storage to REMOTE-backend clients")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=7077)
+    s.add_argument("--secret", default="",
+                   help="shared secret clients must send")
+    s.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
+    s.add_argument("--key", default="", help="PEM private key")
+
     s = sub.add_parser("adminserver", help="start the admin API")
     s.add_argument("--ip", default="127.0.0.1")
     s.add_argument("--port", type=int, default=7071)
@@ -729,6 +758,7 @@ COMMANDS = {
     "undeploy": cmd_undeploy,
     "batchpredict": cmd_batchpredict,
     "eventserver": cmd_eventserver,
+    "storageserver": cmd_storageserver,
     "adminserver": cmd_adminserver,
     "dashboard": cmd_dashboard,
     "status": cmd_status,

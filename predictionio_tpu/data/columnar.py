@@ -35,6 +35,8 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -52,6 +54,81 @@ try:  # pandas.factorize is ~10x numpy for bulk string->code encoding
     import pandas as _pd
 except ImportError:  # pragma: no cover - pandas is baked into the image
     _pd = None
+
+
+# -- pandas-optional bulk helpers (backends' encode paths) ------------------
+# pandas is an ACCELERATOR here, never a dependency: every helper has a
+# slower pure-numpy/stdlib fallback with identical semantics.
+
+def bulk_factorize(values):
+    """(codes int64 [n], uniques object ndarray) — None → code -1."""
+    if _pd is not None:
+        return _pd.factorize(np.asarray(values, dtype=object),
+                             use_na_sentinel=True)
+    index: Dict[object, int] = {}
+    codes = np.empty(len(values), dtype=np.int64)
+    uniques: List[object] = []
+    for k, v in enumerate(values):
+        if v is None:
+            codes[k] = -1
+            continue
+        c = index.get(v)
+        if c is None:
+            c = index[v] = len(uniques)
+            uniques.append(v)
+        codes[k] = c
+    return codes, np.asarray(uniques, dtype=object)
+
+
+def bulk_to_float64(values, assume_numeric: bool = False) -> np.ndarray:
+    """Numbers → float64, anything else (None/str/bool) → NaN.
+
+    The strict path pays one isinstance pass so a numeric STRING like
+    ``"4.5"`` stays NaN (pandas ``to_numeric`` would parse it, silently
+    diverging from the lazy JSON-parse path's isinstance gate).
+    ``assume_numeric=True`` skips that pass — only for callers whose
+    upstream already type-gated (e.g. SQLite's ``json_type`` SQL)."""
+    if _pd is not None:
+        num = _pd.to_numeric(_pd.Series(list(values), dtype=object),
+                             errors="coerce")
+        out = num.to_numpy(dtype=np.float64, na_value=np.nan)
+        if assume_numeric:
+            return np.ascontiguousarray(out)
+        # to_numpy may hand back a read-only view: never write in place
+        good = np.fromiter(
+            (isinstance(v, (int, float)) and not isinstance(v, bool)
+             or v is None for v in values),
+            dtype=bool, count=len(values))
+        return np.where(good, out, np.nan)
+    return np.array([v if isinstance(v, (int, float))
+                     and not isinstance(v, bool) else np.nan
+                     for v in values], dtype=np.float64)
+
+
+def bulk_hash64(strings) -> np.ndarray:
+    """Deterministic 64-bit hashes of strings (uint64) — stable across
+    processes and hosts (pod hosts compare these on a shared fs), as
+    long as every host runs the same stack: the pandas path (siphash,
+    fixed key) and the fallback (blake2b) are each self-consistent but
+    differ from each other."""
+    if _pd is not None:
+        return _pd.util.hash_array(np.asarray(strings, dtype=object))
+    import hashlib
+
+    return np.fromiter(
+        (int.from_bytes(hashlib.blake2b(
+            s.encode("utf-8"), digest_size=8).digest(), "little")
+         for s in strings), dtype=np.uint64, count=len(strings))
+
+
+def bulk_iso_to_millis(strings) -> np.ndarray:
+    """ISO-8601 timestamps → epoch millis int64."""
+    if _pd is not None:
+        return (_pd.to_datetime(list(strings), utc=True,
+                                format="ISO8601").asi8 // 1_000_000)
+    from .event import parse_iso
+    return np.fromiter((to_millis(parse_iso(s)) for s in strings),
+                       dtype=np.int64, count=len(strings))
 
 __all__ = [
     "StringDict",
@@ -571,7 +648,10 @@ class SegmentLog:
         manifest = self.read_manifest() or {
             "count": 0, "segments": [], "float_props": [],
             "watermark": None}
-        seg_name = f"seg-{len(manifest['segments']):06d}"
+        # unique across GENERATIONS: after an invalidate with a grace
+        # period, retired segment dirs coexist with the new generation's
+        # (readers may still mmap them) — names must never collide
+        seg_name = f"seg-{len(manifest['segments']):06d}-{uuid.uuid4().hex[:8]}"
         seg_dir = os.path.join(self.path, seg_name)
         os.makedirs(seg_dir, exist_ok=True)
         cols = _COLS if has_props else tuple(
@@ -661,18 +741,55 @@ class SegmentLog:
         d = self._read_dicts()
         return d, d.counts()
 
-    def invalidate(self) -> None:
+    def invalidate(self, grace_s: float = 0.0) -> None:
         """Drop the sidecar's contents (deletes/compaction changed
         history). The manifest — the commit point — goes first; the
-        ``.lock`` file stays so waiters keep a valid inode."""
+        ``.lock`` file stays so waiters keep a valid inode.
+
+        ``grace_s > 0`` RETIRES segment directories instead of deleting
+        them: on a shared filesystem another host may still hold live
+        mmaps of these files (NFS gives no unlink-keeps-inode guarantee),
+        so they stay until :meth:`sweep` finds them idle past the grace
+        window — the same reader-grace invariant the jsonl log keeps."""
         import shutil
         if not os.path.isdir(self.path):
             return
         with contextlib.suppress(OSError):
             os.remove(self._manifest_path())
+        now = time.time()
         for name in os.listdir(self.path):
             if name == ".lock":
                 continue
             p = os.path.join(self.path, name)
+            if grace_s > 0 and name.startswith("seg-") \
+                    and os.path.isdir(p):
+                # restart the grace clock from retirement, not creation
+                with contextlib.suppress(OSError):
+                    os.utime(p, (now, now))
+                continue
             with contextlib.suppress(OSError):
                 shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+
+    def sweep(self, grace_s: float) -> int:
+        """Delete retired (unreferenced) segment dirs idle ≥ ``grace_s``.
+        Call under :meth:`lock`."""
+        import shutil
+        if not os.path.isdir(self.path):
+            return 0
+        manifest = self.read_manifest()
+        referenced = {s["name"]
+                      for s in (manifest or {}).get("segments", ())}
+        n = 0
+        now = time.time()
+        for name in os.listdir(self.path):
+            if not name.startswith("seg-") or name in referenced:
+                continue
+            p = os.path.join(self.path, name)
+            try:
+                if os.path.isdir(p) \
+                        and now - os.path.getmtime(p) >= grace_s:
+                    shutil.rmtree(p)
+                    n += 1
+            except OSError:
+                pass
+        return n

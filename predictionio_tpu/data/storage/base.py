@@ -124,16 +124,26 @@ class EventStore(abc.ABC):
         fallback retries per event after a failed batch, so a partial
         commit would duplicate the committed prefix under fresh ids.
         Transactional backends get this from their transaction; this
-        default compensates by deleting the already-inserted prefix
-        before re-raising."""
+        default compensates before re-raising: fresh inserts are
+        deleted, and an insert that REPLACED an existing event (same
+        explicit event_id) gets its prior version re-inserted — the
+        store must look as if the batch never happened."""
         done: list = []
+        priors: dict = {}
         try:
             for e in events:
+                if e.event_id and e.event_id not in priors:
+                    priors[e.event_id] = self.get(e.event_id, app_id,
+                                                  channel_id)
                 done.append(self.insert(e, app_id, channel_id))
         except Exception:
             for eid in reversed(done):
                 try:
-                    self.delete(eid, app_id, channel_id)
+                    prior = priors.get(eid)
+                    if prior is not None:
+                        self.insert(prior, app_id, channel_id)
+                    else:
+                        self.delete(eid, app_id, channel_id)
                 except Exception:  # noqa: BLE001 — best-effort rollback
                     pass
             raise

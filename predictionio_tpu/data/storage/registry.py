@@ -99,6 +99,22 @@ def _register_builtins() -> None:
         },
         close=lambda c: c.close()))
 
+    from . import remote
+
+    register_backend("REMOTE", Backend(
+        make_client=lambda cfg: remote.RemoteClient.from_config(cfg),
+        daos={
+            "events": lambda c: remote.RemoteEventStore(c),
+            "apps": lambda c: remote.RemoteApps(c),
+            "access_keys": lambda c: remote.RemoteAccessKeys(c),
+            "channels": lambda c: remote.RemoteChannels(c),
+            "engine_instances": lambda c: remote.RemoteEngineInstances(c),
+            "evaluation_instances":
+                lambda c: remote.RemoteEvaluationInstances(c),
+            "models": lambda c: remote.RemoteModels(c),
+        },
+        close=lambda c: c.close()))
+
     register_backend("LOCALFS", Backend(
         make_client=lambda cfg: localfs.LocalFSClient.from_config(cfg),
         daos={

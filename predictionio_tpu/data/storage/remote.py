@@ -1,0 +1,392 @@
+"""REMOTE storage backend: EventStore + metadata DAOs over HTTP.
+
+The client half of the network-capable storage story (server:
+``server/storageserver.py``) — the role of the reference's JDBC /
+Elasticsearch / HBase sources (``JDBCLEvents.scala:109-247``,
+``ESLEvents.scala:106-150``): a TPU pod host with no shared filesystem
+reaches the event store over the network. Configure via the standard
+env scheme::
+
+    PIO_STORAGE_SOURCES_NET_TYPE=remote
+    PIO_STORAGE_SOURCES_NET_URL=http://storage-host:7077
+    PIO_STORAGE_SOURCES_NET_SECRET=...            # optional
+    PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=NET
+
+The bulk training read (:meth:`RemoteEventStore.find_columnar`) pulls
+the server's columnar sidecar as ONE ``.npz`` payload and caches it by
+``ETag`` — steady-state reads cost a single 304 round-trip, and filter
+pushdown then runs locally over the cached columns (same vectorized
+``ColumnarBatch.select`` every other backend uses).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterator, List, Optional, Sequence
+
+from ..event import Event
+from .base import (
+    AccessKeysDAO,
+    AppsDAO,
+    ChannelsDAO,
+    EngineInstancesDAO,
+    EvaluationInstancesDAO,
+    EventFilter,
+    EventStore,
+    Model,
+    ModelsDAO,
+    StorageError,
+)
+from .wire import (
+    batch_from_npz,
+    entity_from_doc,
+    entity_to_doc,
+    filter_to_doc,
+)
+
+
+class RemoteClient:
+    """One storage-server endpoint + connection policy (shared by the
+    DAOs of a source)."""
+
+    def __init__(self, url: str, secret: Optional[str] = None,
+                 timeout: float = 60.0, retries: int = 2):
+        self.url = url.rstrip("/")
+        self.secret = secret
+        self.timeout = timeout
+        self.retries = retries
+        #: (app_id, channel, props, float_props) → (etag, batch)
+        self.columnar_cache: dict = {}
+        self.lock = threading.Lock()
+
+    @staticmethod
+    def from_config(cfg: dict) -> "RemoteClient":
+        url = cfg.get("URL") or cfg.get("url")
+        if not url:
+            raise ValueError("REMOTE source needs a URL property "
+                             "(PIO_STORAGE_SOURCES_<NAME>_URL)")
+        return RemoteClient(
+            url, secret=cfg.get("SECRET"),
+            timeout=float(cfg.get("TIMEOUT", 60.0)))
+
+    def request(self, method: str, path: str, body: Optional[bytes] = None,
+                headers: Optional[dict] = None,
+                timeout: Optional[float] = None,
+                idempotent: bool = True):
+        """(status, headers, body). Connection errors retry with backoff
+        ONLY for ``idempotent`` requests — a lost RESPONSE means the
+        server may have committed, so a blind replay of a non-idempotent
+        call (e.g. a metadata insert that auto-assigns ids) would
+        duplicate it. Event inserts stay retryable because the client
+        assigns event ids up front (replays become id-keyed upserts)."""
+        hdrs = {"Content-Type": "application/json"}
+        if self.secret:
+            hdrs["X-PIO-Storage-Secret"] = self.secret
+        hdrs.update(headers or {})
+        last: Exception = StorageError("unreachable")
+        retries = self.retries if idempotent else 0
+        for attempt in range(retries + 1):
+            req = urllib.request.Request(
+                self.url + path, data=body, method=method, headers=hdrs)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout) as resp:
+                    return resp.status, dict(resp.headers), resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 304:
+                    return 304, dict(e.headers), b""
+                detail = ""
+                try:
+                    detail = json.loads(e.read().decode()).get("message", "")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise StorageError(
+                    f"storage server {e.code} on {path}: {detail}") from e
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last = e
+                if attempt < retries:
+                    time.sleep(0.2 * (attempt + 1))
+        raise StorageError(
+            f"storage server unreachable at {self.url}: {last}")
+
+    def rpc(self, path: str, doc: Optional[dict] = None,
+            idempotent: bool = True) -> dict:
+        _, _, body = self.request(
+            "POST", path, json.dumps(doc or {}).encode(),
+            idempotent=idempotent)
+        return json.loads(body.decode()) if body else {}
+
+    def close(self) -> None:
+        pass
+
+
+class RemoteEventStore(EventStore):
+    def __init__(self, client: RemoteClient):
+        self.c = client
+
+    def _base(self, app_id: int, channel_id: Optional[int]) -> str:
+        q = f"?channel={channel_id}" if channel_id else ""
+        return f"/v1/events/{app_id}", q
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        base, q = self._base(app_id, channel_id)
+        return bool(self.c.rpc(f"{base}/init{q}").get("ok"))
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        base, q = self._base(app_id, channel_id)
+        ok = bool(self.c.rpc(f"{base}/remove{q}").get("ok"))
+        with self.c.lock:
+            self.c.columnar_cache = {
+                k: v for k, v in self.c.columnar_cache.items()
+                if k[0] != app_id or k[1] != channel_id}
+        return ok
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        from ..event import new_event_id
+
+        base, q = self._base(app_id, channel_id)
+        # assign event ids CLIENT-side: a retried batch whose first
+        # attempt committed but lost its response then replays as an
+        # id-keyed upsert instead of duplicating every event
+        events = [e if e.event_id else e.copy(event_id=new_event_id())
+                  for e in events]
+        doc = [e.to_json() for e in events]
+        return self.c.rpc(f"{base}/batch{q}", doc).get("ids", [])
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        base, q = self._base(app_id, channel_id)
+        sep = "&" if q else "?"
+        _, _, body = self.c.request(
+            "GET", f"{base}/get{q}{sep}id={urllib.parse.quote(event_id)}")
+        d = json.loads(body.decode()).get("event")
+        return Event.from_json(d) if d else None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        base, q = self._base(app_id, channel_id)
+        return bool(self.c.rpc(f"{base}/delete{q}",
+                               {"id": event_id}).get("ok"))
+
+    def find(self, app_id: int, channel_id: Optional[int] = None,
+             filter: EventFilter = EventFilter()) -> Iterator[Event]:
+        base, q = self._base(app_id, channel_id)
+        timeout = None
+        if filter.deadline is not None:
+            timeout = max(filter.deadline - time.monotonic(), 0.001)
+        _, _, body = self.c.request(
+            "POST", f"{base}/find{q}",
+            json.dumps(filter_to_doc(filter)).encode(), timeout=timeout)
+        return iter([Event.from_json(d)
+                     for d in json.loads(body.decode())["events"]])
+
+    def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
+                      filter: EventFilter = EventFilter(),
+                      float_props: Sequence[str] = ("rating",),
+                      ordered: bool = True, with_props: bool = True):
+        base, q = self._base(app_id, channel_id)
+        sep = "&" if q else "?"
+        key = (app_id, channel_id, with_props, tuple(float_props))
+        with self.c.lock:
+            etag, cached = self.c.columnar_cache.get(key, (None, None))
+        headers = {"If-None-Match": etag} if etag else {}
+        path = (f"{base}/columnar{q}{sep}props="
+                f"{'1' if with_props else '0'}"
+                f"&float_props={','.join(float_props)}")
+        status, resp_headers, body = self.c.request(
+            "GET", path, headers=headers)
+        if status == 304 and cached is not None:
+            batch = cached
+        else:
+            batch = batch_from_npz(body)
+            new_etag = {k.lower(): v for k, v in
+                        resp_headers.items()}.get("etag")
+            with self.c.lock:
+                self.c.columnar_cache[key] = (new_etag, batch)
+        return batch.select(filter, ordered=ordered,
+                            with_props=with_props)
+
+    def aggregate_properties(self, app_id: int,
+                             channel_id: Optional[int] = None, *,
+                             entity_type: str, start_time=None,
+                             until_time=None, required=None):
+        from ..datamap import PropertyMap
+
+        base, q = self._base(app_id, channel_id)
+        doc = {
+            "entity_type": entity_type,
+            "start_time": start_time.isoformat() if start_time else None,
+            "until_time": until_time.isoformat() if until_time else None,
+            "required": list(required) if required else None,
+        }
+        from datetime import datetime
+
+        props = self.c.rpc(f"{base}/aggregate{q}", doc)["properties"]
+        return {k: PropertyMap(
+            v["fields"],
+            first_updated=datetime.fromisoformat(v["first_updated"]),
+            last_updated=datetime.fromisoformat(v["last_updated"]))
+            for k, v in props.items()}
+
+
+class _RemoteDAO:
+    DAO = ""
+
+    def __init__(self, client: RemoteClient):
+        self.c = client
+
+    def _rpc(self, method: str, *args, entity=None):
+        doc: dict = {"args": list(args)}
+        if entity is not None:
+            doc["entity"] = entity_to_doc(entity)
+        # metadata inserts auto-assign ids server-side → a lost-response
+        # replay would duplicate them; everything else is idempotent
+        return self.c.rpc(f"/v1/meta/{self.DAO}/{method}", doc,
+                          idempotent=(method != "insert"))
+
+    def _one(self, method: str, *args, entity=None):
+        out = self._rpc(method, *args, entity=entity)
+        if "entity" in out:
+            return entity_from_doc(self.DAO, out["entity"])
+        return out.get("result")
+
+    def _many(self, method: str, *args):
+        return [entity_from_doc(self.DAO, d)
+                for d in self._rpc(method, *args).get("entities", [])]
+
+
+class RemoteApps(_RemoteDAO, AppsDAO):
+    DAO = "apps"
+
+    def insert(self, app):
+        return self._one("insert", entity=app)
+
+    def get(self, app_id):
+        return self._one("get", app_id)
+
+    def get_by_name(self, name):
+        return self._one("get_by_name", name)
+
+    def get_all(self):
+        return self._many("get_all")
+
+    def update(self, app):
+        self._one("update", entity=app)
+
+    def delete(self, app_id):
+        self._one("delete", app_id)
+
+
+class RemoteAccessKeys(_RemoteDAO, AccessKeysDAO):
+    DAO = "access_keys"
+
+    def insert(self, access_key):
+        return self._one("insert", entity=access_key)
+
+    def get(self, key):
+        return self._one("get", key)
+
+    def get_all(self):
+        return self._many("get_all")
+
+    def get_by_app_id(self, app_id):
+        return self._many("get_by_app_id", app_id)
+
+    def update(self, access_key):
+        self._one("update", entity=access_key)
+
+    def delete(self, key):
+        self._one("delete", key)
+
+
+class RemoteChannels(_RemoteDAO, ChannelsDAO):
+    DAO = "channels"
+
+    def insert(self, channel):
+        return self._one("insert", entity=channel)
+
+    def get(self, channel_id):
+        return self._one("get", channel_id)
+
+    def get_by_app_id(self, app_id):
+        return self._many("get_by_app_id", app_id)
+
+    def delete(self, channel_id):
+        self._one("delete", channel_id)
+
+
+class RemoteEngineInstances(_RemoteDAO, EngineInstancesDAO):
+    DAO = "engine_instances"
+
+    def insert(self, instance):
+        return self._one("insert", entity=instance)
+
+    def get(self, instance_id):
+        return self._one("get", instance_id)
+
+    def get_all(self):
+        return self._many("get_all")
+
+    def update(self, instance):
+        self._one("update", entity=instance)
+
+    def delete(self, instance_id):
+        self._one("delete", instance_id)
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return self._many("get_completed", engine_id, engine_version,
+                          engine_variant)
+
+
+class RemoteEvaluationInstances(_RemoteDAO, EvaluationInstancesDAO):
+    DAO = "evaluation_instances"
+
+    def insert(self, instance):
+        return self._one("insert", entity=instance)
+
+    def get(self, instance_id):
+        return self._one("get", instance_id)
+
+    def get_all(self):
+        return self._many("get_all")
+
+    def get_completed(self):
+        return self._many("get_completed")
+
+    def update(self, instance):
+        self._one("update", entity=instance)
+
+    def delete(self, instance_id):
+        self._one("delete", instance_id)
+
+
+class RemoteModels(_RemoteDAO, ModelsDAO):
+    DAO = "models"
+
+    def insert(self, model: Model) -> None:
+        self.c.rpc("/v1/meta/models/insert", {"model": {
+            "id": model.id,
+            "models": base64.b64encode(model.models).decode()}})
+
+    def get(self, model_id: str) -> Optional[Model]:
+        out = self.c.rpc("/v1/meta/models/get", {"args": [model_id]})
+        m = out.get("model")
+        return None if m is None else Model(
+            id=m["id"], models=base64.b64decode(m["models"]))
+
+    def delete(self, model_id: str) -> None:
+        self.c.rpc("/v1/meta/models/delete", {"args": [model_id]})

@@ -94,7 +94,8 @@ def _encode_range_worker(path: str, sql: str, rng: tuple,
     Returns plain numpy payloads; the parent remaps local dictionary
     codes onto the persistent per-log dictionaries."""
     import numpy as np
-    import pandas as pd
+
+    from ..columnar import bulk_factorize, bulk_to_float64
 
     conn = sqlite3.connect(path)
     conn.text_factory = bytes
@@ -110,18 +111,16 @@ def _encode_range_worker(path: str, sql: str, rng: tuple,
     uniq_out = {}
     for name, j in (("event", 0), ("entity_type", 1), ("entity_id", 2),
                     ("target_type", 3), ("target_id", 4)):
-        # ndarray (not pd.array): factorize then returns uniques as an
-        # object ndarray whose .tolist() is C-speed — the ExtensionArray
-        # path boxes every element through __getitem__
-        codes, uniques = pd.factorize(np.asarray(cols[j], dtype=object),
-                                      use_na_sentinel=True)
+        # bulk_factorize hands uniques back as an object ndarray whose
+        # .tolist() is C-speed (pandas ExtensionArray iteration would
+        # box every element through __getitem__)
+        codes, uniques = bulk_factorize(cols[j])
         codes_out[name] = codes.astype(np.int32)
         uniq_out[name] = [u.decode("utf-8") if isinstance(u, bytes)
                           else u for u in uniques.tolist()]
-    # json_extract yields float/int/None; to_numeric is the C-level
-    # None→NaN conversion (bools can't appear: json_type gated in SQL)
-    fpv = [pd.to_numeric(pd.Series(cols[6 + j]), errors="coerce")
-           .to_numpy(dtype=np.float64, na_value=np.nan)
+    # json_extract yields float/int/None only (json_type gated in SQL),
+    # so the strict isinstance pass is skippable
+    fpv = [bulk_to_float64(cols[6 + j], assume_numeric=True)
            for j in range(n_props)]
     return dict(codes=codes_out, uniq=uniq_out,
                 times=np.asarray(cols[5], dtype=np.int64), fpv=fpv,

@@ -1,0 +1,153 @@
+"""Wire serialization shared by the storage server and the REMOTE
+client backend (the client-server storage role of the reference's JDBC /
+Elasticsearch / HBase sources — ``JDBCLEvents.scala:109-247``,
+``ESLEvents.scala:106-150``: every host reaches the event store over the
+network, no shared filesystem required).
+
+Three formats:
+
+- metadata entities ↔ JSON docs (datetimes as ISO strings)
+- :class:`EventFilter` ↔ JSON (the ``ANY`` tri-state sentinel encoded
+  explicitly — ``{"any": true}`` vs ``{"value": ...}`` — matching the
+  reference's ``Option[Option[String]]`` trick)
+- :class:`ColumnarBatch` ↔ one ``.npz`` payload (columns + dictionary
+  value arrays, no pickling) for the bulk training read
+"""
+
+from __future__ import annotations
+
+import io
+from datetime import datetime
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import (
+    ANY,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    EventFilter,
+)
+
+# -- metadata entities ------------------------------------------------------
+
+_DT_FIELDS = ("start_time", "end_time")
+
+
+def entity_to_doc(e) -> dict:
+    import dataclasses
+
+    d = dataclasses.asdict(e)
+    for k in _DT_FIELDS:
+        if isinstance(d.get(k), datetime):
+            d[k] = d[k].isoformat()
+    if "events" in d:
+        d["events"] = list(d["events"])
+    return d
+
+
+_ENTITY_TYPES = {
+    "apps": App,
+    "access_keys": AccessKey,
+    "channels": Channel,
+    "engine_instances": EngineInstance,
+    "evaluation_instances": EvaluationInstance,
+}
+
+
+def entity_from_doc(dao: str, d: dict):
+    cls = _ENTITY_TYPES[dao]
+    d = dict(d)
+    for k in _DT_FIELDS:
+        if isinstance(d.get(k), str):
+            d[k] = datetime.fromisoformat(d[k])
+    if "events" in d and d["events"] is not None:
+        d["events"] = tuple(d["events"])
+    return cls(**d)
+
+
+# -- EventFilter ------------------------------------------------------------
+
+def filter_to_doc(f: EventFilter) -> dict:
+    def tri(v) -> Dict[str, Any]:
+        return {"any": True} if v is ANY else {"value": v}
+
+    return {
+        "start_time": f.start_time.isoformat() if f.start_time else None,
+        "until_time": f.until_time.isoformat() if f.until_time else None,
+        "entity_type": f.entity_type,
+        "entity_id": f.entity_id,
+        "event_names": (list(f.event_names)
+                        if f.event_names is not None else None),
+        "target_entity_type": tri(f.target_entity_type),
+        "target_entity_id": tri(f.target_entity_id),
+        "limit": f.limit,
+        "reversed": f.reversed,
+        # deadline is a LOCAL monotonic clock value — it cannot cross the
+        # wire; the client maps it to an HTTP timeout instead
+    }
+
+
+def filter_from_doc(d: Optional[dict]) -> EventFilter:
+    if not d:
+        return EventFilter()
+
+    def tri(v):
+        if not isinstance(v, dict) or v.get("any"):
+            return ANY
+        return v.get("value")
+
+    def dt(s):
+        return datetime.fromisoformat(s) if s else None
+
+    return EventFilter(
+        start_time=dt(d.get("start_time")),
+        until_time=dt(d.get("until_time")),
+        entity_type=d.get("entity_type"),
+        entity_id=d.get("entity_id"),
+        event_names=d.get("event_names"),
+        target_entity_type=tri(d.get("target_entity_type", {"any": True})),
+        target_entity_id=tri(d.get("target_entity_id", {"any": True})),
+        limit=d.get("limit"),
+        reversed=bool(d.get("reversed")),
+    )
+
+
+# -- ColumnarBatch ----------------------------------------------------------
+
+_BATCH_COLS = ("event", "entity_type", "entity_id", "target_type",
+               "target_id", "event_time", "props_offsets", "props_blob")
+_DICT_NAMES = ("event_names", "entity_types", "entity_ids",
+               "target_types", "target_ids")
+
+
+def batch_to_npz(batch) -> bytes:
+    """Serialize a ColumnarBatch (pickle-free: dictionary values go as
+    numpy unicode arrays)."""
+    arrays = {c: np.asarray(getattr(batch, c)) for c in _BATCH_COLS}
+    for name in _DICT_NAMES:
+        vals = getattr(batch.dicts, name).values
+        arrays[f"dict_{name}"] = np.asarray(vals, dtype="U") if vals \
+            else np.empty(0, dtype="U1")
+    for name, arr in batch.float_props.items():
+        arrays[f"prop_{name}"] = np.asarray(arr)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def batch_from_npz(data: bytes):
+    from ..columnar import ColumnarBatch, ColumnarDicts, StringDict
+
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        dicts = ColumnarDicts(**{
+            name: StringDict([str(v) for v in z[f"dict_{name}"]])
+            for name in _DICT_NAMES})
+        return ColumnarBatch(
+            **{c: z[c] for c in _BATCH_COLS},
+            float_props={k[len("prop_"):]: z[k] for k in z.files
+                         if k.startswith("prop_")},
+            dicts=dicts)

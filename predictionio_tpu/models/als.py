@@ -604,6 +604,11 @@ class PackedRatings:
     user_h: object
     item_h: object
     mesh: Optional[Mesh] = None
+    #: real (unpadded) problem dims — lets ``train_als(None, packed=...)``
+    #: run without the host holding any RatingsCOO (multi-host partial
+    #: reads feed shards straight from storage)
+    n_users: Optional[int] = None
+    n_items: Optional[int] = None
     _blocked: dict = field(default_factory=dict, repr=False)
     _lock: object = field(default_factory=threading.Lock, repr=False)
 
@@ -643,12 +648,15 @@ def pack_ratings(ratings: RatingsCOO, params: ALSParams,
     :func:`pack_ratings_multihost` (per-process device feeding)."""
     if mesh is not None and jax.process_count() > 1:
         return pack_ratings_multihost(ratings, params, mesh)
+    if hasattr(ratings, "to_coo"):  # a sharded source on one host
+        ratings = ratings.to_coo()
     n_dev = 1 if mesh is None else mesh.devices.size
     user_h = _pack(ratings.users, ratings.items, ratings.ratings,
                    ratings.n_users, params, n_dev)
     item_h = _pack(ratings.items, ratings.users, ratings.ratings,
                    ratings.n_items, params, n_dev)
-    return PackedRatings(user_h=user_h, item_h=item_h, mesh=mesh)
+    return PackedRatings(user_h=user_h, item_h=item_h, mesh=mesh,
+                         n_users=ratings.n_users, n_items=ratings.n_items)
 
 
 #: id(ratings) → (weakref-to-ratings, per-ratings ComputeOnce). The pack
@@ -686,7 +694,7 @@ def pack_ratings_cached(ratings: RatingsCOO, params: ALSParams,
     return memo.get(key, lambda: pack_ratings(ratings, params, mesh))
 
 
-def pack_ratings_multihost(ratings: RatingsCOO, params: ALSParams,
+def pack_ratings_multihost(ratings, params: ALSParams,
                            mesh: Mesh, force: bool = False
                            ) -> PackedRatings:
     """Multi-controller packing (``jax.process_count() > 1``): every
@@ -696,10 +704,14 @@ def pack_ratings_multihost(ratings: RatingsCOO, params: ALSParams,
     feeding role, SURVEY §2.3). Single-process falls through to
     :func:`pack_ratings`.
 
-    v1 contract: every process holds the same global COO (each host
-    reads the full event scan; the columnar reader makes that cheap) and
-    derives identical global layout metadata from it; only DEVICE memory
-    is sharded. Pad layout (per-side max_len) is used — the bucketed
+    v2 contract (partial reads): ``ratings`` may be a *sharded source*
+    (``read_rows``/``row_counts`` — e.g.
+    :class:`~predictionio_tpu.models.data.ColumnarRatingsSource` over a
+    shared-filesystem columnar sidecar), in which case each process
+    MATERIALIZES only the rating triples of its own row range — the
+    ``JDBCPEvents.scala:49-89`` partitioned-read role. A plain
+    :class:`RatingsCOO` (every host already holding the global COO)
+    still works. Pad layout (per-side max_len) is used — the bucketed
     layout's per-bucket shards don't split evenly across processes yet.
     """
     import jax
@@ -720,22 +732,33 @@ def pack_ratings_multihost(ratings: RatingsCOO, params: ALSParams,
         raise ValueError("pack_ratings_multihost requires each process's "
                          "devices to be contiguous in mesh order")
 
-    packed = PackedRatings(user_h=None, item_h=None, mesh=mesh)
-    sides = {
-        "user": (ratings.users, ratings.items, ratings.n_users),
-        "item": (ratings.items, ratings.users, ratings.n_items),
-    }
+    is_source = hasattr(ratings, "read_rows")
+    packed = PackedRatings(user_h=None, item_h=None, mesh=mesh,
+                           n_users=ratings.n_users,
+                           n_items=ratings.n_items)
+    sides = {"user": ratings.n_users, "item": ratings.n_items}
     hs = {}
-    for side, (rows, cols, n_rows) in sides.items():
-        counts = np.bincount(rows, minlength=n_rows)
+    for side, n_rows in sides.items():
+        if is_source:
+            counts = np.asarray(ratings.row_counts(side))
+        else:
+            rows_g = ratings.users if side == "user" else ratings.items
+            counts = np.bincount(rows_g, minlength=n_rows)
         L = resolve_max_len(counts, n_rows,
                             params.max_history and int(params.max_history))
         n_pad = -(-n_rows // n_dev) * n_dev
         n_per = n_pad // n_dev
         start, stop = mine[0] * n_per, (mine[-1] + 1) * n_per
-        sel = (rows >= start) & (rows < min(stop, n_rows))
-        local = pack_histories(rows[sel] - start, cols[sel],
-                               ratings.ratings[sel],
+        if is_source:
+            rows_l, cols_l, vals_l = ratings.read_rows(
+                side, start, min(stop, n_rows))
+        else:
+            rows_g = ratings.users if side == "user" else ratings.items
+            cols_g = ratings.items if side == "user" else ratings.users
+            sel = (rows_g >= start) & (rows_g < min(stop, n_rows))
+            rows_l, cols_l, vals_l = rows_g[sel], cols_g[sel], \
+                ratings.ratings[sel]
+        local = pack_histories(rows_l - start, cols_l, vals_l,
                                n_rows=stop - start, max_len=L,
                                pad_rows_to=1)
         d_loc = len(mine)
@@ -791,10 +814,25 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     the latest saved iteration (step-level resume, SURVEY §5 — the
     reference restarts training from scratch after any failure).
     """
-    if len(ratings.users) == 0 or ratings.n_users == 0 \
-            or ratings.n_items == 0:
-        raise ValueError("ALS requires a non-empty ratings matrix "
-                         "(0 entries/users/items given)")
+    if ratings is None:
+        # multi-host partial reads: the host never holds a global COO;
+        # the packed layout carries the problem dims instead
+        if not (isinstance(packed, PackedRatings)
+                and packed.n_users and packed.n_items):
+            raise ValueError(
+                "train_als(ratings=None) needs packed=PackedRatings with "
+                "n_users/n_items (from pack_ratings/_multihost)")
+        if checkpoint_dir:
+            raise ValueError(
+                "checkpointing fingerprints the ratings content; pass "
+                "the ratings (or use checkpoint_dir=None) ")
+        n_users_real, n_items_real = packed.n_users, packed.n_items
+    else:
+        if len(ratings.users) == 0 or ratings.n_users == 0 \
+                or ratings.n_items == 0:
+            raise ValueError("ALS requires a non-empty ratings matrix "
+                             "(0 entries/users/items given)")
+        n_users_real, n_items_real = ratings.n_users, ratings.n_items
     n_dev = 1 if mesh is None else mesh.devices.size
     if packed is None:
         packed = pack_ratings(ratings, params, mesh)
@@ -812,9 +850,9 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
         else item_h.n_rows
 
     ku, ki = jax.random.split(jax.random.key(params.seed))
-    U = _init_factors_sharded(ku, ratings.n_users, u_rows_pad,
+    U = _init_factors_sharded(ku, n_users_real, u_rows_pad,
                               params.rank, mesh)
-    V = _init_factors_sharded(ki, ratings.n_items, i_rows_pad,
+    V = _init_factors_sharded(ki, n_items_real, i_rows_pad,
                               params.rank, mesh)
     uh = packed.blocked("user", n_dev, mesh)
     ih = packed.blocked("item", n_dev, mesh)

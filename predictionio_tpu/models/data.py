@@ -82,22 +82,13 @@ def ratings_from_columnar(
         event_weights = {"rate": None, "buy": 4.0}
 
     d = batch.dicts
-    n = batch.n
-    vals = np.full(n, np.nan, dtype=np.float64)
-    sel = np.zeros(n, dtype=bool)
-    for name, w in event_weights.items():
-        code = d.event_names.index.get(name)
-        if code is None:
-            continue
-        m = batch.event == code
-        if w is None:
-            col = batch.float_prop("rating")
-            vals = np.where(m, col, vals)
-            sel |= m & ~np.isnan(col)
-        else:
-            vals = np.where(m, float(w), vals)
-            sel |= m
-    sel &= batch.target_id >= 0
+    by_code = {d.event_names.index[nm]: w
+               for nm, w in event_weights.items()
+               if nm in d.event_names.index}
+    needs_prop = any(w is None for w in by_code.values())
+    sel, vals = rating_selection(
+        batch.event, batch.target_id,
+        batch.float_prop("rating") if needs_prop else None, by_code)
 
     u_codes = batch.entity_id[sel]
     i_codes = batch.target_id[sel]
@@ -145,3 +136,145 @@ def kfold_split(n: int, k: int, seed: int = 0) -> list:
     rng = np.random.default_rng(seed)
     fold_of = rng.integers(0, k, size=n)
     return [(fold_of != f, fold_of == f) for f in range(k)]
+
+
+# ---------------------------------------------------------------------------
+# Sharded rating sources (multi-host partial reads)
+# ---------------------------------------------------------------------------
+
+
+def rating_selection(event_col, target_col, rating_col,
+                     weights_by_code: Dict[int, Optional[float]]):
+    """Shared event-selection/weight semantics of the training read:
+    fixed-weight events always select; None-weight events read the
+    ``rating`` float column and drop NaN rows; rows without a target
+    never select. BOTH the one-shot COO conversion
+    (:func:`ratings_from_columnar`) and the sharded source
+    (:class:`ColumnarRatingsSource`) call this — the multihost
+    v2-vs-v1 factor-equivalence guarantee rests on the two paths
+    agreeing exactly.
+
+    Returns ``(sel bool [n], vals float64 [n])`` (vals NaN outside
+    ``sel``; ``rating_col`` may be None when no event needs it)."""
+    ev = np.asarray(event_col)
+    n = len(ev)
+    sel = np.zeros(n, dtype=bool)
+    vals = np.full(n, np.nan, dtype=np.float64)
+    for code, w in weights_by_code.items():
+        m = ev == code
+        if w is None:
+            assert rating_col is not None, \
+                "None-weight events need the rating column"
+            col = np.asarray(rating_col)
+            vals = np.where(m, col, vals)
+            sel |= m & ~np.isnan(col)
+        else:
+            vals = np.where(m, float(w), vals)
+            sel |= m
+    sel &= np.asarray(target_col) >= 0
+    return sel, vals
+
+
+class ColumnarRatingsSource:
+    """Per-shard rating reads straight off a (mmap-backed)
+    :class:`~predictionio_tpu.data.columnar.ColumnarBatch` — the
+    multi-host feeding contract v2 (the ``JDBCPEvents.scala:49-89``
+    partitioned-scan role): each pod host materializes ONLY the rating
+    triples whose factor-row index falls in its shard, instead of every
+    host holding the whole log's COO. Persistent per-host state is one
+    bool mask + the code→index LUTs; everything else streams through
+    ``chunk``-bounded temporaries over the mmap'd columns.
+
+    All hosts derive IDENTICAL id indexation (BiMaps) from the same
+    global batch, so shards assemble into one consistent model.
+    """
+
+    def __init__(self, batch,
+                 event_weights: Optional[Dict[str, Optional[float]]] = None,
+                 chunk: int = 4_000_000):
+        self.batch = batch
+        self.chunk = chunk
+        if event_weights is None:
+            event_weights = {"rate": None, "buy": 4.0}
+        self._weights = event_weights
+        d = batch.dicts
+        # entry mask + values via the SAME helper the one-shot COO
+        # conversion uses (rating_selection — semantic drift between the
+        # two paths would silently break multihost shard equivalence)
+        self._fixed = {d.event_names.index[nm]: w
+                       for nm, w in event_weights.items()
+                       if nm in d.event_names.index}
+        needs_prop = any(w is None for w in self._fixed.values())
+        sel, _ = rating_selection(
+            batch.event, batch.target_id,
+            batch.float_prop("rating") if needs_prop else None,
+            self._fixed)
+        self._sel = sel
+        self._needs_prop = needs_prop
+        # global id indexation: dictionary code -> dense factor row, in
+        # first-appearance order of the OBSERVED codes (deterministic on
+        # every host — same batch, same order)
+        u_counts = np.bincount(np.asarray(batch.entity_id)[sel],
+                               minlength=max(len(d.entity_ids), 1))
+        i_counts = np.bincount(np.asarray(batch.target_id)[sel],
+                               minlength=max(len(d.target_ids), 1))
+        u_uniq = np.flatnonzero(u_counts)
+        i_uniq = np.flatnonzero(i_counts)
+        self._u_lut = np.full(max(len(d.entity_ids), 1), -1, np.int64)
+        self._u_lut[u_uniq] = np.arange(len(u_uniq))
+        self._i_lut = np.full(max(len(d.target_ids), 1), -1, np.int64)
+        self._i_lut[i_uniq] = np.arange(len(i_uniq))
+        uv, iv = d.entity_ids.values, d.target_ids.values
+        self.user_ids = BiMap({uv[c]: j for j, c in enumerate(u_uniq)})
+        self.item_ids = BiMap({iv[c]: j for j, c in enumerate(i_uniq)})
+        self.n_users = len(u_uniq)
+        self.n_items = len(i_uniq)
+        self._u_counts = u_counts[u_uniq]
+        self._i_counts = i_counts[i_uniq]
+
+    def row_counts(self, side: str) -> np.ndarray:
+        return self._u_counts if side == "user" else self._i_counts
+
+    def _values(self, lo: int, hi: int) -> np.ndarray:
+        """Rating values for batch slice [lo, hi) — the shared
+        :func:`rating_selection` semantics, chunk-bounded."""
+        _, vals = rating_selection(
+            self.batch.event[lo:hi], self.batch.target_id[lo:hi],
+            (self.batch.float_prop("rating")[lo:hi]
+             if self._needs_prop else None), self._fixed)
+        return vals.astype(np.float32)
+
+    def read_rows(self, side: str, start: int, stop: int):
+        """All rating triples whose ``side`` factor row ∈ [start, stop),
+        as (row_idx, col_idx, value) — chunked over the mmap'd columns so
+        per-call temporaries stay bounded."""
+        row_lut, col_lut, row_col, col_col = (
+            (self._u_lut, self._i_lut, self.batch.entity_id,
+             self.batch.target_id) if side == "user" else
+            (self._i_lut, self._u_lut, self.batch.target_id,
+             self.batch.entity_id))
+        rows_out, cols_out, vals_out = [], [], []
+        n = self.batch.n
+        for lo in range(0, n, self.chunk):
+            hi = min(lo + self.chunk, n)
+            m = self._sel[lo:hi].copy()
+            if not m.any():
+                continue
+            r = row_lut[np.asarray(row_col[lo:hi])]
+            m &= (r >= start) & (r < stop)
+            if not m.any():
+                continue
+            vals = self._values(lo, hi)
+            rows_out.append(r[m])
+            cols_out.append(col_lut[np.asarray(col_col[lo:hi])][m])
+            vals_out.append(vals[m])
+        if not rows_out:
+            z = np.empty(0, np.int64)
+            return z, z, np.empty(0, np.float32)
+        return (np.concatenate(rows_out), np.concatenate(cols_out),
+                np.concatenate(vals_out))
+
+    def to_coo(self) -> RatingsCOO:
+        rows, cols, vals = self.read_rows("user", 0, self.n_users)
+        return RatingsCOO(rows.astype(np.int32), cols.astype(np.int32),
+                          vals, self.n_users, self.n_items)

@@ -1,0 +1,245 @@
+"""Storage server: the event log + metadata DAOs over HTTP.
+
+The network-capable storage story (the role of the reference's
+client-server backends — JDBC ``JDBCLEvents.scala:109-247``,
+Elasticsearch ``ESLEvents.scala:106-150``, HBase
+``HBEventsUtil.scala:76-110``): a TPU pod host with NO shared filesystem
+reaches its event store through this server, which fronts any local
+backend (SQLite by default). The REMOTE client backend
+(``data/storage/remote.py``) speaks this protocol behind the standard
+``EventStore``/DAO contracts, so engines and servers are oblivious.
+
+Protocol (JSON unless noted; optional shared-secret auth via the
+``X-PIO-Storage-Secret`` header):
+
+- ``POST /v1/events/<app>/init|remove|batch|delete|find|aggregate``
+- ``GET  /v1/events/<app>/get?id=``
+- ``GET  /v1/events/<app>/columnar`` — ``.npz`` bulk payload
+  (``ETag``/``If-None-Match`` so pod hosts re-download only on change)
+- ``POST /v1/meta/<dao>/<method>`` — whitelisted DAO RPCs
+- ``GET  /v1/status``
+
+The bulk read stays columnar end-to-end: the server answers from its
+backend's mmap'd sidecar and streams one compressed-free npz; clients
+cache by ETag, so steady-state training reads cost one 304 round-trip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import logging
+from typing import Optional
+
+from ..data.event import Event
+from ..data.storage import Storage
+from ..data.storage.base import EventFilter
+from ..data.storage.wire import (
+    batch_to_npz,
+    entity_from_doc,
+    entity_to_doc,
+    filter_from_doc,
+)
+from .http import AppServer, HTTPApp, HTTPError, Request, Response, \
+    json_response
+
+log = logging.getLogger("predictionio_tpu.storageserver")
+
+#: DAO → RPC methods exposed (exactly the DAO contracts in base.py)
+_META_METHODS = {
+    "apps": {"insert", "get", "get_by_name", "get_all", "update",
+             "delete"},
+    "access_keys": {"insert", "get", "get_all", "get_by_app_id",
+                    "update", "delete"},
+    "channels": {"insert", "get", "get_by_app_id", "delete"},
+    "engine_instances": {"insert", "get", "get_all", "update", "delete",
+                         "get_completed"},
+    "evaluation_instances": {"insert", "get", "get_all",
+                             "get_completed", "update", "delete"},
+    "models": {"insert", "get", "delete"},
+}
+
+
+def _batch_version(batch) -> str:
+    """Cheap content stamp for ETag caching: strided samples + sums of
+    EVERY column — including float-props and the property-byte offsets,
+    so a properties-only replace changes the stamp too."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    h.update(str(batch.n).encode())
+    cols = [batch.event, batch.entity_type, batch.entity_id,
+            batch.target_type, batch.target_id, batch.event_time,
+            batch.props_offsets, batch.props_blob]
+    cols += [batch.float_props[k] for k in sorted(batch.float_props)]
+    for arr in cols:
+        a = np.asarray(arr)
+        h.update(np.ascontiguousarray(a[:: max(1, len(a) // 65536)])
+                 .tobytes())
+        if np.issubdtype(a.dtype, np.floating):
+            s = float(np.nansum(a)) if len(a) else 0.0
+        else:
+            s = int(a.sum(dtype=np.int64)) if len(a) else 0
+        h.update(repr(s).encode())
+    return h.hexdigest()[:32]
+
+
+def build_app(storage: Storage, secret: Optional[str] = None) -> HTTPApp:
+    app = HTTPApp("storageserver")
+
+    def hdr(req: Request, name: str) -> str:
+        # Request.headers preserves as-sent case; match insensitively
+        for k, v in req.headers.items():
+            if k.lower() == name:
+                return v
+        return ""
+
+    def auth(req: Request) -> None:
+        if secret and not hmac.compare_digest(
+                hdr(req, "x-pio-storage-secret"), secret):
+            raise HTTPError(401, "Invalid storage secret.")
+
+    def chan(req: Request) -> Optional[int]:
+        c = req.query.get("channel")
+        return int(c) if c else None
+
+    @app.route("GET", r"/v1/status")
+    def status(req: Request) -> Response:
+        auth(req)
+        return json_response({"status": "alive"})
+
+    # -- events ------------------------------------------------------------
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/init")
+    def ev_init(req: Request) -> Response:
+        auth(req)
+        ok = storage.events().init(int(req.path_params["app_id"]),
+                                   chan(req))
+        return json_response({"ok": bool(ok)})
+
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/remove")
+    def ev_remove(req: Request) -> Response:
+        auth(req)
+        ok = storage.events().remove(int(req.path_params["app_id"]),
+                                     chan(req))
+        return json_response({"ok": bool(ok)})
+
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/batch")
+    def ev_batch(req: Request) -> Response:
+        auth(req)
+        events = [Event.from_json(d) for d in req.json()]
+        ids = storage.events().insert_batch(
+            events, int(req.path_params["app_id"]), chan(req))
+        return json_response({"ids": ids})
+
+    @app.route("GET", r"/v1/events/(?P<app_id>\d+)/get")
+    def ev_get(req: Request) -> Response:
+        auth(req)
+        e = storage.events().get(req.query.get("id", ""),
+                                 int(req.path_params["app_id"]),
+                                 chan(req))
+        return json_response({"event": e.to_json() if e else None})
+
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/delete")
+    def ev_delete(req: Request) -> Response:
+        auth(req)
+        ok = storage.events().delete(req.json()["id"],
+                                     int(req.path_params["app_id"]),
+                                     chan(req))
+        return json_response({"ok": bool(ok)})
+
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/find")
+    def ev_find(req: Request) -> Response:
+        auth(req)
+        f = filter_from_doc(req.json())
+        out = [e.to_json() for e in storage.events().find(
+            int(req.path_params["app_id"]), chan(req), f)]
+        return json_response({"events": out})
+
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/aggregate")
+    def ev_aggregate(req: Request) -> Response:
+        auth(req)
+        from datetime import datetime
+
+        d = req.json() or {}
+
+        def dt(s):
+            return datetime.fromisoformat(s) if s else None
+
+        props = storage.events().aggregate_properties(
+            int(req.path_params["app_id"]), chan(req),
+            entity_type=d["entity_type"],
+            start_time=dt(d.get("start_time")),
+            until_time=dt(d.get("until_time")),
+            required=d.get("required"))
+        return json_response({"properties": {
+            k: {"fields": v.to_dict(),
+                "first_updated": v.first_updated.isoformat(),
+                "last_updated": v.last_updated.isoformat()}
+            for k, v in props.items()}})
+
+    @app.route("GET", r"/v1/events/(?P<app_id>\d+)/columnar")
+    def ev_columnar(req: Request) -> Response:
+        auth(req)
+        with_props = req.query.get("props", "1") != "0"
+        fp = tuple(p for p in
+                   (req.query.get("float_props") or "rating").split(",")
+                   if p)
+        batch = storage.events().find_columnar(
+            int(req.path_params["app_id"]), chan(req), EventFilter(),
+            float_props=fp, ordered=False, with_props=with_props)
+        version = _batch_version(batch)
+        if hdr(req, "if-none-match") == version:
+            return Response(status=304, body=b"",
+                            headers={"ETag": version})
+        return Response(status=200, body=batch_to_npz(batch),
+                        content_type="application/octet-stream",
+                        headers={"ETag": version})
+
+    # -- metadata ----------------------------------------------------------
+    @app.route("POST", r"/v1/meta/(?P<dao>[a-z_]+)/(?P<method>[a-z_]+)")
+    def meta_rpc(req: Request) -> Response:
+        auth(req)
+        dao_name = req.path_params["dao"]
+        method = req.path_params["method"]
+        allowed = _META_METHODS.get(dao_name)
+        if allowed is None or method not in allowed:
+            raise HTTPError(404, f"unknown RPC {dao_name}/{method}")
+        dao = getattr(storage, dao_name)()
+        body = req.json() or {}
+        args = body.get("args", [])
+        if dao_name == "models":
+            import base64
+
+            from ..data.storage.base import Model
+            if method == "insert":
+                m = body["model"]
+                dao.insert(Model(id=m["id"],
+                                 models=base64.b64decode(m["models"])))
+                return json_response({"ok": True})
+            if method == "get":
+                m = dao.get(*args)
+                return json_response({"model": None if m is None else {
+                    "id": m.id,
+                    "models": base64.b64encode(m.models).decode()}})
+            dao.delete(*args)
+            return json_response({"ok": True})
+        if "entity" in body:
+            args = [entity_from_doc(dao_name, body["entity"])] + args
+        result = getattr(dao, method)(*args)
+        if result is None or isinstance(result, (int, str)):
+            return json_response({"result": result})
+        if isinstance(result, list):
+            return json_response(
+                {"entities": [entity_to_doc(e) for e in result]})
+        return json_response({"entity": entity_to_doc(result)})
+
+    return app
+
+
+def create_storage_server(storage: Optional[Storage] = None,
+                          host: str = "0.0.0.0", port: int = 7077,
+                          secret: Optional[str] = None) -> AppServer:
+    """Bind the storage server (default port 7077 — beside the event
+    server's reference port 7070)."""
+    return AppServer(build_app(storage or Storage(), secret=secret),
+                     host, port)

@@ -59,14 +59,57 @@ WORKER = textwrap.dedent("""
     hs = host_shard(np.arange(10))
     assert len(hs) == 5, hs
 
+    # v2 contract (partial reads): the same problem fed through a
+    # sharded source — each process must MATERIALIZE only ~its half of
+    # the log (VERDICT r2 task 5), and the factors must match v1's.
+    from predictionio_tpu.data.columnar import (
+        ColumnarDicts, columnar_from_columns)
+    from predictionio_tpu.models.als import pack_ratings_multihost
+    from predictionio_tpu.models.data import ColumnarRatingsSource
+
+    batch = columnar_from_columns(
+        ColumnarDicts(),
+        ["rate"] * nnz, ["user"] * nnz,
+        [f"u{u:05d}" for u in ratings.users],
+        ["item"] * nnz,
+        [f"i{i:05d}" for i in ratings.items],
+        np.arange(nnz, dtype=np.int64),
+        [None] * nnz, float_props=())
+    batch.float_props["rating"] = ratings.ratings.astype(np.float64)
+    src = ColumnarRatingsSource(batch, chunk=257)
+    touched = {"n": 0}
+    orig_read = src.read_rows
+    def counting_read(side, start, stop):
+        r, c, v = orig_read(side, start, stop)
+        touched["n"] += len(r)
+        return r, c, v
+    src.read_rows = counting_read
+    packed2 = pack_ratings_multihost(src, params, mesh)
+    # each side reads ~nnz/2 per process -> ~nnz total, not 2*nnz
+    assert touched["n"] <= 1.25 * nnz, touched
+    U2, V2 = train_als(None, params, mesh=mesh, packed=packed2)
+
     # replicate through the compiled program, then read locally
     rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
     U_full = np.asarray(rep(U).addressable_data(0))
     V_full = np.asarray(rep(V).addressable_data(0))
+    U2_full = np.asarray(rep(U2).addressable_data(0))
+    V2_full = np.asarray(rep(V2).addressable_data(0))
+    # v2 equivalence check: SAME problem and indexation, fed the v1 way
+    # (global COO on every host) — only the feeding path differs, so the
+    # factors must agree tightly
+    coo_v2 = src.to_coo()
+    packed_v1 = pack_ratings_multihost(coo_v2, params, mesh)
+    U3, V3 = train_als(coo_v2, params, mesh=mesh, packed=packed_v1)
+    U3_full = np.asarray(rep(U3).addressable_data(0))
+    V3_full = np.asarray(rep(V3).addressable_data(0))
+    np.testing.assert_allclose(U2_full, U3_full, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(V2_full, V3_full, rtol=1e-4, atol=1e-5)
     if pid == 0:
         np.save(os.path.join(outdir, "U.npy"), U_full)
         np.save(os.path.join(outdir, "V.npy"), V_full)
-        json.dump({"ok": True}, open(os.path.join(outdir, "ok.json"), "w"))
+        json.dump({"ok": True, "touched": touched["n"], "nnz": nnz},
+                  open(os.path.join(outdir, "ok.json"), "w"))
 """)
 
 
