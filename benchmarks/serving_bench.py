@@ -1,0 +1,186 @@
+"""Serving-path benchmark: the REAL engine server under concurrent load.
+
+Measures `POST /queries.json` latency through the full deployed stack
+(HTTP → QueryServer → template predict → top-k), the reference hot path
+``CreateServer.scala:484-633``, in three configurations:
+
+- ``host``: small catalog — the host fast path (numpy dot, the
+  reference's in-JVM BLAS serving role)
+- ``device``: a catalog past ``HOST_SERVE_WORK`` — every query is an
+  MXU matmul + top-k dispatch
+- ``device+batching``: same catalog with the serving micro-batcher
+  coalescing concurrent queries into one ``batch_predict`` dispatch
+  (``ServerConfig(batching=True)``; the reference served strictly
+  per-request — ``CreateServer.scala:507-510`` "TODO: Parallelize")
+
+Prints ONE JSON line with p50/p90/p99 (ms) and throughput per config.
+
+Usage: python benchmarks/serving_bench.py [n_items_device] [rank]
+Env:   SERVE_THREADS (8), SERVE_REQUESTS (400 per config)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from datetime import datetime, timezone
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from predictionio_tpu.controller import Context  # noqa: E402
+from predictionio_tpu.data.bimap import BiMap  # noqa: E402
+from predictionio_tpu.data.storage import App, Storage  # noqa: E402
+from predictionio_tpu.data.storage.base import (  # noqa: E402
+    EngineInstance,
+    STATUS_COMPLETED,
+)
+from predictionio_tpu.models.als import (  # noqa: E402
+    ALSModel,
+    ALSParams,
+    HOST_SERVE_WORK,
+)
+from predictionio_tpu.server.engineserver import (  # noqa: E402
+    QueryServer,
+    ServerConfig,
+    create_engine_server,
+)
+from predictionio_tpu.templates.recommendation import (  # noqa: E402
+    default_engine_params,
+    recommendation_engine,
+)
+
+
+def synth_model(n_users: int, n_items: int, rank: int,
+                device: bool) -> ALSModel:
+    rng = np.random.default_rng(0)
+    U = rng.standard_normal((n_users, rank)).astype(np.float32)
+    V = rng.standard_normal((n_items, rank)).astype(np.float32)
+    if device:
+        import jax
+        U = jax.device_put(U)
+        V = jax.device_put(V)
+        V.block_until_ready()
+    return ALSModel(
+        user_factors=U, item_factors=V, n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+
+
+def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
+                 n_threads: int, label: str) -> dict:
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "servebench"))
+    ctx = Context(app_name="servebench", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("servebench", rank=model.params.rank)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="bench", status=STATUS_COMPLETED, start_time=now, end_time=now,
+        engine_id="bench", engine_version="1",
+        engine_variant="engine.json", engine_factory="synthetic")
+    qs = QueryServer(ctx, engine, ep, [model], inst, cfg)
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    port = srv.port
+    rng = np.random.default_rng(1)
+    users = rng.integers(0, model.n_users, n_requests)
+
+    # warm the compiled path (first device dispatch compiles)
+    for u in users[:3]:
+        body = json.dumps({"user": f"u{u}", "num": 10}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/queries.json", data=body,
+            headers={"Content-Type": "application/json"}), timeout=120
+        ).read()
+
+    lat: list = []
+    lat_lock = threading.Lock()
+    idx = iter(range(n_requests))
+    idx_lock = threading.Lock()
+
+    def worker():
+        while True:
+            with idx_lock:
+                k = next(idx, None)
+            if k is None:
+                return
+            body = json.dumps({"user": f"u{users[k]}",
+                               "num": 10}).encode()
+            t0 = time.monotonic()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=120) as resp:
+                out = json.loads(resp.read())
+            dt = time.monotonic() - t0
+            assert out.get("itemScores") is not None, out
+            with lat_lock:
+                lat.append(dt)
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    srv.shutdown()
+    arr = np.sort(np.asarray(lat)) * 1e3
+    return {
+        "config": label,
+        "n": len(arr),
+        "p50_ms": round(float(np.percentile(arr, 50)), 2),
+        "p90_ms": round(float(np.percentile(arr, 90)), 2),
+        "p99_ms": round(float(np.percentile(arr, 99)), 2),
+        "qps": round(len(arr) / wall, 1),
+    }
+
+
+def main() -> None:
+    n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
+    rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    n_threads = int(os.environ.get("SERVE_THREADS", "8"))
+    n_requests = int(os.environ.get("SERVE_REQUESTS", "400"))
+    n_users = 50_000
+
+    assert n_items_dev * rank > HOST_SERVE_WORK, \
+        "device catalog must exceed HOST_SERVE_WORK to force the MXU path"
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # the env var alone does not stop an installed TPU PJRT plugin
+        # from initializing (and hanging when the tunnel is down)
+        jax.config.update("jax_platforms", "cpu")
+    device_kind = jax.devices()[0].device_kind
+
+    results = []
+    host_model = synth_model(2000, 2000, rank, device=False)
+    results.append(bench_config(host_model, ServerConfig(), n_requests,
+                                n_threads, "host_small_catalog"))
+    dev_model = synth_model(n_users, n_items_dev, rank, device=True)
+    results.append(bench_config(dev_model, ServerConfig(), n_requests,
+                                n_threads, "device_per_query"))
+    results.append(bench_config(
+        dev_model, ServerConfig(batching=True, max_batch=64,
+                                batch_window_ms=2.0),
+        n_requests, n_threads, "device_microbatch"))
+    print(json.dumps({
+        "bench": "serving_queries_json",
+        "device": device_kind,
+        "rank": rank,
+        "n_items_device": n_items_dev,
+        "threads": n_threads,
+        "results": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

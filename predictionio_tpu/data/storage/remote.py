@@ -130,7 +130,8 @@ class RemoteEventStore(EventStore):
     def __init__(self, client: RemoteClient):
         self.c = client
 
-    def _base(self, app_id: int, channel_id: Optional[int]) -> str:
+    def _base(self, app_id: int,
+              channel_id: Optional[int]) -> "tuple[str, str]":
         q = f"?channel={channel_id}" if channel_id else ""
         return f"/v1/events/{app_id}", q
 

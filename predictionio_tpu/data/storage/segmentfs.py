@@ -192,7 +192,11 @@ class SegmentFSEventStore(EventStore):
                 from ..columnar import SegmentLog
                 log = SegmentLog(cdir)
                 with log.lock():
-                    log.invalidate()
+                    # same reader grace as rebuilds: another pod host may
+                    # still mmap these segments (NFS gives no
+                    # unlink-keeps-inode guarantee)
+                    log.invalidate(grace_s=_GC_GRACE_S)
+                    log.sweep(_GC_GRACE_S)
         with self.c._seg_lock:
             self.c.replay_cache.pop(d, None)
             self.c.replay_cache.pop(("columnar", d), None)

@@ -67,6 +67,10 @@ class ALSParams:
     #: with f32 accumulation (the TPU-native mixed-precision idiom);
     #: factors and solves stay f32.
     matmul_dtype: str = "float32"
+    #: Weighted-gram realization: "einsum" (baseline batched matmul),
+    #: "pair" (two rank-r systems packed per 128x128 MXU tile —
+    #: ``ops/gram.py``), or "auto".
+    gram_mode: str = "auto"
     #: History layout. "pad": one [n_rows, L] padded matrix per side
     #: (entries beyond L are DROPPED — round-1 semantics). "bucket":
     #: power-of-two length buckets, drop-free at ≤2× padding with MXU-deep
@@ -86,6 +90,10 @@ class ALSParams:
             raise ValueError(
                 f"history_mode must be 'auto', 'pad', 'split' or "
                 f"'bucket', got {self.history_mode!r}")
+        if self.gram_mode not in ("auto", "einsum", "pair"):
+            raise ValueError(
+                f"gram_mode must be 'auto', 'einsum' or 'pair', got "
+                f"{self.gram_mode!r}")
 
 
 @jax.tree_util.register_dataclass
@@ -122,11 +130,11 @@ class RatingsCOO:
 
 
 @functools.partial(jax.jit, static_argnames=("implicit", "scale_reg",
-                                             "bf16"))
+                                             "bf16", "gram"))
 def _update_block(fixed: jax.Array, G, indices: jax.Array,
                   values: jax.Array, counts: jax.Array, reg: float,
                   alpha: float, implicit: bool, scale_reg: bool,
-                  bf16: bool = False) -> jax.Array:
+                  bf16: bool = False, gram: str = "auto") -> jax.Array:
     """Recompute one block of rows, holding ``fixed`` constant.
 
     fixed: [m, r] (flat, row-sharded); G: [r, r] Gramian of ``fixed`` (only
@@ -141,14 +149,10 @@ def _update_block(fixed: jax.Array, G, indices: jax.Array,
     F = fixed[indices]  # [d, B, L, r] — cross-shard gather under a mesh
 
     def outer(Fm, w):
-        """Σ_l w·f fᵀ and Σ_l w·f, on the MXU (optionally bf16 inputs
-        with f32 accumulation — the TPU mixed-precision idiom)."""
-        if bf16:
-            Fw = (Fm * w[..., None]).astype(jnp.bfloat16)
-            Fc = Fm.astype(jnp.bfloat16)
-            return jnp.einsum("dnlr,dnls->dnrs", Fw, Fc,
-                              preferred_element_type=jnp.float32)
-        return jnp.einsum("dnlr,dnls,dnl->dnrs", Fm, Fm, w)
+        """Σ_l w·f fᵀ on the MXU (optionally bf16 inputs with f32
+        accumulation); realization per ``ALSParams.gram_mode``."""
+        from ..ops.gram import gram_dispatch
+        return gram_dispatch(Fm, w, mode=gram, bf16=bf16)
 
     if implicit:
         # Hu-Koren-Volinsky: c = 1 + alpha·r, preference p=1 on observed.
@@ -271,7 +275,8 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
 
 def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
                       reg, alpha, implicit: bool, scale_reg: bool,
-                      bf16: bool, block_rows_opt) -> jax.Array:
+                      bf16: bool, block_rows_opt,
+                      gram: str = "auto") -> jax.Array:
     """Trace-level body of a bucketed half-iteration (jit-wrapped by
     :func:`_bucket_half_step` and inlined whole-training by
     :func:`_train_bucket_fused`)."""
@@ -287,7 +292,7 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
             parts.append(_update_block(
                 fixed, G, b["idx"][:, s:e], b["val"][:, s:e],
                 b["cnt"][:, s:e], reg, alpha, implicit, scale_reg,
-                bf16=bf16))
+                bf16=bf16, gram=gram))
         new = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
                                                                axis=1)
         # each real row lives in exactly one bucket → unique indices (the
@@ -300,11 +305,12 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
 
 @functools.partial(jax.jit,
                    static_argnames=("implicit", "scale_reg", "bf16",
-                                    "block_rows_opt"),
+                                    "block_rows_opt", "gram"),
                    donate_argnums=(1,))
 def _bucket_half_step(fixed: jax.Array, out0: jax.Array, buckets,
                       reg, alpha, *, implicit: bool, scale_reg: bool,
-                      bf16: bool, block_rows_opt) -> jax.Array:
+                      bf16: bool, block_rows_opt,
+                      gram: str = "auto") -> jax.Array:
     """One ENTIRE bucketed half-iteration as a single compiled program —
     Gramian, every bucket's normal-equation blocks, solves, and the
     unique-index scatters all fuse into one dispatch. Separate per-bucket
@@ -315,7 +321,7 @@ def _bucket_half_step(fixed: jax.Array, out0: jax.Array, buckets,
     compilation; the bucket STRUCTURE (shapes) is the cache key.
     """
     return _bucket_half_impl(fixed, out0, buckets, reg, alpha, implicit,
-                             scale_reg, bf16, block_rows_opt)
+                             scale_reg, bf16, block_rows_opt, gram)
 
 
 def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
@@ -331,17 +337,18 @@ def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
         implicit=params.implicit_prefs,
         scale_reg=params.scale_reg_by_count,
         bf16=(params.matmul_dtype == "bfloat16"),
-        block_rows_opt=params.block_rows)
+        block_rows_opt=params.block_rows, gram=params.gram_mode)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("implicit", "scale_reg",
                                     "bf16", "block_rows_opt", "nu", "ni",
-                                    "shard_u", "shard_i"))
+                                    "shard_u", "shard_i", "gram"))
 def _train_bucket_fused(U: jax.Array, V: jax.Array, ub, ib, reg, alpha,
                         iters, *, implicit: bool, scale_reg: bool,
                         bf16: bool, block_rows_opt, nu: int, ni: int,
-                        shard_u, shard_i) -> Tuple[jax.Array, jax.Array]:
+                        shard_u, shard_i, gram: str = "auto"
+                        ) -> Tuple[jax.Array, jax.Array]:
     """The WHOLE training run as one compiled program (bucket layouts,
     no checkpointing): through a remote-device tunnel, per-dispatch
     latency rivals a full half-iteration of compute, so 2·iters
@@ -356,7 +363,7 @@ def _train_bucket_fused(U: jax.Array, V: jax.Array, ub, ib, reg, alpha,
             out0 = jax.lax.with_sharding_constraint(out0, shard)
         return _bucket_half_impl(fixed, out0, buckets, reg, alpha,
                                  implicit, scale_reg, bf16,
-                                 block_rows_opt)
+                                 block_rows_opt, gram)
 
     def body(_, UV):
         U, V = UV
@@ -385,7 +392,8 @@ def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
             fixed, G, indices[:, s:e], values[:, s:e], counts[:, s:e],
             params.reg, params.alpha, params.implicit_prefs,
             params.scale_reg_by_count,
-            bf16=(params.matmul_dtype == "bfloat16")))
+            bf16=(params.matmul_dtype == "bfloat16"),
+            gram=params.gram_mode))
     out = blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=1)
     return out.reshape(d * n_per, out.shape[-1])
 
@@ -827,6 +835,15 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
                 "checkpointing fingerprints the ratings content; pass "
                 "the ratings (or use checkpoint_dir=None) ")
         n_users_real, n_items_real = packed.n_users, packed.n_items
+    elif hasattr(ratings, "read_rows"):  # a sharded source
+        if ratings.n_users == 0 or ratings.n_items == 0:
+            raise ValueError("ALS requires a non-empty ratings matrix "
+                             "(0 users/items in the source)")
+        if checkpoint_dir:
+            raise ValueError(
+                "checkpointing fingerprints the ratings content; pass a "
+                "RatingsCOO (source.to_coo()) when using checkpoint_dir")
+        n_users_real, n_items_real = ratings.n_users, ratings.n_items
     else:
         if len(ratings.users) == 0 or ratings.n_users == 0 \
                 or ratings.n_items == 0:
@@ -948,7 +965,8 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             bf16=(params.matmul_dtype == "bfloat16"),
             block_rows_opt=params.block_rows,
             nu=u_rows_pad, ni=i_rows_pad,
-            shard_u=shard, shard_i=shard)
+            shard_u=shard, shard_i=shard,
+            gram=params.gram_mode)
 
     try:
         for it in range(start, params.num_iterations):

@@ -1,0 +1,65 @@
+"""Weighted-gram variants for the ALS normal equations.
+
+The per-row system build Σ_l w·f fᵀ is where the FLOPs are
+(``ALSAlgorithm.scala:75-85`` role). At rank 64 the straightforward
+batched einsum ``[B,L,64]→[B,64,64]`` runs M=N=64 matmuls on a 128×128
+MXU — a quarter of the array (measured ~3-5 TF/s f32 on a v5e whose
+bf16 peak is 197, BASELINE.md).
+
+``gram_pairs`` packs TWO rank-64 systems per MXU tile: rows are paired
+along the feature axis, one ``[B/2, L, 128]²`` einsum produces
+``[B/2, 128, 128]`` tiles whose two diagonal 64×64 blocks are the two
+rows' grams. The multiply count doubles (the off-diagonal blocks are
+discarded) but every multiply now runs on a FULL MXU tile — a net win
+exactly when the op is MXU-bound, which ``benchmarks/gram_profile.py``
+measures per shape. Opt-in via ``ALSParams(gram_mode="pair")``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gram_weighted(F: jax.Array, w: jax.Array,
+                  bf16: bool = False) -> jax.Array:
+    """Baseline batched weighted gram: ``A[..., i, :, :] = Σ_l w·f fᵀ``.
+    F: [..., L, r], w: [..., L] → [..., r, r]."""
+    if bf16:
+        Fw = (F * w[..., None]).astype(jnp.bfloat16)
+        Fc = F.astype(jnp.bfloat16)
+        return jnp.einsum("...lr,...ls->...rs", Fw, Fc,
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("...lr,...ls,...l->...rs", F, F, w)
+
+
+def gram_pairs(F: jax.Array, w: jax.Array,
+               bf16: bool = False) -> jax.Array:
+    """Pair-packed weighted gram (see module docstring): same result as
+    :func:`gram_weighted` with rows packed two-per-MXU-tile. Requires an
+    EVEN number of rows on the second-to-last batch axis (callers fall
+    back to :func:`gram_weighted` otherwise)."""
+    *lead, n, L, r = F.shape
+    assert n % 2 == 0, "gram_pairs needs an even row count"
+    F0, F1 = F[..., 0::2, :, :], F[..., 1::2, :, :]
+    Fp = jnp.concatenate([F0, F1], axis=-1)  # [..., n/2, L, 2r]
+    Wp = jnp.concatenate([F0 * w[..., 0::2, :, None],
+                          F1 * w[..., 1::2, :, None]], axis=-1)
+    if bf16:
+        Fp = Fp.astype(jnp.bfloat16)
+        Wp = Wp.astype(jnp.bfloat16)
+    G2 = jnp.einsum("...lr,...ls->...rs", Wp, Fp,
+                    preferred_element_type=jnp.float32)
+    # [..., n/2, 2r, 2r] → the two diagonal blocks, interleaved back
+    A0 = G2[..., :r, :r]
+    A1 = G2[..., r:, r:]
+    return jnp.stack([A0, A1], axis=-3).reshape(*lead, n, r, r)
+
+
+def gram_dispatch(F: jax.Array, w: jax.Array, mode: str,
+                  bf16: bool = False) -> jax.Array:
+    """``mode``: "einsum" (baseline), "pair", or "auto" (currently the
+    baseline; flips per-shape once gram_profile.py numbers land)."""
+    if mode == "pair" and F.shape[-3] % 2 == 0:
+        return gram_pairs(F, w, bf16=bf16)
+    return gram_weighted(F, w, bf16=bf16)
