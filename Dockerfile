@@ -1,0 +1,33 @@
+# predictionio-tpu service image.
+#
+# This base serves the CONTROL PLANE (event server, storage server,
+# admin, dashboard, engine serving on CPU). For TPU training/serving
+# hosts, build FROM a TPU VM base image instead (one that ships libtpu,
+# e.g. the Cloud TPU VM base) and pass --build-arg BASE=...:
+#
+#   docker build -t predictionio-tpu .
+#   docker build -t predictionio-tpu:tpu --build-arg \
+#       BASE=us-docker.pkg.dev/cloud-tpu-images/inference/tpu-vm-base .
+ARG BASE=python:3.12-slim
+FROM ${BASE}
+
+WORKDIR /opt/predictionio-tpu
+COPY pyproject.toml README.md ./
+COPY predictionio_tpu ./predictionio_tpu
+COPY bin ./bin
+COPY examples ./examples
+COPY docs ./docs
+
+RUN pip install --no-cache-dir . \
+    && pip install --no-cache-dir jax || true
+
+# PIO_HOME holds the default sqlite/localfs state; mount a volume here
+ENV PIO_HOME=/var/lib/predictionio-tpu
+RUN mkdir -p /var/lib/predictionio-tpu
+VOLUME /var/lib/predictionio-tpu
+
+# 7070 event server, 7077 storage server, 8000 engine, 7071 admin, 9000 dashboard
+EXPOSE 7070 7077 8000 7071 9000
+
+ENTRYPOINT ["ptpu"]
+CMD ["eventserver", "--ip", "0.0.0.0", "--port", "7070"]

@@ -159,14 +159,14 @@ def eval_ndcg_at_k(U, V, train_users, train_items, test_users, test_items,
         if j is not None:
             test_sets[j].add(int(i))
 
-    discounts = 1.0 / np.log2(np.arange(2, k + 2))
+    from predictionio_tpu.controller.metric import ndcg_at_k
+
     total = 0.0
     for j in range(S):
-        ranked = [i for i in ids[j] if i not in train_sets[j]][:k]
-        dcg = sum(discounts[r] for r, i in enumerate(ranked)
-                  if i in test_sets[j])
-        idcg = discounts[: min(k, len(test_sets[j]))].sum()
-        total += dcg / idcg if idcg > 0 else 0.0
+        ranked = [int(i) for i in ids[j]
+                  if int(i) not in train_sets[j]][:k]
+        score = ndcg_at_k(ranked, test_sets[j], k)
+        total += score if score is not None else 0.0
     return total / max(S, 1)
 
 
@@ -183,12 +183,8 @@ def main():
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        # The env var alone does not stop an installed TPU PJRT plugin
-        # from initializing (and hanging when the tunnel is down); the
-        # config update is authoritative. Lets CPU smoke runs of the
-        # bench work on a TPU-tunnel machine.
-        jax.config.update("jax_platforms", "cpu")
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
 
     from predictionio_tpu.models.als import (
         ALSParams,

@@ -44,8 +44,8 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
 
     from predictionio_tpu.ops.gram import gram_pairs, gram_weighted
     from predictionio_tpu.ops.solve import solve_spd_batch

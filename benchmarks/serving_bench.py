@@ -102,6 +102,7 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
         ).read()
 
     lat: list = []
+    errors: list = []
     lat_lock = threading.Lock()
     idx = iter(range(n_requests))
     idx_lock = threading.Lock()
@@ -115,13 +116,20 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
             body = json.dumps({"user": f"u{users[k]}",
                                "num": 10}).encode()
             t0 = time.monotonic()
-            with urllib.request.urlopen(urllib.request.Request(
-                    f"http://127.0.0.1:{port}/queries.json", data=body,
-                    headers={"Content-Type": "application/json"}),
-                    timeout=120) as resp:
-                out = json.loads(resp.read())
+            try:
+                with urllib.request.urlopen(urllib.request.Request(
+                        f"http://127.0.0.1:{port}/queries.json",
+                        data=body,
+                        headers={"Content-Type": "application/json"}),
+                        timeout=120) as resp:
+                    out = json.loads(resp.read())
+                if out.get("itemScores") is None:
+                    raise RuntimeError(f"bad response: {out}")
+            except Exception as e:  # noqa: BLE001 — surface, not die
+                with lat_lock:
+                    errors.append(str(e))
+                continue
             dt = time.monotonic() - t0
-            assert out.get("itemScores") is not None, out
             with lat_lock:
                 lat.append(dt)
 
@@ -133,6 +141,11 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
         t.join()
     wall = time.monotonic() - t_start
     srv.shutdown()
+    if errors or not lat:
+        raise RuntimeError(
+            f"{label}: {len(errors)} failed requests of {n_requests} "
+            f"(first: {errors[0] if errors else 'none'}) — latency "
+            f"numbers would describe a degraded load, refusing")
     arr = np.sort(np.asarray(lat)) * 1e3
     return {
         "config": label,
@@ -155,10 +168,9 @@ def main() -> None:
         "device catalog must exceed HOST_SERVE_WORK to force the MXU path"
 
     import jax
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        # the env var alone does not stop an installed TPU PJRT plugin
-        # from initializing (and hanging when the tunnel is down)
-        jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
     device_kind = jax.devices()[0].device_kind
 
     results = []

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Build the release artifacts: wheel + sdist + a self-contained tarball
+# (bin/, examples/, docs/, Docker assets) an operator can unpack and run
+# — the role of the reference's make-distribution.sh, minus sbt.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+VERSION=$(python -c "import tomllib; \
+print(tomllib.load(open('pyproject.toml','rb'))['project']['version'])")
+DIST="dist/predictionio-tpu-${VERSION}"
+
+rm -rf dist
+# --no-build-isolation: build with the installed setuptools (works in
+# air-gapped environments; pip's isolated env would fetch from PyPI)
+python -m pip wheel --no-deps --no-build-isolation -w dist . > /dev/null
+
+mkdir -p "${DIST}"
+cp -r bin examples docs Dockerfile docker README.md "${DIST}/"
+cp dist/*.whl "${DIST}/"
+cat > "${DIST}/install.sh" << 'EOF'
+#!/usr/bin/env bash
+set -euo pipefail
+cd "$(dirname "$0")"
+pip install ./*.whl
+echo "Installed. Try: ptpu status"
+EOF
+chmod +x "${DIST}/install.sh" bin/ptpu || true
+
+tar -C dist -czf "dist/predictionio-tpu-${VERSION}.tar.gz" \
+    "predictionio-tpu-${VERSION}"
+echo "Built:"
+ls -l dist/*.tar.gz dist/*.whl

@@ -174,13 +174,14 @@ def _update_block(fixed: jax.Array, G, indices: jax.Array,
 _gramian_jit = jax.jit(gramian)
 
 
-@functools.partial(jax.jit, static_argnames=("implicit", "bf16"),
+@functools.partial(jax.jit, static_argnames=("implicit", "bf16",
+                                             "gram"),
                    donate_argnums=(5, 6))
 def _partials_block(fixed: jax.Array, indices: jax.Array,
                     values: jax.Array, counts: jax.Array,
                     row_ids: jax.Array, A_acc: jax.Array,
                     b_acc: jax.Array, alpha: float, implicit: bool,
-                    bf16: bool = False):
+                    bf16: bool = False, gram: str = "auto"):
     """Split-mode half of :func:`_update_block`: per-VIRTUAL-row partials
     Σ w·ffᵀ and Σ w·f, scatter-added onto the owning real rows.
     Sentinel/padding virtual rows contribute exactly zero (their valid
@@ -192,12 +193,8 @@ def _partials_block(fixed: jax.Array, indices: jax.Array,
     F = fixed[indices]  # [d, B, L, r]
 
     def outer(Fm, w):
-        if bf16:
-            Fw = (Fm * w[..., None]).astype(jnp.bfloat16)
-            Fc = Fm.astype(jnp.bfloat16)
-            return jnp.einsum("dnlr,dnls->dnrs", Fw, Fc,
-                              preferred_element_type=jnp.float32)
-        return jnp.einsum("dnlr,dnls,dnl->dnrs", Fm, Fm, w)
+        from ..ops.gram import gram_dispatch
+        return gram_dispatch(Fm, w, mode=gram, bf16=bf16)
 
     if implicit:
         c1 = alpha * values * valid
@@ -266,7 +263,8 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
             fixed, sh["idx"][:, s:e], sh["val"][:, s:e],
             sh["cnt"][:, s:e], sh["rid"][:, s:e], A_acc, b_acc,
             params.alpha, implicit,
-            bf16=(params.matmul_dtype == "bfloat16"))
+            bf16=(params.matmul_dtype == "bfloat16"),
+            gram=params.gram_mode)
     if G is None:
         G = jnp.zeros((r, r), jnp.float32)  # static arg shape filler
     return _solve_accumulated(A_acc, b_acc, G, sh["real_cnt"], params.reg,

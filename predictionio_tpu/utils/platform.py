@@ -1,0 +1,20 @@
+"""Host/device platform helpers shared by benchmarks and tools."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    """Make ``JAX_PLATFORMS=cpu`` authoritative.
+
+    The env var alone does not stop an installed TPU PJRT plugin from
+    initializing — and through a device tunnel that init can HANG
+    indefinitely when the tunnel is down (exactly how round 2's driver
+    bench died). The config update is authoritative; call this after
+    importing jax and before the first device use.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
