@@ -18,8 +18,7 @@ COPY bin ./bin
 COPY examples ./examples
 COPY docs ./docs
 
-RUN pip install --no-cache-dir . \
-    && pip install --no-cache-dir jax || true
+RUN pip install --no-cache-dir .
 
 # PIO_HOME holds the default sqlite/localfs state; mount a volume here
 ENV PIO_HOME=/var/lib/predictionio-tpu

@@ -63,3 +63,138 @@ def gram_dispatch(F: jax.Array, w: jax.Array, mode: str,
     if mode == "pair" and F.shape[-3] % 2 == 0:
         return gram_pairs(F, w, bf16=bf16)
     return gram_weighted(F, w, bf16=bf16)
+
+
+# -- VMEM-table fused gather+gram (Pallas) ----------------------------------
+#
+# The XLA half-step materializes F = table[idx] ([B, L, r] f32) in HBM
+# and reads it back for the gram — ≥3 HBM touches per gathered element.
+# When the FIXED factor table fits VMEM (27k items × rank 64 × 4B =
+# 6.9MB on a ~16MB/core budget), this kernel streams only idx+weights
+# (8B/entry) from HBM, gathers from the resident table, and runs the
+# pair-packed MXU contraction entirely on-chip. Arithmetic intensity per
+# entry goes from ~11 to ~1000 flops/byte — the HBM bound disappears.
+#
+# Mosaic's dynamic (vector-index) gather support is version-dependent;
+# ``gram_table_supported()`` probes lowering once so callers can fall
+# back to the XLA paths.
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+#: rows of A/b produced per kernel invocation step (must be even: the
+#: MXU contraction packs two rows per 128-wide tile)
+_BLOCK_ROWS = 16
+
+
+def _gram_table_kernel(tab_ref, idx_ref, wa_ref, wb_ref, A_ref, b_ref):
+    """One [Bt, L] block: per row pair, gather the pair's history rows
+    from the VMEM-resident table, weight, and contract as ONE
+    [L, 2r]ᵀ[L, 2r] MXU matmul whose diagonal r×r blocks are the two
+    rows' grams (plus a [2, L]×[L, 2r] matmul for the b vectors)."""
+    Bt, L = idx_ref.shape
+    r = tab_ref.shape[1]
+    tab = tab_ref[:]
+
+    def step(p, carry):
+        i0 = 2 * p
+        idx2 = idx_ref[pl.ds(i0, 2), :]                        # [2, L]
+        wa2 = wa_ref[pl.ds(i0, 2), :]
+        wb2 = wb_ref[pl.ds(i0, 2), :]
+        F2 = tab[idx2.reshape(2 * L)]                          # [2L, r]
+        F0, F1 = F2[:L], F2[L:]
+        Fp = jnp.concatenate([F0, F1], axis=1)                 # [L, 2r]
+        Wp = jnp.concatenate([F0 * wa2[0][:, None],
+                              F1 * wa2[1][:, None]], axis=1)
+        G2 = jax.lax.dot_general(
+            Wp, Fp, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [2r, 2r]
+        B2 = jax.lax.dot_general(
+            wb2, Fp, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [2, 2r]
+        A_ref[pl.ds(i0, 1), :, :] = G2[None, :r, :r]
+        A_ref[pl.ds(i0 + 1, 1), :, :] = G2[None, r:, r:]
+        b_ref[pl.ds(i0, 1), :] = B2[None, 0, :r]
+        b_ref[pl.ds(i0 + 1, 1), :] = B2[None, 1, r:]
+        return carry
+
+    jax.lax.fori_loop(0, Bt // 2, step, 0, unroll=False)
+
+
+def gram_table_pallas(table: jax.Array, idx: jax.Array, wa: jax.Array,
+                      wb: jax.Array, interpret: bool = False):
+    """Fused gather+gram from a VMEM-resident ``table`` [m, r]:
+    returns (A [B, r, r], b [B, r]) with
+    ``A[i] = Σ_l wa[i,l]·f fᵀ`` and ``b[i] = Σ_l wb[i,l]·f`` over
+    ``f = table[idx[i,l]]``. Pad slots carry w=0 (idx may point
+    anywhere valid). B is padded to the block size internally."""
+    assert _HAVE_PALLAS, "pallas unavailable"
+    B, L = idx.shape
+    m, r = table.shape
+    Bp = -(-B // _BLOCK_ROWS) * _BLOCK_ROWS
+    if Bp != B:
+        pad = ((0, Bp - B), (0, 0))
+        idx = jnp.pad(idx, pad)
+        wa = jnp.pad(wa, pad)
+        wb = jnp.pad(wb, pad)
+    A, b = pl.pallas_call(
+        _gram_table_kernel,
+        grid=(Bp // _BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((m, r), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, L), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, L), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, L), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, r, r), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, r), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bp, r, r), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, r), jnp.float32),
+        ],
+        interpret=interpret,
+    )(table, idx, wa, wb)
+    return A[:B], b[:B]
+
+
+_table_support: dict = {}
+
+
+def gram_table_supported() -> bool:
+    """Probe once whether the fused table kernel LOWERS on the attached
+    backend (Mosaic's vector-gather support is version-dependent)."""
+    if not _HAVE_PALLAS:
+        return False
+    try:
+        dev = jax.devices()[0]
+        if not (dev.platform == "tpu"
+                or dev.device_kind.startswith("TPU")):
+            return False
+    except Exception:  # pragma: no cover
+        return False
+    cached = _table_support.get("tpu")
+    if cached is not None:
+        return cached
+    try:
+        tab = jnp.zeros((128, 64), jnp.float32)
+        idx = jnp.zeros((_BLOCK_ROWS, 128), jnp.int32)
+        w = jnp.zeros((_BLOCK_ROWS, 128), jnp.float32)
+        jax.jit(gram_table_pallas).lower(tab, idx, w, w).compile()
+        ok = True
+    except Exception:  # noqa: BLE001 — lowering not supported
+        ok = False
+    _table_support["tpu"] = ok
+    return ok

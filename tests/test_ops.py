@@ -115,3 +115,26 @@ class TestGramVariants:
                                    rtol=5e-2, atol=2e-3)
         np.testing.assert_allclose(np.asarray(V1), np.asarray(V2),
                                    rtol=5e-2, atol=2e-3)
+
+    def test_gram_table_pallas_interpret(self):
+        """Fused VMEM-table gather+gram kernel vs the einsum reference
+        (interpret mode — Mosaic lowering is probed at runtime on TPU)."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.gram import gram_table_pallas
+        rng = np.random.default_rng(4)
+        m, r, B, L = 200, 16, 21, 24
+        tab = rng.standard_normal((m, r)).astype(np.float32)
+        idx = rng.integers(0, m, (B, L)).astype(np.int32)
+        wa = rng.random((B, L)).astype(np.float32)
+        wb = rng.random((B, L)).astype(np.float32)
+        A, b = gram_table_pallas(jnp.asarray(tab), jnp.asarray(idx),
+                                 jnp.asarray(wa), jnp.asarray(wb),
+                                 interpret=True)
+        F = tab[idx]
+        np.testing.assert_allclose(
+            np.asarray(A), np.einsum("blr,bls,bl->brs", F, F, wa),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(b), np.einsum("blr,bl->br", F, wb),
+            rtol=1e-4, atol=1e-4)
