@@ -717,8 +717,13 @@ def pack_ratings_multihost(ratings, params: ALSParams,
     MATERIALIZES only the rating triples of its own row range — the
     ``JDBCPEvents.scala:49-89`` partitioned-read role. A plain
     :class:`RatingsCOO` (every host already holding the global COO)
-    still works. Pad layout (per-side max_len) is used — the bucketed
-    layout's per-bucket shards don't split evenly across processes yet.
+    still works.
+
+    Layouts: "auto" resolves per side like the single-host pack — pad
+    when nothing would drop, otherwise the DROP-FREE bucketed layout,
+    whose per-bucket rows are padded to the device count and sharded so
+    each process packs only its own bucket rows ("split" maps to bucket
+    here: its duplicate-index scatter has no multihost layout).
     """
     import jax
 
@@ -738,6 +743,8 @@ def pack_ratings_multihost(ratings, params: ALSParams,
         raise ValueError("pack_ratings_multihost requires each process's "
                          "devices to be contiguous in mesh order")
 
+    from ..ops.ragged import AUTO_CAP_ENTRIES
+
     is_source = hasattr(ratings, "read_rows")
     packed = PackedRatings(user_h=None, item_h=None, mesh=mesh,
                            n_users=ratings.n_users,
@@ -750,6 +757,45 @@ def pack_ratings_multihost(ratings, params: ALSParams,
         else:
             rows_g = ratings.users if side == "user" else ratings.items
             counts = np.bincount(rows_g, minlength=n_rows)
+
+        mode = params.history_mode
+        bucket_cap = params.max_history and int(params.max_history)
+        if mode == "split":
+            # split's duplicate-index scatter has no multihost layout;
+            # bucket covers its drop-free role. Split keeps EVERY entry
+            # (its max_history is the virtual-row length, not a cap), so
+            # the bucket stand-in must be uncapped too.
+            mode = "bucket"
+            bucket_cap = None
+        elif mode == "auto":
+            if params.max_history is not None:
+                mode = "pad"
+            else:
+                L_full = int(counts.max(initial=1))
+                mode = "pad" if n_rows * L_full <= AUTO_CAP_ENTRIES \
+                    else "bucket"
+        if mode == "bucket":
+            # drop-free layout, sharded per process (each packs only
+            # the bucket rows its devices own)
+            if is_source:
+                def rrm(m, _side=side):
+                    return ratings.read_row_mask(_side, m)
+            else:
+                rows_g = ratings.users if side == "user" \
+                    else ratings.items
+                cols_g = ratings.items if side == "user" \
+                    else ratings.users
+
+                def rrm(m, _r=rows_g, _c=cols_g):
+                    sel = m[_r]
+                    return _r[sel], _c[sel], ratings.ratings[sel]
+            layout, h = _pack_side_bucket_multihost(
+                rrm, counts, n_rows, mesh, mine, bucket_cap)
+            packed._blocked[(side, n_dev,
+                             tuple(mesh.devices.flat))] = layout
+            hs[side] = h
+            continue
+
         L = resolve_max_len(counts, n_rows,
                             params.max_history and int(params.max_history))
         n_pad = -(-n_rows // n_dev) * n_dev
@@ -798,6 +844,122 @@ class _LayoutOnlyHistories:
 
     n_rows: int
     max_len: int
+
+
+@dataclass(frozen=True)
+class _LayoutOnlyBucket:
+    length: int
+    n_rows: int  # padded member rows
+
+
+@dataclass(frozen=True)
+class _LayoutOnlyBucketed:
+    """Shape metadata standing in for a BucketedHistories assembled from
+    per-process shards (duck-typed: padded_entries/n_rows_padded drive
+    the FLOP model and factor sizing)."""
+
+    buckets: tuple  # of _LayoutOnlyBucket
+    n_rows: int
+    n_rows_padded: int
+
+    @property
+    def padded_entries(self) -> int:
+        return sum(b.n_rows * b.length for b in self.buckets)
+
+    @property
+    def max_len(self) -> int:
+        return max((b.length for b in self.buckets), default=1)
+
+
+def _pack_side_bucket_multihost(read_row_mask, counts: np.ndarray,
+                                n_rows: int, mesh: Mesh, mine: list,
+                                max_len: Optional[int]):
+    """One side of the DROP-FREE multihost packing: every process
+    derives the same global bucket plan from the same ``counts``, packs
+    ONLY the bucket rows its devices own (an arbitrary row set — bucket
+    membership is by history length), and returns per-bucket local
+    arrays ready for ``jax.make_array_from_process_local_data``.
+
+    Unlike the single-host layout, skinny buckets also shard by rows
+    (L-axis sharding would split single rows' entries across processes
+    by position); their padding rows solve to zero and drop."""
+    import jax
+
+    from ..ops.ragged import bucket_layout
+    from ..ops.ragged import _pack_flat_on_device as pack_flat
+
+    n_dev = mesh.devices.size
+    d_loc = len(mine)
+    if max_len is not None:
+        counts = np.minimum(counts, int(max_len))
+    plan, _, _ = bucket_layout(counts, min_len=8, pad_rows_to=n_dev,
+                               max_len=None)
+    n_rows_pad = max(-(-n_rows // n_dev) * n_dev, n_dev)
+
+    # local destination map: global row -> offset in THIS process's flat
+    # buffer (only rows this process owns; others stay -1)
+    local_base = np.full(n_rows, -1, dtype=np.int64)
+    owned = np.zeros(n_rows, dtype=bool)
+    spans = []  # (L, rows_local, n_loc_slots, off_loc, rid_local)
+    off_loc = 0
+    for L, rows_k, n_bk_pad, _ in plan:
+        npb = n_bk_pad // n_dev
+        lo, hi = mine[0] * npb, (mine[-1] + 1) * npb
+        rows_local = rows_k[lo:min(hi, len(rows_k))]
+        n_loc = d_loc * npb
+        rid_global = (n_rows_pad
+                      + np.arange(n_bk_pad, dtype=np.int64)
+                      - len(rows_k)).astype(np.int32)
+        rid_global[:len(rows_k)] = rows_k
+        local_base[rows_local] = off_loc + np.arange(
+            len(rows_local), dtype=np.int64) * int(L)
+        owned[rows_local] = True
+        spans.append((int(L), rows_local, n_loc, off_loc,
+                      rid_global[lo:hi]))
+        off_loc += n_loc * int(L)
+    S_loc = off_loc
+
+    rows_l, cols_l, vals_l = read_row_mask(owned)
+    flat_idx, flat_val = pack_flat(
+        jnp.asarray(rows_l, dtype=jnp.int32),
+        jnp.asarray(cols_l, dtype=jnp.int32),
+        jnp.asarray(vals_l, dtype=jnp.float32),
+        jnp.asarray(local_base, dtype=jnp.int32),
+        jnp.asarray(counts, dtype=jnp.int32),
+        n_rows=n_rows, S=max(S_loc, 1))
+    flat_idx = np.asarray(flat_idx)
+    flat_val = np.asarray(flat_val)
+
+    sharding_rows = NamedSharding(mesh, ROWS)
+    sharding_cnt = NamedSharding(mesh, P(("data", "model")))
+    buckets = []
+    layout_buckets = []
+    for L, rows_local, n_loc, off, rid_local in spans:
+        npb = n_loc // d_loc
+        n_bk_pad = npb * n_dev
+        idx_loc = flat_idx[off:off + n_loc * L].reshape(d_loc, npb, L)
+        val_loc = flat_val[off:off + n_loc * L].reshape(d_loc, npb, L)
+        cnt_loc = np.zeros(n_loc, dtype=np.int32)
+        cnt_loc[:len(rows_local)] = counts[rows_local]
+        buckets.append({
+            "idx": jax.make_array_from_process_local_data(
+                sharding_rows, idx_loc, (n_dev, npb, L)),
+            "val": jax.make_array_from_process_local_data(
+                sharding_rows, val_loc, (n_dev, npb, L)),
+            "cnt": jax.make_array_from_process_local_data(
+                sharding_cnt, cnt_loc.reshape(d_loc, npb),
+                (n_dev, npb)),
+            "rid": jax.make_array_from_process_local_data(
+                sharding_rows, np.ascontiguousarray(rid_local),
+                (n_bk_pad,)),
+        })
+        layout_buckets.append(_LayoutOnlyBucket(length=L,
+                                                n_rows=n_bk_pad))
+    layout = {"mode": "bucket", "mesh": mesh, "buckets": buckets,
+              "n_rows_padded": n_rows_pad}
+    h = _LayoutOnlyBucketed(buckets=tuple(layout_buckets),
+                            n_rows=n_rows, n_rows_padded=n_rows_pad)
+    return layout, h
 
 
 def train_als(ratings: RatingsCOO, params: ALSParams,
@@ -857,12 +1019,10 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
 
     u_split = isinstance(user_h, SplitHistories)
     i_split = isinstance(item_h, SplitHistories)
-    u_rows_pad = user_h.n_rows_padded \
-        if isinstance(user_h, (SplitHistories, BucketedHistories)) \
-        else user_h.n_rows
-    i_rows_pad = item_h.n_rows_padded \
-        if isinstance(item_h, (SplitHistories, BucketedHistories)) \
-        else item_h.n_rows
+    # duck-typed: multihost bucket layouts stand in via
+    # _LayoutOnlyBucketed, which also carries n_rows_padded
+    u_rows_pad = getattr(user_h, "n_rows_padded", None) or user_h.n_rows
+    i_rows_pad = getattr(item_h, "n_rows_padded", None) or item_h.n_rows
 
     ku, ki = jax.random.split(jax.random.key(params.seed))
     U = _init_factors_sharded(ku, n_users_real, u_rows_pad,
@@ -873,7 +1033,7 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     ih = packed.blocked("item", n_dev, mesh)
 
     def _stepper(h, layout):
-        if isinstance(h, BucketedHistories):
+        if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):
             return lambda fixed: _update_side_bucket(fixed, layout, params)
         n_r = h.n_virtual if isinstance(h, SplitHistories) else h.n_rows
         blk = params.block_rows or _auto_block_rows(
@@ -950,8 +1110,9 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             V = _shard(state["V"], mesh, ROWS)
             start = int(latest)
 
-    both_bucket = isinstance(user_h, BucketedHistories) \
-        and isinstance(item_h, BucketedHistories)
+    both_bucket = isinstance(
+        user_h, (BucketedHistories, _LayoutOnlyBucketed)) \
+        and isinstance(item_h, (BucketedHistories, _LayoutOnlyBucketed))
     if ckpt is None and both_bucket and start < params.num_iterations:
         shard = None if mesh is None else NamedSharding(mesh, ROWS)
         return _train_bucket_fused(
@@ -993,7 +1154,7 @@ def als_flops_per_iter(user_h, item_h, params: ALSParams) -> int:
     r = params.rank
 
     def side(h, fixed_rows: int) -> int:
-        if isinstance(h, BucketedHistories):
+        if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):
             padded = h.padded_entries
             n_solve = sum(b.n_rows for b in h.buckets)
         elif isinstance(h, SplitHistories):

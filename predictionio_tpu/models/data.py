@@ -244,10 +244,13 @@ class ColumnarRatingsSource:
              if self._needs_prop else None), self._fixed)
         return vals.astype(np.float32)
 
-    def read_rows(self, side: str, start: int, stop: int):
-        """All rating triples whose ``side`` factor row ∈ [start, stop),
-        as (row_idx, col_idx, value) — chunked over the mmap'd columns so
-        per-call temporaries stay bounded."""
+    def _read_filtered(self, side: str, row_pred):
+        """Shared chunked streaming over the mmap'd columns: collect the
+        rating triples whose mapped ``side`` row index passes
+        ``row_pred`` (a vectorized predicate over int64 row indices).
+        ONE loop serves both the contiguous-range read and the
+        arbitrary-row-set read — the two must never drift (multihost
+        shard equivalence rests on it)."""
         row_lut, col_lut, row_col, col_col = (
             (self._u_lut, self._i_lut, self.batch.entity_id,
              self.batch.target_id) if side == "user" else
@@ -261,7 +264,7 @@ class ColumnarRatingsSource:
             if not m.any():
                 continue
             r = row_lut[np.asarray(row_col[lo:hi])]
-            m &= (r >= start) & (r < stop)
+            m &= row_pred(r)
             if not m.any():
                 continue
             vals = self._values(lo, hi)
@@ -273,6 +276,20 @@ class ColumnarRatingsSource:
             return z, z, np.empty(0, np.float32)
         return (np.concatenate(rows_out), np.concatenate(cols_out),
                 np.concatenate(vals_out))
+
+    def read_rows(self, side: str, start: int, stop: int):
+        """All rating triples whose ``side`` factor row ∈ [start, stop),
+        as (row_idx, col_idx, value) — chunk-bounded temporaries."""
+        return self._read_filtered(
+            side, lambda r: (r >= start) & (r < stop))
+
+    def read_row_mask(self, side: str, mask: np.ndarray):
+        """Rating triples whose ``side`` factor row has ``mask[row]``
+        True — the bucketed multihost layout assigns each process a
+        NON-contiguous row set (bucket membership is by history
+        length), so range reads don't cover it."""
+        return self._read_filtered(
+            side, lambda r: mask[np.maximum(r, 0)] & (r >= 0))
 
     def to_coo(self) -> RatingsCOO:
         rows, cols, vals = self.read_rows("user", 0, self.n_users)

@@ -105,9 +105,27 @@ WORKER = textwrap.dedent("""
     V3_full = np.asarray(rep(V3).addressable_data(0))
     np.testing.assert_allclose(U2_full, U3_full, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(V2_full, V3_full, rtol=1e-4, atol=1e-5)
+    # v3: DROP-FREE bucketed multihost on skewed data (the layout the
+    # pad path would truncate) — each process packs only its own bucket
+    # rows; factors must match the single-process bucket run (parent).
+    rng2 = np.random.default_rng(21)
+    nnz2 = 1200
+    r2 = RatingsCOO(rng2.integers(0, 48, nnz2).astype(np.int32),
+                    ((rng2.zipf(1.2, nnz2) - 1) % 24).astype(np.int32),
+                    np.ones(nnz2, np.float32), 48, 24)
+    params2 = ALSParams(rank=4, num_iterations=2, seed=9,
+                        implicit_prefs=True, alpha=10.0,
+                        history_mode="bucket")
+    packed_b = pack_ratings_multihost(r2, params2, mesh)
+    Ub, Vb = train_als(None, params2, mesh=mesh, packed=packed_b)
+    Ub_full = np.asarray(rep(Ub).addressable_data(0))
+    Vb_full = np.asarray(rep(Vb).addressable_data(0))
+
     if pid == 0:
         np.save(os.path.join(outdir, "U.npy"), U_full)
         np.save(os.path.join(outdir, "V.npy"), V_full)
+        np.save(os.path.join(outdir, "Ub.npy"), Ub_full)
+        np.save(os.path.join(outdir, "Vb.npy"), Vb_full)
         json.dump({"ok": True, "touched": touched["n"], "nnz": nnz},
                   open(os.path.join(outdir, "ok.json"), "w"))
 """)
@@ -151,4 +169,21 @@ def test_two_process_training_matches_single_process(tmp_path):
     np.testing.assert_allclose(U2[:n_users], np.asarray(U1)[:n_users],
                                rtol=2e-3, atol=2e-4)
     np.testing.assert_allclose(V2[:n_items], np.asarray(V1)[:n_items],
+                               rtol=2e-3, atol=2e-4)
+
+    # drop-free bucketed multihost vs the single-process bucket run
+    rng2 = np.random.default_rng(21)
+    nnz2 = 1200
+    r2 = RatingsCOO(rng2.integers(0, 48, nnz2).astype(np.int32),
+                    ((rng2.zipf(1.2, nnz2) - 1) % 24).astype(np.int32),
+                    np.ones(nnz2, np.float32), 48, 24)
+    params2 = ALSParams(rank=4, num_iterations=2, seed=9,
+                        implicit_prefs=True, alpha=10.0,
+                        history_mode="bucket")
+    U1b, V1b = train_als(r2, params2)
+    Ub = np.load(tmp_path / "Ub.npy")
+    Vb = np.load(tmp_path / "Vb.npy")
+    np.testing.assert_allclose(Ub[:48], np.asarray(U1b)[:48],
+                               rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(Vb[:24], np.asarray(V1b)[:24],
                                rtol=2e-3, atol=2e-4)
