@@ -918,6 +918,10 @@ def _pack_side_bucket_multihost(read_row_mask, counts: np.ndarray,
                       rid_global[lo:hi]))
         off_loc += n_loc * int(L)
     S_loc = off_loc
+    if S_loc >= 2 ** 31:  # pragma: no cover — >1B padded slots/process
+        raise ValueError(
+            f"bucketed multihost layout needs {S_loc} local slots "
+            f"(> int32); use more processes or cap max_history")
 
     rows_l, cols_l, vals_l = read_row_mask(owned)
     flat_idx, flat_val = pack_flat(
@@ -1170,9 +1174,8 @@ def als_flops_per_iter(user_h, item_h, params: ALSParams) -> int:
         return f
 
     def rows_of(h):
-        return h.n_rows_padded \
-            if isinstance(h, (SplitHistories, BucketedHistories)) \
-            else h.n_rows
+        # duck-typed: _LayoutOnlyBucketed carries n_rows_padded too
+        return getattr(h, "n_rows_padded", None) or h.n_rows
 
     return side(user_h, rows_of(item_h)) + side(item_h, rows_of(user_h))
 

@@ -68,12 +68,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def wait_port(port, timeout=30):
+def wait_port(port, timeout=30, any_status=False):
+    """Poll until the port answers HTTP — with 200 on GET / by default,
+    or ANY status with ``any_status`` (servers without a root route)."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
             status, _ = http("GET", f"http://127.0.0.1:{port}/")
-            if status == 200:
+            if any_status or status == 200:
                 return
         except OSError:
             time.sleep(0.2)
@@ -183,3 +185,105 @@ def test_quickstart_end_to_end(tmp_path):
     finally:
         srv.terminate()
         srv.wait(timeout=10)
+
+
+def remote_env(pio_home: Path, storage_port: int) -> dict:
+    env = cli_env(pio_home)
+    env.update({
+        "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{storage_port}",
+        "PIO_STORAGE_SOURCES_NET_SECRET": "qs-secret",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+    })
+    return env
+
+
+@pytest.mark.integration
+def test_quickstart_over_remote_storage(tmp_path):
+    """The pod topology end-to-end in real processes: ONE storage server
+    owns the store; the CLI, event server, trainer, and engine server
+    all reach it over HTTP (no shared PIO_HOME state between them)."""
+    storage_home = tmp_path / "storage_home"
+    storage_home.mkdir()
+    client_home = tmp_path / "client_home"  # deliberately EMPTY
+    client_home.mkdir()
+
+    st_port = free_port()
+    st = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli", "storageserver",
+         "--ip", "127.0.0.1", "--port", str(st_port),
+         "--secret", "qs-secret"],
+        env=cli_env(storage_home), cwd=str(REPO),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    env = remote_env(client_home, st_port)
+
+    def run(*args, timeout=240):
+        return subprocess.run(
+            [sys.executable, "-m", "predictionio_tpu.cli", *args],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=str(REPO))
+
+    es = srv = None
+    try:
+        wait_port(st_port, any_status=True)
+        out = run("app", "new", "netqs")
+        assert out.returncode == 0, out.stderr
+        access_key = next(l.split(":", 1)[1].strip()
+                          for l in out.stdout.splitlines()
+                          if l.startswith("Access Key:"))
+        assert run("status").returncode == 0
+
+        es_port = free_port()
+        es = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli",
+             "eventserver", "--ip", "127.0.0.1", "--port", str(es_port)],
+            env=env, cwd=str(REPO), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        wait_port(es_port)
+        rng = np.random.default_rng(9)
+        batch = [{"event": "rate", "entityType": "user",
+                  "entityId": f"u{int(u)}", "targetEntityType": "item",
+                  "targetEntityId": f"i{int(i)}",
+                  "properties": {"rating": float(r)}}
+                 for u, i, r in zip(rng.integers(0, 12, 48),
+                                    rng.integers(0, 10, 48),
+                                    rng.integers(1, 6, 48))]
+        status, body = http(
+            "POST",
+            f"http://127.0.0.1:{es_port}/batch/events.json"
+            f"?accessKey={access_key}", batch)
+        assert status == 200 and all(r["status"] == 201 for r in body)
+
+        variant = {
+            "id": "netqs", "version": "1",
+            "engineFactory": "predictionio_tpu.templates."
+                             "recommendation:recommendation_engine",
+            "datasource": {"params": {"app_name": "netqs"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "num_iterations": 3,
+                                       "seed": 2}}],
+        }
+        ej = tmp_path / "engine.json"
+        ej.write_text(json.dumps(variant))
+        out = run("train", "--engine-json", str(ej))
+        assert out.returncode == 0, out.stderr + out.stdout
+
+        q_port = free_port()
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli", "deploy",
+             "--engine-json", str(ej), "--ip", "127.0.0.1",
+             "--port", str(q_port)],
+            env=env, cwd=str(REPO), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        wait_port(q_port, timeout=90)
+        status, body = http(
+            "POST", f"http://127.0.0.1:{q_port}/queries.json",
+            {"user": "u0", "num": 3})
+        assert status == 200 and body["itemScores"], body
+    finally:
+        for p in (es, srv, st):
+            if p is not None:
+                p.terminate()
+                p.wait(timeout=10)
