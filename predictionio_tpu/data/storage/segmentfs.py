@@ -445,6 +445,8 @@ class SegmentFSEventStore(EventStore):
     #: delta records per sidecar segment append (bounds host memory —
     #: a compacted jsonl log can be ONE multi-million-line segment)
     COLUMNAR_CHUNK = 500_000
+    #: bytes per native-codec parse call (plus the current line's tail)
+    CODEC_BLOCK = 64 << 20
 
     @staticmethod
     def _iter_records(path: str) -> Iterator[dict]:
@@ -455,6 +457,86 @@ class SegmentFSEventStore(EventStore):
             for line in f:
                 if line.strip():
                     yield json.loads(line)
+
+    #: encode-chunk column names (parallel lists)
+    _CCOLS = ("event", "entity_type", "entity_id", "target_type",
+              "target_id", "time_iso", "event_id", "props_raw")
+
+    def _iter_segment_columns(self, path: str, float_props: tuple):
+        """Yield column-dict blocks for one jsonl segment — the NATIVE
+        codec (C++ tokenizer, predictionio_tpu/native) when available,
+        else a pure-Python fallback with identical semantics. Yields
+        ``None`` (then stops) on the first non-"put" record: the caller
+        rebuilds (deletes falsify incremental encode)."""
+        from ...native import codec
+
+        m = codec()
+        if m is not None:
+            try:
+                with open(path, "rb") as f:
+                    while True:
+                        data = f.read(self.CODEC_BLOCK)
+                        if not data:
+                            return
+                        tail = f.readline()  # finish the cut line
+                        if tail:
+                            data += tail
+                        out = m.parse_segment(data, tuple(float_props))
+                        if out is None:
+                            yield None
+                            return
+                        ev, et, ei, tt, ti, times, ids, praw, fps = out
+                        yield {"event": ev, "entity_type": et,
+                               "entity_id": ei, "target_type": tt,
+                               "target_id": ti, "time_iso": times,
+                               "event_id": ids, "props_raw": praw,
+                               "fprops": fps}
+                return
+            except (ValueError, UnicodeDecodeError):
+                # content the strict C++ tokenizer refuses (e.g. LONE
+                # surrogate escapes, which Python's json round-trips):
+                # redo THIS segment on the always-correct Python path
+                pass
+        from ..columnar import bulk_to_float64
+
+        def fresh():
+            c = {k: [] for k in self._CCOLS}
+            c["fprops"] = [[] for _ in float_props]
+            return c
+
+        def finish(c):
+            # ONE numbers-only gate for both producers (bulk_to_float64;
+            # the codec applies the same gate in C++)
+            c["fprops"] = [bulk_to_float64(raw).tolist()
+                           for raw in c["fprops"]]
+            return c
+
+        cols = fresh()
+        n = 0
+        for r in self._iter_records(path):
+            if r["op"] != "put":
+                yield None
+                return
+            e = r["event"]
+            props = e.get("properties")
+            cols["event"].append(e["event"])
+            cols["entity_type"].append(e["entityType"])
+            cols["entity_id"].append(e["entityId"])
+            cols["target_type"].append(e.get("targetEntityType"))
+            cols["target_id"].append(e.get("targetEntityId"))
+            cols["time_iso"].append(e["eventTime"])
+            cols["event_id"].append(e.get("eventId") or "")
+            cols["props_raw"].append(
+                json.dumps(props).encode("utf-8") if props else None)
+            for w, nm in enumerate(float_props):
+                cols["fprops"][w].append((props or {}).get(nm))
+            n += 1
+            if n >= self.COLUMNAR_CHUNK:
+                yield finish(cols)
+                cols = fresh()
+                n = 0
+        if n:
+            yield finish(cols)
 
     def _encode_columnar_delta(self, log, d: str, src: tuple, done: tuple,
                                delta: tuple, float_props: tuple,
@@ -497,14 +579,22 @@ class SegmentFSEventStore(EventStore):
             return
         stored = np.asarray(stored)
         consumed = list(done)
-        chunk: list = []
+        chunk: Optional[dict] = None
+
+        def extend(acc, cols):
+            if acc is None:
+                return cols
+            for k in self._CCOLS:
+                acc[k].extend(cols[k])
+            for w in range(len(acc["fprops"])):
+                acc["fprops"][w].extend(cols["fprops"][w])
+            return acc
 
         def flush(chunk, consumed_after) -> bool:
             """Encode one chunk; False → dup detected, caller rebuilds."""
             nonlocal stored
-            ids = np.asarray([e.get("eventId") or "" for e in chunk],
-                             dtype=object)
-            new_h = bulk_hash64(ids)
+            new_h = bulk_hash64(
+                np.asarray(chunk["event_id"], dtype=object))
             if len(np.unique(new_h)) != len(new_h) \
                     or (len(stored) and np.isin(new_h, stored).any()):
                 return False
@@ -514,26 +604,28 @@ class SegmentFSEventStore(EventStore):
             return True
 
         for name in delta:
-            for r in self._iter_records(os.path.join(d, name)):
-                if r["op"] != "put":
+            for cols in self._iter_segment_columns(
+                    os.path.join(d, name), float_props):
+                if cols is None:
                     rebuild()
                     return
-                chunk.append(r["event"])
-                if len(chunk) >= self.COLUMNAR_CHUNK:
+                chunk = extend(chunk, cols)
+                if len(chunk["event"]) >= self.COLUMNAR_CHUNK:
                     # mid-segment flush: watermark only advances at
                     # segment boundaries (crash ⇒ re-encode of this
                     # segment is caught by the dup check → rebuild)
                     if not flush(chunk, consumed):
                         rebuild()
                         return
-                    chunk = []
+                    chunk = None
             consumed.append(name)
-            if chunk and len(chunk) >= self.COLUMNAR_CHUNK // 2:
+            if chunk is not None \
+                    and len(chunk["event"]) >= self.COLUMNAR_CHUNK // 2:
                 if not flush(chunk, consumed):
                     rebuild()
                     return
-                chunk = []
-        if chunk:
+                chunk = None
+        if chunk is not None:
             if not flush(chunk, consumed):
                 rebuild()
                 return
@@ -543,32 +635,23 @@ class SegmentFSEventStore(EventStore):
                 man["watermark"] = consumed
                 log._write_manifest(man)
 
-    def _append_put_chunk(self, log, puts: list, consumed: list,
+    def _append_put_chunk(self, log, cols: dict, consumed: list,
                           float_props: tuple, new_h) -> None:
+        """Commit one column-chunk (see ``_CCOLS`` + per-prop float
+        lists, NaN for missing — both producers pre-apply the
+        numbers-only gate) as a sidecar segment."""
         import numpy as np
 
-        from ..columnar import (
-            bulk_iso_to_millis,
-            bulk_to_float64,
-            columnar_from_columns,
-        )
+        from ..columnar import bulk_iso_to_millis, columnar_from_columns
 
         dicts, prev_counts = log.dicts_and_counts()
-        times = bulk_iso_to_millis([e["eventTime"] for e in puts])
-        props = [e.get("properties") for e in puts]
-        pj = [json.dumps(p) if p else None for p in props]
-        # bulk_to_float64 drops non-numbers (incl. bools) to NaN — the
-        # lazy parse path's isinstance gate
-        fpv = {nm: bulk_to_float64([(p or {}).get(nm) for p in props])
-               for nm in float_props}
+        times = bulk_iso_to_millis(cols["time_iso"])
+        fpv = {nm: np.asarray(cols["fprops"][w], dtype=np.float64)
+               for w, nm in enumerate(float_props)}
         batch = columnar_from_columns(
-            dicts,
-            [e["event"] for e in puts],
-            [e["entityType"] for e in puts],
-            [e["entityId"] for e in puts],
-            [e.get("targetEntityType") for e in puts],
-            [e.get("targetEntityId") for e in puts],
-            np.asarray(times, dtype=np.int64), pj,
+            dicts, cols["event"], cols["entity_type"],
+            cols["entity_id"], cols["target_type"], cols["target_id"],
+            np.asarray(times, dtype=np.int64), cols["props_raw"],
             float_props=float_props, float_prop_values=fpv)
         log.append(batch, watermark=list(consumed),
                    prev_dict_counts=prev_counts)

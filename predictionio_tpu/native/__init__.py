@@ -1,0 +1,89 @@
+"""Native (C++) hot-path components, with graceful fallback.
+
+The framework's compute plane is JAX/XLA; the RUNTIME around it uses
+native code where Python is the measured bottleneck — first component:
+the jsonl→columnar segment codec (``_codec.cpp``), covering the role
+the reference's JVM/parser stack played for its storage codecs.
+
+The extension is compiled on first use with the toolchain's ``g++``
+(one ``-O2 -shared -fPIC`` invocation against this interpreter's
+headers, cached per source digest under ``~/.cache/predictionio_tpu``)
+— or import a prebuilt ``_codec`` if packaging built one. Every caller
+falls back to the pure-Python path when no compiler/extension is
+available, so native code is an accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_state: dict = {}
+
+
+def _build(src: str) -> Optional[object]:
+    try:
+        cache = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")),
+            "predictionio_tpu")
+        os.makedirs(cache, exist_ok=True)
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError as e:
+        # e.g. an installed wheel without the .cpp, or unwritable cache
+        log.info("native codec source unavailable (%s); using the "
+                 "pure-Python path", e)
+        return None
+    tag = (f"_codec-{digest}-cp{sys.version_info.major}"
+           f"{sys.version_info.minor}.so")
+    out = os.path.join(cache, tag)
+    if not os.path.exists(out):
+        # per-process tmp name: concurrent first-use builds (multi-host
+        # training on a shared home) must not interleave into one file
+        tmp = f"{out}.tmp.{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               f"-I{sysconfig.get_paths()['include']}", src,
+               "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, out)
+        except (OSError, subprocess.SubprocessError) as e:
+            log.info("native codec build unavailable (%s); using the "
+                     "pure-Python path", e)
+            return None
+    spec = importlib.util.spec_from_file_location(
+        "predictionio_tpu.native._codec", out)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception as e:  # noqa: BLE001 — ABI mismatch etc.
+        log.info("native codec load failed (%s); using the pure-Python "
+                 "path", e)
+        return None
+    return mod
+
+
+def codec() -> Optional[object]:
+    """The ``_codec`` extension module, or None (pure-Python fallback).
+    Tried once per process; set ``PTPU_NO_NATIVE=1`` to disable."""
+    if "codec" in _state:
+        return _state["codec"]
+    mod = None
+    if os.environ.get("PTPU_NO_NATIVE") != "1":
+        try:
+            from . import _codec as mod  # type: ignore[attr-defined]
+        except ImportError:
+            mod = _build(os.path.join(os.path.dirname(__file__),
+                                      "_codec.cpp"))
+    _state["codec"] = mod
+    return mod

@@ -1,0 +1,120 @@
+"""Native (C++) columnar codec: correctness against the Python path.
+
+The codec is an ACCELERATOR — every test here must also pass with
+``PTPU_NO_NATIVE=1`` (the suite covers both by construction: the
+fallback-equivalence test runs the two paths against each other).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.native import codec
+
+
+@pytest.fixture(scope="module")
+def mod():
+    m = codec()
+    if m is None:
+        pytest.skip("native codec unavailable (no compiler)")
+    return m
+
+
+class TestCodecParse:
+    def test_roundtrip_tricky_content(self, mod):
+        recs = [
+            {"op": "put", "event": {
+                "event": "rate", "entityType": "user",
+                "entityId": "uñ→\"x\\",
+                "targetEntityType": "item",
+                "targetEntityId": "i\U0001F600", "eventId": "e1",
+                "properties": {"rating": 4.5, "note": "a\nb",
+                               "nested": {"k": [1, {"r": 2}]},
+                               "flag": True},
+                "eventTime": "2026-07-30T12:00:00.123Z",
+                "creationTime": "2026-07-30T12:00:00.123Z",
+                "tags": ["a", "b"]}},
+            {"op": "put", "event": {
+                "event": "$set", "entityType": "item", "entityId": "i1",
+                "eventId": "e2",
+                "eventTime": "2026-07-30T12:00:01.000Z",
+                "creationTime": "2026-07-30T12:00:01.000Z"}},
+        ]
+        data = ("".join(json.dumps(r) + "\n" for r in recs)).encode()
+        ev, et, ei, tt, ti, times, ids, praw, fps = mod.parse_segment(
+            data, ("rating",))
+        assert ev == ["rate", "$set"]
+        assert ei[0] == 'uñ→"x\\'
+        assert ti[0] == "i\U0001F600" and tt[1] is None
+        assert ids == ["e1", "e2"]
+        assert json.loads(praw[0]) == recs[0]["event"]["properties"]
+        assert praw[1] is None
+        assert fps[0][0] == 4.5 and np.isnan(fps[0][1])
+
+    def test_string_number_and_bool_props_stay_nan(self, mod):
+        recs = [{"op": "put", "event": {
+            "event": "rate", "entityType": "user", "entityId": "u",
+            "targetEntityType": "item", "targetEntityId": "i",
+            "eventId": f"e{k}", "properties": {"rating": v},
+            "eventTime": "2026-01-01T00:00:00.000Z",
+            "creationTime": "2026-01-01T00:00:00.000Z"}}
+            for k, v in enumerate(["4.5", True, None, 3])]
+        data = ("".join(json.dumps(r) + "\n" for r in recs)).encode()
+        *_, fps = mod.parse_segment(data, ("rating",))
+        r = fps[0]
+        assert np.isnan(r[0]) and np.isnan(r[1]) and np.isnan(r[2])
+        assert r[3] == 3.0
+
+    def test_del_record_returns_none(self, mod):
+        data = (json.dumps({"op": "del", "id": "x"}) + "\n").encode()
+        assert mod.parse_segment(data, ()) is None
+
+    def test_malformed_raises(self, mod):
+        with pytest.raises(ValueError):
+            mod.parse_segment(b'{"op": "put", "event": {oops\n', ())
+
+
+class TestNativeVsPythonEncode:
+    def test_segmentfs_encode_identical(self, tmp_path, monkeypatch):
+        """The sidecar built through the codec must be value-identical
+        to the pure-Python build of the same log."""
+        import predictionio_tpu.native as native
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.segmentfs import (
+            SegmentFSClient,
+            SegmentFSEventStore,
+        )
+
+        def build(td):
+            es = SegmentFSEventStore(SegmentFSClient(str(td)))
+            es.init(1)
+            rng = np.random.default_rng(7)
+            es.insert_batch(
+                [Event(event="rate", entity_type="user",
+                       entity_id=f"u{int(u)}",
+                       target_entity_type="item",
+                       target_entity_id=f"ié{int(i)}",
+                       properties=DataMap({"rating": float(r),
+                                           "extra": "x,\"y\""}))
+                 for u, i, r in zip(rng.integers(0, 20, 400),
+                                    rng.integers(0, 9, 400),
+                                    rng.integers(1, 6, 400))], 1)
+            return es.find_columnar(1, ordered=True)
+
+        b1 = build(tmp_path / "native")
+        native._state.clear()
+        monkeypatch.setenv("PTPU_NO_NATIVE", "1")
+        try:
+            b2 = build(tmp_path / "python")
+        finally:
+            native._state.clear()
+        assert b1.n == b2.n == 400
+        np.testing.assert_array_equal(b1.float_prop("rating"),
+                                      b2.float_prop("rating"))
+        e1 = [(e.event, e.entity_id, e.target_entity_id,
+               e.properties.to_dict()) for e in b1.to_events()]
+        e2 = [(e.event, e.entity_id, e.target_entity_id,
+               e.properties.to_dict()) for e in b2.to_events()]
+        assert e1 == e2
