@@ -186,6 +186,25 @@ def main():
     from predictionio_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
 
+    # Fail FAST when the device tunnel is hung: jax.devices() through a
+    # dead tunnel blocks indefinitely (observed all of round 3), which
+    # would burn the supervisor's whole attempt timeout per retry. Probe
+    # in a daemon thread with its own bound; rc=3 tells the supervisor
+    # this was an init hang, not a slow run.
+    import concurrent.futures as _cf
+
+    probe_s = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
+    with _cf.ThreadPoolExecutor(1) as _pool:
+        fut = _pool.submit(jax.devices)
+        try:
+            devs = fut.result(timeout=probe_s)
+        except _cf.TimeoutError:
+            sys.stderr.write(
+                f"device backend init exceeded {probe_s}s (hung "
+                f"tunnel)\n")
+            os._exit(3)  # the probe thread is stuck; no clean join
+    sys.stderr.write(f"devices: {devs}\n")
+
     from predictionio_tpu.models.als import (
         ALSParams,
         RatingsCOO,

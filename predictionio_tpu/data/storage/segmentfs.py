@@ -472,6 +472,7 @@ class SegmentFSEventStore(EventStore):
 
         m = codec()
         if m is not None:
+            yielded = False
             try:
                 with open(path, "rb") as f:
                     while True:
@@ -486,6 +487,9 @@ class SegmentFSEventStore(EventStore):
                             yield None
                             return
                         ev, et, ei, tt, ti, times, ids, praw, fps = out
+                        if not ev:
+                            continue  # blank-only block
+                        yielded = True
                         yield {"event": ev, "entity_type": et,
                                "entity_id": ei, "target_type": tt,
                                "target_id": ti, "time_iso": times,
@@ -494,9 +498,14 @@ class SegmentFSEventStore(EventStore):
                 return
             except (ValueError, UnicodeDecodeError):
                 # content the strict C++ tokenizer refuses (e.g. LONE
-                # surrogate escapes, which Python's json round-trips):
-                # redo THIS segment on the always-correct Python path
-                pass
+                # surrogate escapes, which Python's json round-trips).
+                # Only a CLEAN restart may redo the segment on the
+                # Python path — if blocks already went downstream, a
+                # re-read would duplicate them (dup-check → pointless
+                # full rebuild); signal rebuild directly instead.
+                if yielded:
+                    yield None
+                    return
         from ..columnar import bulk_to_float64
 
         def fresh():
@@ -603,6 +612,14 @@ class SegmentFSEventStore(EventStore):
             stored = np.concatenate([stored, new_h])
             return True
 
+        def split(c, n):
+            """First n rows of a column chunk, and the remainder."""
+            head = {k: c[k][:n] for k in self._CCOLS}
+            head["fprops"] = [f[:n] for f in c["fprops"]]
+            rest = {k: c[k][n:] for k in self._CCOLS}
+            rest["fprops"] = [f[n:] for f in c["fprops"]]
+            return head, (rest if rest["event"] else None)
+
         for name in delta:
             for cols in self._iter_segment_columns(
                     os.path.join(d, name), float_props):
@@ -610,14 +627,17 @@ class SegmentFSEventStore(EventStore):
                     rebuild()
                     return
                 chunk = extend(chunk, cols)
-                if len(chunk["event"]) >= self.COLUMNAR_CHUNK:
-                    # mid-segment flush: watermark only advances at
-                    # segment boundaries (crash ⇒ re-encode of this
-                    # segment is caught by the dup check → rebuild)
-                    if not flush(chunk, consumed):
+                while chunk is not None \
+                        and len(chunk["event"]) >= self.COLUMNAR_CHUNK:
+                    # mid-segment flush in CHUNK-row slices (a codec
+                    # block can carry several chunks' worth): watermark
+                    # only advances at segment boundaries (crash ⇒
+                    # re-encode of this segment is caught by the dup
+                    # check → rebuild)
+                    head, chunk = split(chunk, self.COLUMNAR_CHUNK)
+                    if not flush(head, consumed):
                         rebuild()
                         return
-                    chunk = None
             consumed.append(name)
             if chunk is not None \
                     and len(chunk["event"]) >= self.COLUMNAR_CHUNK // 2:
@@ -625,7 +645,7 @@ class SegmentFSEventStore(EventStore):
                     rebuild()
                     return
                 chunk = None
-        if chunk is not None:
+        if chunk is not None and chunk["event"]:
             if not flush(chunk, consumed):
                 rebuild()
                 return

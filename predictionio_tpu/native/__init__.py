@@ -43,8 +43,11 @@ def _build(src: str) -> Optional[object]:
         log.info("native codec source unavailable (%s); using the "
                  "pure-Python path", e)
         return None
+    # tag carries python version AND platform: a shared home across
+    # heterogeneous hosts must not serve one arch's .so to another
+    plat = sysconfig.get_platform().replace("-", "_")
     tag = (f"_codec-{digest}-cp{sys.version_info.major}"
-           f"{sys.version_info.minor}.so")
+           f"{sys.version_info.minor}-{plat}.so")
     out = os.path.join(cache, tag)
     if not os.path.exists(out):
         # per-process tmp name: concurrent first-use builds (multi-host

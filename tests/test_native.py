@@ -76,7 +76,8 @@ class TestCodecParse:
 
 
 class TestNativeVsPythonEncode:
-    def test_segmentfs_encode_identical(self, tmp_path, monkeypatch):
+    def test_segmentfs_encode_identical(self, tmp_path, monkeypatch,
+                                        mod):
         """The sidecar built through the codec must be value-identical
         to the pure-Python build of the same log."""
         import predictionio_tpu.native as native
@@ -86,6 +87,8 @@ class TestNativeVsPythonEncode:
             SegmentFSClient,
             SegmentFSEventStore,
         )
+
+        assert codec() is not None  # not vacuous: native side is real
 
         def build(td):
             es = SegmentFSEventStore(SegmentFSClient(str(td)))
