@@ -50,9 +50,11 @@ def _build(src: str) -> Optional[object]:
            f"{sys.version_info.minor}-{plat}.so")
     out = os.path.join(cache, tag)
     if not os.path.exists(out):
-        # per-process tmp name: concurrent first-use builds (multi-host
-        # training on a shared home) must not interleave into one file
-        tmp = f"{out}.tmp.{os.getpid()}"
+        # unique tmp name: concurrent first-use builds (multi-host
+        # training on a shared home — pid alone collides ACROSS hosts)
+        # must not interleave into one file
+        import uuid as _uuid
+        tmp = f"{out}.tmp.{_uuid.uuid4().hex}"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                f"-I{sysconfig.get_paths()['include']}", src,
                "-o", tmp]

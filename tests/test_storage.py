@@ -599,6 +599,27 @@ class TestSegmentFSColumnarSidecar:
         props = es.aggregate_properties(1, entity_type="item")
         assert props["i3"]["cat"] == "c1"
 
+    def test_missing_hash_file_crash_window_self_heals(self, tmp_path):
+        """A crash between the sidecar segment commit and its id-hash
+        write leaves a hash-less segment; the next sync must rebuild
+        (not trust, not crash) and serve the correct projection."""
+        import os
+
+        es = self._store(tmp_path)
+        self._seed(es, n=30)
+        es.find_columnar(1)
+        cdir = tmp_path / "events" / "app_1" / "columnar"
+        hashes = list(cdir.glob("seg-*/id_hash.npy"))
+        assert hashes
+        os.unlink(hashes[0])
+        self._seed(es, n=10, seed=5)  # delta sync hits the crash window
+        b = es.find_columnar(1, ordered=False)
+        assert b.n == 40
+        rows = sorted((e.event, e.entity_id) for e in es.find(1))
+        cols = sorted((e.event, e.entity_id)
+                      for e in es.find_columnar(1).to_events())
+        assert rows == cols
+
     def test_rebuild_retires_old_segments_with_grace(self, tmp_path):
         """A rebuild must not unlink sidecar files other hosts may still
         mmap (NFS gives no unlink-keeps-inode guarantee); old segment
