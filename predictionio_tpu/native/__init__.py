@@ -63,6 +63,10 @@ def _build(src: str) -> Optional[object]:
                            timeout=120)
             os.replace(tmp, out)
         except (OSError, subprocess.SubprocessError) as e:
+            try:  # a failed/timed-out build must not leak its tmp
+                os.unlink(tmp)
+            except OSError:
+                pass
             log.info("native codec build unavailable (%s); using the "
                      "pure-Python path", e)
             return None
