@@ -1,0 +1,268 @@
+"""Sequential recommendation: causal self-attention over item histories.
+
+A model family BEYOND the reference's inventory (PredictionIO has no
+sequence models — SURVEY §5 records sequence parallelism "absent"), made
+natural here by the TPU-first substrate: a SASRec-style next-item
+predictor — item + position embeddings → one pre-LN causal
+self-attention block (the SAME blockwise-softmax kernel
+``ops/ring_attention`` uses; at pod scale the ring path serves sequences
+longer than one device holds) → position-wise FFN → tied-embedding item
+scores — trained with sampled-softmax cross-entropy under ``jit`` on an
+optionally batch-sharded mesh.
+
+Shapes are static everywhere: histories are right-aligned into a fixed
+``[N, L]`` window with a padding id, the training step is one compiled
+program, and epochs run as a host loop of compiled steps.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ring_attention import _ring_attention_local_nodist
+
+
+@dataclass(frozen=True)
+class SeqRecParams:
+    """Hyperparameters (engine.json-compatible camelCase aliases via the
+    controller's param instantiation, like every other algorithm)."""
+
+    dim: int = 48
+    heads: int = 2
+    max_len: int = 50
+    num_epochs: int = 10
+    batch_size: int = 128
+    learning_rate: float = 1e-3
+    n_negatives: int = 64
+    dropout: float = 0.0  # reserved; the compiled step is deterministic
+    seed: int = 7
+
+    def __post_init__(self):
+        if self.dim % self.heads != 0:
+            raise ValueError("dim must divide by heads")
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SeqRecModel:
+    """Learned weights + id indexation. A pytree (weights are children)
+    so model persistence's host/device moves reach inside."""
+
+    weights: Dict[str, jax.Array] = field(metadata=dict(static=False))
+    n_items: int = field(metadata=dict(static=True))
+    item_ids: Optional[object] = field(default=None,
+                                       metadata=dict(static=True))
+    params: SeqRecParams = field(default_factory=SeqRecParams,
+                                 metadata=dict(static=True))
+    #: event names the training sequences were built from — serving-time
+    #: history reads must filter identically (train/serve skew otherwise)
+    events: Optional[Tuple[str, ...]] = field(
+        default=None, metadata=dict(static=True))
+    #: app the model was trained on — serving-time history reads resolve
+    #: against it (the deploy ctx's app_name may be unset; the
+    #: e-commerce template does the same)
+    app_name: str = field(default="", metadata=dict(static=True))
+
+
+def sequences_from_ratings(users: np.ndarray, items: np.ndarray,
+                           times: np.ndarray, n_users: int,
+                           max_len: int) -> np.ndarray:
+    """Per-user chronological item sequences, right-aligned into a
+    ``[n_users, max_len]`` window padded with -1 (older items beyond the
+    window drop — the SASRec convention)."""
+    order = np.lexsort((times, users))
+    u, it = users[order], items[order]
+    out = np.full((n_users, max_len), -1, dtype=np.int32)
+    counts = np.bincount(u, minlength=n_users)
+    starts = np.zeros(n_users + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    for row in range(n_users):
+        s, e = starts[row], starts[row + 1]
+        seq = it[s:e][-max_len:]
+        if len(seq):
+            out[row, -len(seq):] = seq
+    return out
+
+
+def _init_weights(key, n_items: int, p: SeqRecParams) -> Dict[str, jax.Array]:
+    ks = jax.random.split(key, 6)
+    d = p.dim
+    s = d ** -0.5
+    return {
+        # one extra row: the padding id embeds to a learned-but-masked row
+        "item_emb": jax.random.normal(ks[0], (n_items + 1, d)) * 0.02,
+        "pos_emb": jax.random.normal(ks[1], (p.max_len, d)) * 0.02,
+        "qkv": jax.random.normal(ks[2], (d, 3 * d)) * s,
+        "attn_out": jax.random.normal(ks[3], (d, d)) * s,
+        "ff1": jax.random.normal(ks[4], (d, 4 * d)) * s,
+        "ff2": jax.random.normal(ks[5], (4 * d, d)) * (4 * d) ** -0.5,
+        "ln1": jnp.ones((d,)), "ln1b": jnp.zeros((d,)),
+        "ln2": jnp.ones((d,)), "ln2b": jnp.zeros((d,)),
+        "lnf": jnp.ones((d,)), "lnfb": jnp.zeros((d,)),
+    }
+
+
+def _layer_norm(x, g, b):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g + b
+
+
+def _encode(w: Dict[str, jax.Array], seq: jax.Array, p: SeqRecParams
+            ) -> jax.Array:
+    """[B, L] padded item ids → [B, L, dim] causal contextual states."""
+    B, L = seq.shape
+    d, H = p.dim, p.heads
+    pad = seq < 0
+    ids = jnp.where(pad, p_pad_id(w), seq)
+    x = w["item_emb"][ids] + w["pos_emb"][None, -L:]
+    x = jnp.where(pad[..., None], 0.0, x)
+
+    h = _layer_norm(x, w["ln1"], w["ln1b"])
+    qkv = h @ w["qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shp = (B, L, H, d // H)
+    # the shared blockwise-softmax attention kernel (ring-capable at pod
+    # scale; single-device blockwise here — L is the history window).
+    # key_valid masks the left-pad slots: without it, real positions
+    # attend to (learned) pad keys and scores drift with pad count —
+    # the classic SASRec padding bug.
+    attn = _ring_attention_local_nodist(
+        q.reshape(shp), k.reshape(shp), v.reshape(shp), causal=True,
+        scale=(d // H) ** -0.5, key_valid=~pad).reshape(B, L, d)
+    x = x + jnp.where(pad[..., None], 0.0, attn @ w["attn_out"])
+
+    h = _layer_norm(x, w["ln2"], w["ln2b"])
+    x = x + jnp.where(pad[..., None], 0.0,
+                      jax.nn.relu(h @ w["ff1"]) @ w["ff2"])
+    return _layer_norm(x, w["lnf"], w["lnfb"])
+
+
+def p_pad_id(w) -> int:
+    return w["item_emb"].shape[0] - 1
+
+
+@functools.partial(jax.jit, static_argnames=("p", "n_items"))
+def _train_step(w, opt_m, opt_v, step, seq, key, p: SeqRecParams,
+                n_items: int):
+    """One Adam step of sampled-softmax next-item loss. Inputs [B, L]
+    (positions 0..L-2 predict 1..L-1); compiled once per shape."""
+
+    def loss_fn(w):
+        ctx = _encode(w, seq[:, :-1], p)            # [B, L-1, d]
+        targets = seq[:, 1:]                         # [B, L-1]
+        valid = (targets >= 0) & (seq[:, :-1] >= 0)
+        tgt = jnp.where(valid, targets, 0)
+        negs = jax.random.randint(
+            key, seq.shape[:1] + (seq.shape[1] - 1, p.n_negatives),
+            0, n_items)
+        cand = jnp.concatenate([tgt[..., None], negs], axis=-1)
+        emb = w["item_emb"][cand]                    # [B, L-1, K+1, d]
+        logits = jnp.einsum("bld,blkd->blk", ctx, emb)
+        # sampled softmax: positive is slot 0
+        ll = jax.nn.log_softmax(logits, axis=-1)[..., 0]
+        n = jnp.maximum(valid.sum(), 1)
+        return -(jnp.where(valid, ll, 0.0).sum()) / n
+
+    loss, grads = jax.value_and_grad(loss_fn)(w)
+    # inline Adam (no optax state-pytree plumbing across shardings)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    step = step + 1
+    new_w, new_m, new_v = {}, {}, {}
+    for kname, g in grads.items():
+        m = b1 * opt_m[kname] + (1 - b1) * g
+        v = b2 * opt_v[kname] + (1 - b2) * g * g
+        mh = m / (1 - b1 ** step)
+        vh = v / (1 - b2 ** step)
+        new_w[kname] = w[kname] - p.learning_rate * mh / (
+            jnp.sqrt(vh) + eps)
+        new_m[kname], new_v[kname] = m, v
+    return new_w, new_m, new_v, step, loss
+
+
+def train_seqrec(sequences: np.ndarray, n_items: int,
+                 params: SeqRecParams,
+                 mesh: Optional[Mesh] = None,
+                 item_ids: Optional[object] = None,
+                 events: Optional[Tuple[str, ...]] = None,
+                 app_name: str = ""
+                 ) -> Tuple[SeqRecModel, List[float]]:
+    """Train on ``[N, max_len]`` padded sequences (-1 = pad). Under a
+    mesh the BATCH axis shards over all devices (data parallel; XLA
+    inserts the gradient all-reduce). Returns (model, per-epoch loss)."""
+    seqs = np.asarray(sequences, dtype=np.int32)
+    # keep rows with at least one (context, target) pair
+    seqs = seqs[(seqs >= 0).sum(axis=1) >= 2]
+    if len(seqs) == 0:
+        raise ValueError("seqrec needs at least one sequence of length 2")
+    key = jax.random.key(params.seed)
+    w = _init_weights(key, n_items, params)
+    opt_m = {k: jnp.zeros_like(v) for k, v in w.items()}
+    opt_v = {k: jnp.zeros_like(v) for k, v in w.items()}
+    step = jnp.zeros((), jnp.int32)
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        w = jax.device_put(w, rep)
+        opt_m = jax.device_put(opt_m, rep)
+        opt_v = jax.device_put(opt_v, rep)
+
+    B = params.batch_size
+    n_dev = 1 if mesh is None else mesh.devices.size
+    B = max(B // n_dev, 1) * n_dev  # divisible batches for the mesh
+    rng = np.random.default_rng(params.seed)
+    losses: List[float] = []
+    batch_sharding = None if mesh is None \
+        else NamedSharding(mesh, P(("data", "model")))
+    for epoch in range(params.num_epochs):
+        order = rng.permutation(len(seqs))
+        total, batches = 0.0, 0
+        for s in range(0, len(seqs) - B + 1, B):
+            rows = order[s:s + B]
+            batch = seqs[rows]
+            xb = jnp.asarray(batch) if batch_sharding is None else \
+                jax.device_put(jnp.asarray(batch), batch_sharding)
+            key, sub = jax.random.split(key)
+            w, opt_m, opt_v, step, loss = _train_step(
+                w, opt_m, opt_v, step, xb, sub, params, n_items)
+            total += float(loss)
+            batches += 1
+        if batches == 0:  # fewer rows than one batch: single partial run
+            pad_rows = np.resize(np.arange(len(seqs)), B)
+            xb = jnp.asarray(seqs[pad_rows])
+            if batch_sharding is not None:
+                xb = jax.device_put(xb, batch_sharding)
+            key, sub = jax.random.split(key)
+            w, opt_m, opt_v, step, loss = _train_step(
+                w, opt_m, opt_v, step, xb, sub, params, n_items)
+            total, batches = float(loss), 1
+        losses.append(total / batches)
+    return SeqRecModel(weights=w, n_items=n_items, item_ids=item_ids,
+                       params=params, events=events,
+                       app_name=app_name), losses
+
+
+@functools.partial(jax.jit, static_argnames=("p", "k"))
+def _recommend_jit(w, seq, p: SeqRecParams, k: int):
+    ctx = _encode(w, seq, p)[:, -1]          # [B, d] last position
+    scores = ctx @ w["item_emb"][:-1].T       # exclude the pad row
+    return jax.lax.top_k(scores, k)
+
+
+def recommend_next(model: SeqRecModel, history: Sequence[int], k: int = 10
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k next items for one item-id history (most recent last)."""
+    p = model.params
+    seq = np.full((1, p.max_len), -1, dtype=np.int32)
+    h = list(history)[-p.max_len:]
+    if h:
+        seq[0, -len(h):] = h
+    scores, ids = _recommend_jit(model.weights, jnp.asarray(seq), p,
+                                 min(k, model.n_items))
+    return np.asarray(ids[0]), np.asarray(scores[0])

@@ -132,7 +132,10 @@ def _compiled(mesh_key, mesh, axis: str, causal: bool, scale: float):
     """Cached jitted entry per (mesh, axis, causal, scale) — a fresh
     jax.jit per call would re-trace every invocation (~200x the cost of
     the cached dispatch; same convention as models/als.py)."""
-    key = (mesh_key, axis, causal, scale)
+    # the MESH itself (hashable) keys the cache: two meshes over the
+    # same devices with different axis layouts must not collide
+    key = (mesh_key, None if mesh is None else mesh, axis, causal,
+           scale)
     fn = _fn_cache.get(key)
     if fn is None:
         if mesh is None:
@@ -149,16 +152,26 @@ def _compiled(mesh_key, mesh, axis: str, causal: bool, scale: float):
     return fn
 
 
-def _ring_attention_local_nodist(q, k, v, *, causal: bool, scale: float):
+def _ring_attention_local_nodist(q, k, v, *, causal: bool, scale: float,
+                                 key_valid=None):
     """Single-device reference/fallback: dense softmax attention with
-    the same masking and dtype conventions."""
+    the same masking and dtype conventions. ``key_valid`` ([B, Sk]
+    bool) additionally masks key positions (padding slots in
+    right-aligned sequence-model windows); fully-masked query rows
+    return 0, never NaN."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         S = q.shape[1]
         mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
         s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+    if key_valid is not None:
+        s = jnp.where(key_valid[:, None, None, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isinf(m), 0.0, m)  # all-masked rows
+    p = jnp.exp(s - m)
+    denom = p.sum(axis=-1, keepdims=True)
+    p = jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
                       preferred_element_type=jnp.float32
                       ).astype(q.dtype)

@@ -1,0 +1,193 @@
+"""Sequential-recommendation template: next-item prediction from
+chronological item histories via causal self-attention
+(``models/seqrec.py``) — a model family BEYOND the reference's inventory
+(it has no sequence models), expressed in the same DASE shape as every
+shipped template so the whole lifecycle (train/deploy/eval/
+batchpredict) applies unchanged.
+
+Query: ``{"user": "u1", "num": 10}`` (recent history read from the
+event store at serving time — the e-commerce template's realtime-lookup
+pattern) or ``{"items": ["i3", "i9"], "num": 10}`` for an explicit
+session history. Known items in the history are excluded from results.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..controller import (
+    Context,
+    DataSource,
+    IdentityPreparator,
+    FirstServing,
+    Algorithm,
+    Engine,
+    SanityCheck,
+)
+from ..data.bimap import BiMap
+from ..models.data import ratings_from_columnar
+from ..models.seqrec import (
+    SeqRecModel,
+    SeqRecParams,
+    recommend_next,
+    sequences_from_ratings,
+    train_seqrec,
+)
+
+
+@dataclass(frozen=True)
+class Query:
+    user: Optional[str] = None
+    items: Optional[Tuple[str, ...]] = None
+    num: int = 10
+
+    def __post_init__(self):
+        if self.items is not None:
+            object.__setattr__(self, "items", tuple(self.items))
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def to_json(self) -> dict:
+        return {"itemScores": [{"item": s.item, "score": s.score}
+                               for s in self.item_scores]}
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    sequences: np.ndarray      # [n_users, max_len] int32, -1 padded
+    item_ids: BiMap
+    n_items: int
+    events: Tuple[str, ...] = ()
+    app_name: str = ""
+
+    def sanity_check(self):
+        if (self.sequences >= 0).sum() == 0:
+            raise ValueError("no interaction events found")
+
+
+@dataclass(frozen=True)
+class DataSourceParams:
+    app_name: str = ""
+    #: events forming the sequence, in preference order
+    events: Tuple[str, ...] = ("view", "rate", "buy")
+    max_len: int = 50
+
+
+class SequentialDataSource(DataSource):
+    """Chronological per-user item sequences from the columnar bulk
+    read (no per-event Python objects on the training path)."""
+
+    def __init__(self, params: DataSourceParams = DataSourceParams()):
+        self.params = params
+
+    def read_training(self, ctx: Context) -> TrainingData:
+        app = self.params.app_name or ctx.app_name
+        batch = ctx.event_store.find_columnar(
+            app, entity_type="user", target_entity_type="item",
+            event_names=list(self.params.events), ordered=False,
+            with_props=False)
+        coo, user_ids, item_ids = ratings_from_columnar(
+            batch, event_weights={e: 1.0 for e in self.params.events})
+        sel_times = self._times_for(batch, coo)
+        seqs = sequences_from_ratings(coo.users, coo.items, sel_times,
+                                      coo.n_users, self.params.max_len)
+        return TrainingData(sequences=seqs, item_ids=item_ids,
+                            n_items=coo.n_items,
+                            events=tuple(self.params.events),
+                            app_name=app)
+
+    @staticmethod
+    def _times_for(batch, coo) -> np.ndarray:
+        """Event times aligned to the COO entries: the batch holds only
+        the requested event names (filter pushdown) with fixed weights,
+        so ratings_from_columnar's selection is exactly target>=0."""
+        times = np.asarray(batch.event_time)[
+            np.asarray(batch.target_id) >= 0]
+        assert len(times) == len(coo.users), (len(times), len(coo.users))
+        return times
+
+
+class SeqRecAlgorithm(Algorithm):
+    """DASE wrapper over :func:`train_seqrec`."""
+
+    query_class = Query
+
+    def __init__(self, params: SeqRecParams = SeqRecParams()):
+        self.params = params
+
+    def train(self, ctx: Context, td: TrainingData) -> SeqRecModel:
+        model, losses = train_seqrec(td.sequences, td.n_items,
+                                     self.params, mesh=ctx.mesh,
+                                     item_ids=td.item_ids,
+                                     events=td.events,
+                                     app_name=td.app_name)
+        return model
+
+    # serving-time history lookup (the e-commerce realtime pattern)
+    def bind_serving(self, ctx: Context) -> None:
+        self._serving_store = ctx.event_store
+        self._app_name = ctx.app_name
+
+    def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
+        ids: BiMap = model.item_ids
+        history: list = []
+        if query.items:
+            history = [ids[i] for i in query.items if i in ids]
+        elif query.user:
+            store = getattr(self, "_serving_store", None)
+            if store is None:
+                from ..data.store import event_store as store  # noqa: F811
+            try:
+                evs = store.find_by_entity(
+                    model.app_name
+                    or getattr(self, "_app_name", "") or "", "user",
+                    query.user, target_entity_type="item",
+                    event_names=(list(model.events)
+                                 if model.events else None),
+                    limit=model.params.max_len, latest=True,
+                    timeout_ms=200)
+            except Exception:  # noqa: BLE001 — serving never hard-fails
+                evs = []
+            # latest-first → chronological
+            history = [ids[e.target_entity_id] for e in reversed(evs)
+                       if e.target_entity_id in ids]
+        if not history:
+            return PredictedResult()
+        known = set(history)
+        idx, scores = recommend_next(model, history,
+                                     k=query.num + len(known))
+        inv = ids.inverse
+        out = [(int(i), float(s)) for i, s in zip(idx, scores)
+               if int(i) not in known][: query.num]
+        return PredictedResult(tuple(
+            ItemScore(item=inv[i], score=s) for i, s in out))
+
+
+class SequentialServing(FirstServing):
+    pass
+
+
+def sequential_engine() -> Engine:
+    """Engine factory."""
+    return Engine(
+        datasource_classes=SequentialDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"seqrec": SeqRecAlgorithm,
+                           "": SeqRecAlgorithm},
+        serving_classes=SequentialServing,
+        datasource_params_class=DataSourceParams,
+        algorithm_params_classes={"seqrec": SeqRecParams,
+                                  "": SeqRecParams},
+    )
