@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.ring_attention import _ring_attention_local_nodist
+from ..ops.ring_attention import ring_attention
 
 
 @dataclass(frozen=True)
@@ -129,14 +129,15 @@ def _encode(w: Dict[str, jax.Array], seq: jax.Array, p: SeqRecParams
     qkv = h @ w["qkv"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shp = (B, L, H, d // H)
-    # the shared blockwise-softmax attention kernel (ring-capable at pod
-    # scale; single-device blockwise here — L is the history window).
-    # key_valid masks the left-pad slots: without it, real positions
-    # attend to (learned) pad keys and scores drift with pad count —
-    # the classic SASRec padding bug.
-    attn = _ring_attention_local_nodist(
-        q.reshape(shp), k.reshape(shp), v.reshape(shp), causal=True,
-        scale=(d // H) ** -0.5, key_valid=~pad).reshape(B, L, d)
+    # the shared attention kernel via its PUBLIC API (ring-capable at
+    # pod scale; mesh=None here — L is the history window). key_valid
+    # masks the left-pad slots: without it, real positions attend to
+    # (learned) pad keys and scores drift with pad count — the classic
+    # SASRec padding bug.
+    attn = ring_attention(
+        q.reshape(shp), k.reshape(shp), v.reshape(shp), mesh=None,
+        causal=True, scale=(d // H) ** -0.5,
+        key_valid=~pad).reshape(B, L, d)
     x = x + jnp.where(pad[..., None], 0.0, attn @ w["attn_out"])
 
     h = _layer_norm(x, w["ln2"], w["ln2b"])
@@ -222,7 +223,8 @@ def train_seqrec(sequences: np.ndarray, n_items: int,
         else NamedSharding(mesh, P(("data", "model")))
     for epoch in range(params.num_epochs):
         order = rng.permutation(len(seqs))
-        total, batches = 0.0, 0
+        epoch_losses: list = []
+        batches = 0
         for s in range(0, len(seqs) - B + 1, B):
             rows = order[s:s + B]
             batch = seqs[rows]
@@ -231,7 +233,7 @@ def train_seqrec(sequences: np.ndarray, n_items: int,
             key, sub = jax.random.split(key)
             w, opt_m, opt_v, step, loss = _train_step(
                 w, opt_m, opt_v, step, xb, sub, params, n_items)
-            total += float(loss)
+            epoch_losses.append(loss)  # device scalar: no per-step sync
             batches += 1
         if batches == 0:  # fewer rows than one batch: single partial run
             pad_rows = np.resize(np.arange(len(seqs)), B)
@@ -241,8 +243,10 @@ def train_seqrec(sequences: np.ndarray, n_items: int,
             key, sub = jax.random.split(key)
             w, opt_m, opt_v, step, loss = _train_step(
                 w, opt_m, opt_v, step, xb, sub, params, n_items)
-            total, batches = float(loss), 1
-        losses.append(total / batches)
+            epoch_losses, batches = [loss], 1
+        # ONE host sync per epoch (a float() per step would serialize
+        # host batch prep against device compute)
+        losses.append(float(jnp.mean(jnp.stack(epoch_losses))))
     return SeqRecModel(weights=w, n_items=n_items, item_ids=item_ids,
                        params=params, events=events,
                        app_name=app_name), losses

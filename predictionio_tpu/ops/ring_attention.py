@@ -28,10 +28,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          scale: float):
+def _ring_attention_local(q, k, v, kmask, *, axis_name: str,
+                          causal: bool, scale: float):
     """Per-device body under shard_map. q/k/v: [B, S_loc, H, D] (this
-    device's sequence chunk); returns the local output chunk."""
+    device's sequence chunk); kmask: [B, S_loc] bool key-validity (all
+    True when no padding) — it rotates around the ring WITH its k/v
+    block. Returns the local output chunk."""
     n_dev = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S_loc, H, D = q.shape
@@ -53,7 +55,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     acc0 = _vary(jnp.zeros((B, S_loc, H, D), jnp.float32))
 
     def step(j, carry):
-        k_blk, v_blk, m, l, acc = carry
+        k_blk, v_blk, km_blk, m, l, acc = carry
         # rotate at the START for steps > 0: n_dev blocks need only
         # n_dev-1 rotations, and a trailing rotation would pay one
         # discarded ICI hop per block per call. The predicate is the
@@ -62,11 +64,11 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
         def rotate(kv):
-            return (jax.lax.ppermute(kv[0], axis_name, perm),
-                    jax.lax.ppermute(kv[1], axis_name, perm))
+            return tuple(jax.lax.ppermute(x, axis_name, perm)
+                         for x in kv)
 
-        k_blk, v_blk = jax.lax.cond(j > 0, rotate, lambda kv: kv,
-                                    (k_blk, v_blk))
+        k_blk, v_blk, km_blk = jax.lax.cond(
+            j > 0, rotate, lambda kv: kv, (k_blk, v_blk, km_blk))
         # after j rotations this device holds the KV block originally
         # owned by device (idx - j) mod n_dev
         kv_owner = (idx - j) % n_dev
@@ -78,6 +80,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         if causal:
             mask = q_pos[:, None] >= kv_pos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
+        s = jnp.where(km_blk[:, None, None, :], s, -jnp.inf)
 
         # streaming softmax: fold this block into (m, l, acc)
         blk_max = jnp.max(s, axis=-1)
@@ -92,10 +95,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                         v_blk.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
         acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
-        return k_blk, v_blk, m_new, l_new, acc_new
+        return k_blk, v_blk, km_blk, m_new, l_new, acc_new
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n_dev, step,
-                                        (k, v, m0, l0, acc0))
+    _, _, _, m, l, acc = jax.lax.fori_loop(0, n_dev, step,
+                                           (k, v, kmask, m0, l0, acc0))
     # fully-masked rows (can't happen for causal self-attention, where
     # position t always sees itself) would have l=0; keep them 0, not NaN
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
@@ -105,49 +108,59 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: Optional[Mesh] = None, axis: str = "data",
                    causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   key_valid: Optional[jax.Array] = None) -> jax.Array:
     """Sequence-parallel multi-head attention.
 
     q/k/v: ``[batch, seq, heads, head_dim]`` with the sequence axis
     sharded over ``mesh`` axis ``axis`` (``seq`` must divide evenly by
-    that axis size). Returns attention output with the same sharding.
-    With ``mesh=None`` this is plain (single-device) blockwise
-    attention — the same code path, ring of length 1.
+    that axis size). ``key_valid`` ([batch, seq] bool) masks key
+    positions — padding slots in right-aligned sequence-model windows —
+    on BOTH paths (the mask rotates around the ring with its KV block).
+    Returns attention output with the same sharding. With ``mesh=None``
+    this is plain (single-device) blockwise attention — the same
+    contract, ring of length 1.
     """
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    fn = _compiled(None if mesh is None else tuple(mesh.devices.flat),
-                   mesh, axis, causal, scale)
+    fn = _compiled(mesh, axis, causal, scale)
+    if key_valid is None:
+        key_valid = jnp.ones(q.shape[:2], bool)
     if mesh is None:
-        return fn(q, k, v)
+        return fn(q, k, v, key_valid)
     sharding = NamedSharding(mesh, P(None, axis, None, None))
+    km_sharding = NamedSharding(mesh, P(None, axis))
     return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
-              jax.device_put(v, sharding))
+              jax.device_put(v, sharding),
+              jax.device_put(key_valid, km_sharding))
 
 
 _fn_cache: dict = {}
 
 
-def _compiled(mesh_key, mesh, axis: str, causal: bool, scale: float):
+def _compiled(mesh, axis: str, causal: bool, scale: float):
     """Cached jitted entry per (mesh, axis, causal, scale) — a fresh
     jax.jit per call would re-trace every invocation (~200x the cost of
-    the cached dispatch; same convention as models/als.py)."""
-    # the MESH itself (hashable) keys the cache: two meshes over the
-    # same devices with different axis layouts must not collide
-    key = (mesh_key, None if mesh is None else mesh, axis, causal,
-           scale)
+    the cached dispatch; same convention as models/als.py). The Mesh
+    itself keys the cache (hashable, value-compared over devices AND
+    axis layout)."""
+    key = (mesh, axis, causal, scale)
     fn = _fn_cache.get(key)
     if fn is None:
         if mesh is None:
-            fn = jax.jit(functools.partial(
-                _ring_attention_local_nodist, causal=causal,
-                scale=scale))
+            def nodist(q, k, v, key_valid):
+                return _ring_attention_local_nodist(
+                    q, k, v, causal=causal, scale=scale,
+                    key_valid=key_valid)
+            fn = jax.jit(nodist)
         else:
             spec = P(None, axis, None, None)
+            km_spec = P(None, axis)
             fn = jax.jit(jax.shard_map(
                 functools.partial(_ring_attention_local, axis_name=axis,
                                   causal=causal, scale=scale),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+                mesh=mesh, in_specs=(spec, spec, spec, km_spec),
+                out_specs=spec))
         _fn_cache[key] = fn
     return fn
 

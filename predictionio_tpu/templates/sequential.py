@@ -27,6 +27,7 @@ from ..controller import (
     Engine,
     SanityCheck,
 )
+from ..controller.metric import AverageMetric, ndcg_at_k
 from ..data.bimap import BiMap
 from ..models.data import ratings_from_columnar
 from ..models.seqrec import (
@@ -43,6 +44,11 @@ class Query:
     user: Optional[str] = None
     items: Optional[Tuple[str, ...]] = None
     num: int = 10
+    #: exclude history items from results (serving default). Eval turns
+    #: it off: leave-one-out targets may legitimately REPEAT an item
+    #: from the prefix, and an unconditional filter would score every
+    #: repeat-consumption user 0 regardless of model quality.
+    exclude_known: bool = True
 
     def __post_init__(self):
         if self.items is not None:
@@ -83,6 +89,19 @@ class DataSourceParams:
     #: events forming the sequence, in preference order
     events: Tuple[str, ...] = ("view", "rate", "buy")
     max_len: int = 50
+    #: top-N requested by eval queries
+    eval_query_num: int = 10
+
+
+@dataclass(frozen=True)
+class EvalInfo:
+    n_users: int = 0
+
+
+@dataclass(frozen=True)
+class ActualResult:
+    #: the held-out NEXT item (leave-one-out)
+    item: str = ""
 
 
 class SequentialDataSource(DataSource):
@@ -108,6 +127,33 @@ class SequentialDataSource(DataSource):
                             events=tuple(self.params.events),
                             app_name=app)
 
+    def read_eval(self, ctx: Context):
+        """Leave-one-out: per user with ≥3 interactions, hold out the
+        LAST item; the query carries the prefix explicitly (eval is
+        storage-independent), the actual is the held-out next item —
+        the standard sequential-recommendation protocol."""
+        td = self.read_training(ctx)
+        inv = td.item_ids.inverse
+        train = td.sequences.copy()
+        qa = []
+        for row in range(len(train)):
+            real = train[row][train[row] >= 0]
+            if len(real) < 3:
+                continue
+            target = int(real[-1])
+            prefix = [int(x) for x in real[:-1]]
+            # drop the held-out item from the training window
+            train[row, :] = -1
+            train[row, -len(prefix):] = prefix
+            qa.append((Query(items=tuple(inv[i] for i in prefix),
+                             num=self.params.eval_query_num,
+                             exclude_known=False),
+                       ActualResult(item=inv[target])))
+        td_train = TrainingData(sequences=train, item_ids=td.item_ids,
+                                n_items=td.n_items, events=td.events,
+                                app_name=td.app_name)
+        return [(td_train, EvalInfo(n_users=len(qa)), qa)]
+
     @staticmethod
     def _times_for(batch, coo) -> np.ndarray:
         """Event times aligned to the COO entries: the batch holds only
@@ -117,6 +163,39 @@ class SequentialDataSource(DataSource):
             np.asarray(batch.target_id) >= 0]
         assert len(times) == len(coo.users), (len(times), len(coo.users))
         return times
+
+
+class HitRateAtK(AverageMetric):
+    """Fraction of users whose held-out next item appears in the top-k
+    (the standard leave-one-out sequential-rec metric)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"HitRate@{self.k}"
+
+    def calculate_point(self, ei, q: Query, p: PredictedResult,
+                        a: ActualResult):
+        top = [s.item for s in p.item_scores[: self.k]]
+        return 1.0 if a.item in top else 0.0
+
+
+class SeqNDCGAtK(AverageMetric):
+    """Binary NDCG@k of the single held-out next item."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"SeqNDCG@{self.k}"
+
+    def calculate_point(self, ei, q: Query, p: PredictedResult,
+                        a: ActualResult):
+        return ndcg_at_k([s.item for s in p.item_scores], {a.item},
+                         self.k) or 0.0
 
 
 class SeqRecAlgorithm(Algorithm):
@@ -165,7 +244,7 @@ class SeqRecAlgorithm(Algorithm):
                        if e.target_entity_id in ids]
         if not history:
             return PredictedResult()
-        known = set(history)
+        known = set(history) if query.exclude_known else set()
         idx, scores = recommend_next(model, history,
                                      k=query.num + len(known))
         inv = ids.inverse

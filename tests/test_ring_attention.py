@@ -73,3 +73,29 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(out, dtype=np.float64), ref, rtol=0.05,
             atol=0.05)
+
+    def test_key_valid_mask_matches_dense_on_mesh(self, mesh8):
+        """Padding-key masking through the PUBLIC API: the mask rotates
+        around the ring with its KV block and must equal dense
+        attention over only the valid keys."""
+        q, k, v = _qkv(B=2, S=64, H=2, D=8, seed=11)
+        rng = np.random.default_rng(12)
+        key_valid = rng.random((2, 64)) > 0.3
+        out = ring_attention(jnp.asarray(q), jnp.asarray(k),
+                             jnp.asarray(v), mesh=mesh8, causal=True,
+                             key_valid=jnp.asarray(key_valid))
+        # dense reference with the same key mask
+        scale = q.shape[-1] ** -0.5
+        s = np.einsum("bqhd,bkhd->bhqk", q, k).astype(np.float64) * scale
+        S = q.shape[1]
+        cmask = np.arange(S)[:, None] >= np.arange(S)[None, :]
+        s = np.where(cmask[None, None], s, -np.inf)
+        s = np.where(key_valid[:, None, None, :], s, -np.inf)
+        m = s.max(axis=-1, keepdims=True)
+        m = np.where(np.isinf(m), 0.0, m)
+        p = np.exp(s - m)
+        denom = p.sum(-1, keepdims=True)
+        p = np.where(denom > 0, p / np.maximum(denom, 1e-30), 0.0)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float64))
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=3e-5, atol=3e-5)
