@@ -338,35 +338,67 @@ def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
         block_rows_opt=params.block_rows, gram=params.gram_mode)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("implicit", "scale_reg",
-                                    "bf16", "block_rows_opt", "nu", "ni",
-                                    "shard_u", "shard_i", "gram"))
-def _train_bucket_fused(U: jax.Array, V: jax.Array, ub, ib, reg, alpha,
-                        iters, *, implicit: bool, scale_reg: bool,
-                        bf16: bool, block_rows_opt, nu: int, ni: int,
-                        shard_u, shard_i, gram: str = "auto"
-                        ) -> Tuple[jax.Array, jax.Array]:
-    """The WHOLE training run as one compiled program (bucket layouts,
-    no checkpointing): through a remote-device tunnel, per-dispatch
-    latency rivals a full half-iteration of compute, so 2·iters
-    dispatches cost more than the math. ``iters`` is traced (a sweep
-    over iteration counts shares one compilation). ``shard_*`` are
-    NamedShardings (hashable, static) constraining each half-step's
-    scatter target on a mesh; None on a single device."""
+def _pad_half_impl(fixed: jax.Array, lay: dict, block: int, reg, alpha,
+                   implicit: bool, scale_reg: bool, bf16: bool,
+                   gram: str) -> jax.Array:
+    """One pad-layout half-iteration (trace-level body): Gramian, row
+    blocks through :func:`_update_block`, flat reshape. SHARED by the
+    per-step path (:func:`_update_side`) and the fused whole-run
+    trainer — the two must never diverge."""
+    G = gramian(fixed) if implicit else None
+    d, n_per, L = lay["idx"].shape
+    parts = []
+    for st in range(0, n_per, block):
+        e = min(st + block, n_per)
+        parts.append(_update_block(
+            fixed, G, lay["idx"][:, st:e], lay["val"][:, st:e],
+            lay["cnt"][:, st:e], reg, alpha, implicit, scale_reg,
+            bf16=bf16, gram=gram))
+    out = parts[0] if len(parts) == 1 \
+        else jnp.concatenate(parts, axis=1)
+    return out.reshape(d * n_per, out.shape[-1])
 
-    def half(fixed, buckets, n_total, shard):
-        out0 = jnp.zeros((n_total, fixed.shape[-1]), fixed.dtype)
+
+@functools.partial(jax.jit,
+                   static_argnames=("implicit", "scale_reg", "bf16",
+                                    "gram", "kind_u", "kind_i",
+                                    "block_u", "block_i",
+                                    "block_rows_opt", "nu", "ni",
+                                    "shard_u", "shard_i"))
+def _train_fused(U: jax.Array, V: jax.Array, lay_u, lay_i, reg, alpha,
+                 iters, *, implicit: bool, scale_reg: bool, bf16: bool,
+                 gram: str, kind_u: str, kind_i: str, block_u: int,
+                 block_i: int, block_rows_opt, nu: int, ni: int,
+                 shard_u, shard_i) -> Tuple[jax.Array, jax.Array]:
+    """The WHOLE training run as ONE compiled program (no
+    checkpointing): through a remote-device tunnel, per-dispatch latency
+    rivals a full half-iteration of compute, so 2·iters·blocks
+    dispatches cost more than the math. Each side's half-step is chosen
+    STATICALLY by its layout kind ("pad" or "bucket" — mixed sides are a
+    normal history_mode='auto' outcome on skewed data), both realized by
+    the same impls the per-step path uses. ``iters`` stays traced (a
+    sweep over iteration counts shares one compilation); ``shard_*`` are
+    NamedShardings (hashable, static) constraining each half-step's
+    output on a mesh."""
+
+    def half(fixed, kind, lay, block, n_total, shard):
+        if kind == "bucket":
+            out0 = jnp.zeros((n_total, fixed.shape[-1]), fixed.dtype)
+            if shard is not None:
+                out0 = jax.lax.with_sharding_constraint(out0, shard)
+            return _bucket_half_impl(fixed, out0, lay, reg, alpha,
+                                     implicit, scale_reg, bf16,
+                                     block_rows_opt, gram)
+        out = _pad_half_impl(fixed, lay, block, reg, alpha, implicit,
+                             scale_reg, bf16, gram)
         if shard is not None:
-            out0 = jax.lax.with_sharding_constraint(out0, shard)
-        return _bucket_half_impl(fixed, out0, buckets, reg, alpha,
-                                 implicit, scale_reg, bf16,
-                                 block_rows_opt, gram)
+            out = jax.lax.with_sharding_constraint(out, shard)
+        return out
 
     def body(_, UV):
         U, V = UV
-        U = half(V, ub, nu, shard_u)
-        V = half(U, ib, ni, shard_i)
+        U = half(V, kind_u, lay_u, block_u, nu, shard_u)
+        V = half(U, kind_i, lay_i, block_i, ni, shard_i)
         return U, V
 
     # fori_loop, not Python unrolling: program size must not scale with
@@ -379,21 +411,14 @@ def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
                  counts: jax.Array, params: "ALSParams",
                  block_rows: int) -> jax.Array:
     """One half-iteration, row-blocked to bound the [B, L, r] gather's
-    memory (ALX-style batched updates). Inputs are in the blocked layout
-    [d, rows_per_shard, ...]; returns flat [d*rows_per_shard, r]."""
-    G = _gramian_jit(fixed) if params.implicit_prefs else None
-    d, n_per, L = indices.shape
-    blocks = []
-    for s in range(0, n_per, block_rows):
-        e = min(s + block_rows, n_per)
-        blocks.append(_update_block(
-            fixed, G, indices[:, s:e], values[:, s:e], counts[:, s:e],
-            params.reg, params.alpha, params.implicit_prefs,
-            params.scale_reg_by_count,
-            bf16=(params.matmul_dtype == "bfloat16"),
-            gram=params.gram_mode))
-    out = blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=1)
-    return out.reshape(d * n_per, out.shape[-1])
+    memory (ALX-style batched updates); the per-step twin of the fused
+    trainer — both route through :func:`_pad_half_impl`."""
+    return _pad_half_impl(
+        fixed, {"idx": indices, "val": values, "cnt": counts},
+        block_rows, params.reg, params.alpha, params.implicit_prefs,
+        params.scale_reg_by_count,
+        bf16=(params.matmul_dtype == "bfloat16"),
+        gram=params.gram_mode)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "n_padded", "rank"))
@@ -1036,22 +1061,6 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     uh = packed.blocked("user", n_dev, mesh)
     ih = packed.blocked("item", n_dev, mesh)
 
-    def _stepper(h, layout):
-        if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):
-            return lambda fixed: _update_side_bucket(fixed, layout, params)
-        n_r = h.n_virtual if isinstance(h, SplitHistories) else h.n_rows
-        blk = params.block_rows or _auto_block_rows(
-            n_r // n_dev, h.max_len, params.rank)
-        if isinstance(h, SplitHistories):
-            return lambda fixed: _update_side_split(fixed, layout, params,
-                                                    blk)
-        return lambda fixed: _update_side(
-            fixed, layout["idx"], layout["val"], layout["cnt"], params,
-            blk)
-
-    step_u = _stepper(user_h, uh)
-    step_i = _stepper(item_h, ih)
-
     ckpt = None
     start = 0
     fingerprint = ""
@@ -1114,22 +1123,55 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             V = _shard(state["V"], mesh, ROWS)
             start = int(latest)
 
-    both_bucket = isinstance(
-        user_h, (BucketedHistories, _LayoutOnlyBucketed)) \
-        and isinstance(item_h, (BucketedHistories, _LayoutOnlyBucketed))
-    if ckpt is None and both_bucket and start < params.num_iterations:
+    def _kind(h) -> str:
+        if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):
+            return "bucket"
+        if isinstance(h, (PaddedHistories, _LayoutOnlyHistories)):
+            return "pad"
+        return "split"
+
+    kind_u, kind_i = _kind(user_h), _kind(item_h)
+    if ckpt is None and "split" not in (kind_u, kind_i) \
+            and start < params.num_iterations:
+        # checkpoint-free runs compile the WHOLE training loop into one
+        # dispatch, whatever mix of pad/bucket layouts auto resolved to
         shard = None if mesh is None else NamedSharding(mesh, ROWS)
-        return _train_bucket_fused(
-            U, V, tuple(uh["buckets"]), tuple(ih["buckets"]),
-            params.reg, params.alpha,
+
+        def _fused_args(kind, h, lay):
+            if kind == "bucket":
+                return tuple(lay["buckets"]), 0
+            return lay, params.block_rows or _auto_block_rows(
+                h.n_rows // n_dev, h.max_len, params.rank)
+
+        lay_u, block_u = _fused_args(kind_u, user_h, uh)
+        lay_i, block_i = _fused_args(kind_i, item_h, ih)
+        return _train_fused(
+            U, V, lay_u, lay_i, params.reg, params.alpha,
             params.num_iterations - start,
             implicit=params.implicit_prefs,
             scale_reg=params.scale_reg_by_count,
             bf16=(params.matmul_dtype == "bfloat16"),
+            gram=params.gram_mode, kind_u=kind_u, kind_i=kind_i,
+            block_u=block_u, block_i=block_i,
             block_rows_opt=params.block_rows,
             nu=u_rows_pad, ni=i_rows_pad,
-            shard_u=shard, shard_i=shard,
-            gram=params.gram_mode)
+            shard_u=shard, shard_i=shard)
+
+    def _stepper(h, layout):
+        if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):
+            return lambda fixed: _update_side_bucket(fixed, layout, params)
+        n_r = h.n_virtual if isinstance(h, SplitHistories) else h.n_rows
+        blk = params.block_rows or _auto_block_rows(
+            n_r // n_dev, h.max_len, params.rank)
+        if isinstance(h, SplitHistories):
+            return lambda fixed: _update_side_split(fixed, layout, params,
+                                                    blk)
+        return lambda fixed: _update_side(
+            fixed, layout["idx"], layout["val"], layout["cnt"], params,
+            blk)
+
+    step_u = _stepper(user_h, uh)
+    step_i = _stepper(item_h, ih)
 
     try:
         for it in range(start, params.num_iterations):

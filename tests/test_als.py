@@ -715,3 +715,76 @@ class TestColumnarRatingsSource:
         assert sorted(zip(coo.users, coo.items, coo.ratings)) == \
             sorted(zip(ref.users, ref.items, ref.ratings))
         assert len(coo.users) == 4  # 2 rate + 2 buy; view + NaN-rate drop
+
+
+class TestPadFusedTrainer:
+    """The fused whole-run pad program must match the per-step path."""
+
+    def _coo(self):
+        rng = np.random.default_rng(6)
+        return RatingsCOO(rng.integers(0, 40, 800).astype(np.int32),
+                          rng.integers(0, 25, 800).astype(np.int32),
+                          (rng.random(800) * 4 + 1).astype(np.float32),
+                          40, 25)
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_fused_matches_stepwise(self, tmp_path, implicit):
+        coo = self._coo()
+        params = ALSParams(rank=6, num_iterations=3, seed=4,
+                           history_mode="pad",
+                           implicit_prefs=implicit, alpha=8.0)
+        U1, V1 = train_als(coo, params)  # fused (no checkpointing)
+        # checkpoint_dir forces the per-step path. Same math and order,
+        # but the fused program inlines the Gramian into one XLA
+        # computation whose fusion reassociates f32 reductions — a few
+        # 1e-4-rel ulps of drift per iteration is expected, bitwise
+        # equality is not.
+        U2, V2 = train_als(coo, params,
+                           checkpoint_dir=str(tmp_path / "ck"),
+                           checkpoint_every=100)
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U2),
+                                   rtol=2e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(V1), np.asarray(V2),
+                                   rtol=2e-3, atol=1e-5)
+
+    def test_fused_on_mesh(self, mesh8):
+        coo = self._coo()
+        params = ALSParams(rank=6, num_iterations=3, seed=4,
+                           history_mode="pad")
+        U1, V1 = train_als(coo, params)
+        U8, V8 = train_als(coo, params, mesh=mesh8)
+        np.testing.assert_allclose(np.asarray(U8), np.asarray(U1),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_mixed_pad_bucket_fused(self):
+        """history_mode='auto' can resolve pad on one side and bucket on
+        the other (per-side skew); the unified fused trainer must handle
+        the mix and agree with the uniform layouts."""
+        from predictionio_tpu.models.als import PackedRatings, pack_ratings
+        from predictionio_tpu.ops.ragged import (
+            pack_histories_bucketed_device,
+            pack_histories_device,
+        )
+
+        coo = self._coo()
+        params = ALSParams(rank=6, num_iterations=3, seed=4,
+                           implicit_prefs=True, alpha=8.0)
+        counts_u = np.bincount(coo.users, minlength=coo.n_users)
+        user_h = pack_histories_device(
+            coo.users, coo.items, coo.ratings, coo.n_users,
+            max_len=int(counts_u.max()), pad_rows_to=1)
+        item_h = pack_histories_bucketed_device(
+            coo.items, coo.users, coo.ratings, coo.n_items,
+            pad_rows_to=1)
+        mixed = PackedRatings(user_h=user_h, item_h=item_h, mesh=None,
+                              n_users=coo.n_users, n_items=coo.n_items)
+        Um, Vm = train_als(coo, params, packed=mixed)
+        import dataclasses
+        Ub, Vb = train_als(coo, dataclasses.replace(
+            params, history_mode="bucket"))
+        np.testing.assert_allclose(np.asarray(Um)[:coo.n_users],
+                                   np.asarray(Ub)[:coo.n_users],
+                                   rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(Vm)[:coo.n_items],
+                                   np.asarray(Vb)[:coo.n_items],
+                                   rtol=2e-3, atol=2e-4)
