@@ -262,11 +262,39 @@ def _recommend_jit(w, seq, p: SeqRecParams, k: int):
 def recommend_next(model: SeqRecModel, history: Sequence[int], k: int = 10
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k next items for one item-id history (most recent last)."""
+    ids, scores = recommend_next_batch(model, [history], k)
+    return ids[0], scores[0]
+
+
+def _pow2_at_least(n: int, cap: int) -> int:
+    v = 1
+    while v < n:
+        v <<= 1
+    return min(v, cap)
+
+
+def recommend_next_batch(model: SeqRecModel,
+                         histories: Sequence[Sequence[int]], k: int = 10
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k next items for MANY histories in one device dispatch (the
+    batch-predict / serving micro-batcher hot path). Returns
+    (ids [B, k], scores [B, k]).
+
+    The compiled kernel runs with batch AND k rounded up to powers of
+    two (clamped to the catalog) so arbitrary serving batches reuse
+    O(log²) compilations instead of re-tracing per (B, k) pair — the
+    same jit-cache-bounding convention as the ALS serving path."""
     p = model.params
-    seq = np.full((1, p.max_len), -1, dtype=np.int32)
-    h = list(history)[-p.max_len:]
-    if h:
-        seq[0, -len(h):] = h
+    B = len(histories)
+    k_req = min(k, model.n_items)
+    B_pad = _pow2_at_least(max(B, 1), 1 << 16)
+    k_pad = _pow2_at_least(max(k_req, 1), model.n_items)
+    seq = np.full((B_pad, p.max_len), -1, dtype=np.int32)
+    for row, history in enumerate(histories):
+        h = list(history)[-p.max_len:]
+        if h:
+            seq[row, -len(h):] = h
     scores, ids = _recommend_jit(model.weights, jnp.asarray(seq), p,
-                                 min(k, model.n_items))
-    return np.asarray(ids[0]), np.asarray(scores[0])
+                                 k_pad)
+    return (np.asarray(ids)[:B, :k_req],
+            np.asarray(scores)[:B, :k_req])

@@ -14,7 +14,7 @@ session history. Known items in the history are excluded from results.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -33,7 +33,7 @@ from ..models.data import ratings_from_columnar
 from ..models.seqrec import (
     SeqRecModel,
     SeqRecParams,
-    recommend_next,
+    recommend_next_batch,
     sequences_from_ratings,
     train_seqrec,
 )
@@ -219,7 +219,9 @@ class SeqRecAlgorithm(Algorithm):
         self._serving_store = ctx.event_store
         self._app_name = ctx.app_name
 
-    def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
+    def _history_for(self, model: SeqRecModel, query: Query) -> list:
+        """Resolve one query's item-index history (explicit session
+        items, or a serving-time event-store read for user queries)."""
         ids: BiMap = model.item_ids
         history: list = []
         if query.items:
@@ -242,16 +244,52 @@ class SeqRecAlgorithm(Algorithm):
             # latest-first → chronological
             history = [ids[e.target_entity_id] for e in reversed(evs)
                        if e.target_entity_id in ids]
-        if not history:
-            return PredictedResult()
+        return history
+
+    def _results(self, model: SeqRecModel, query: Query, history,
+                 idx, scores) -> PredictedResult:
         known = set(history) if query.exclude_known else set()
-        idx, scores = recommend_next(model, history,
-                                     k=query.num + len(known))
-        inv = ids.inverse
+        inv = model.item_ids.inverse
         out = [(int(i), float(s)) for i, s in zip(idx, scores)
                if int(i) not in known][: query.num]
         return PredictedResult(tuple(
             ItemScore(item=inv[i], score=s) for i, s in out))
+
+    def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
+        # single-query = batch of one: exactly one over-fetch rule
+        return self.batch_predict(model, [query])[0]
+
+    def batch_predict(self, model: SeqRecModel,
+                      queries: Sequence[Query]) -> List[PredictedResult]:
+        """ONE device dispatch for the whole batch (the batch-predict
+        job and the serving micro-batcher both call this). Serving-time
+        store reads for user queries run CONCURRENTLY — serialized
+        200ms-bounded lookups would cost the coalesced batch more than
+        the dispatch it saves."""
+        if len(queries) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(queries))) as pool:
+                hists = list(pool.map(
+                    lambda q: self._history_for(model, q), queries))
+        else:
+            hists = [self._history_for(model, q) for q in queries]
+        live = [i for i, h in enumerate(hists) if h]
+        out: List[PredictedResult] = [PredictedResult()] * len(queries)
+        if not live:
+            return out
+        k = max(queries[i].num
+                + (len(set(hists[i]))
+                   if queries[i].exclude_known else 0)
+                for i in live)
+        ids, scores = recommend_next_batch(
+            model, [hists[i] for i in live],
+            k=min(k, model.n_items))
+        for row, i in enumerate(live):
+            out[i] = self._results(model, queries[i], hists[i],
+                                   ids[row], scores[row])
+        return out
 
 
 class SequentialServing(FirstServing):

@@ -133,3 +133,21 @@ class TestSequentialEvaluation:
         # cyclic successor data: the model should hit the next item in
         # the top-5 far more often than the 5/24 random baseline
         assert best > 0.5, result.to_one_liner()
+
+
+class TestSequentialBatchPredict:
+    def test_batch_matches_single(self, seq_ctx):
+        engine, ep, model = _train(seq_ctx)
+        algo = engine.make_algorithms(ep)[0]
+        algo.bind_serving(seq_ctx)
+        queries = [Query(items=("i3", "i4"), num=3),
+                   Query(user="u1", num=2),
+                   Query(user="nobody", num=2),
+                   Query(items=("i9",), num=4)]
+        batch = algo.batch_predict(model, queries)
+        singles = [algo.predict(model, q) for q in queries]
+        assert len(batch) == len(singles) == 4
+        for b, s in zip(batch, singles):
+            assert [x.item for x in b.item_scores] == \
+                [x.item for x in s.item_scores]
+        assert batch[2].item_scores == ()  # unknown user slot intact
