@@ -1,0 +1,41 @@
+"""Leave-one-out evaluation for the sequential template.
+
+Run:  ptpu eval evaluation:evaluation evaluation:engine_params_generator
+"""
+
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+)
+from predictionio_tpu.controller.params import EngineParams
+from predictionio_tpu.models.seqrec import SeqRecParams
+from predictionio_tpu.templates.sequential import (
+    DataSourceParams,
+    HitRateAtK,
+    SeqNDCGAtK,
+    sequential_engine,
+)
+
+evaluation = Evaluation(
+    engine=sequential_engine(),
+    metric=HitRateAtK(k=10),
+    other_metrics=[SeqNDCGAtK(k=10)],
+)
+
+
+class _Gen(EngineParamsGenerator):
+    engine_params_list = [
+        EngineParams(
+            datasource=("", DataSourceParams(app_name="MyApp1",
+                                             max_len=50,
+                                             eval_query_num=10)),
+            algorithms=[("seqrec", SeqRecParams(
+                dim=dim, heads=2, num_blocks=blocks, max_len=50,
+                num_epochs=20, batch_size=256, learning_rate=1e-3,
+                n_negatives=64, seed=7))])
+        for dim in (32, 64)
+        for blocks in (1, 2)
+    ]
+
+
+engine_params_generator = _Gen()

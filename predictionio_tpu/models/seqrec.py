@@ -3,8 +3,8 @@
 A model family BEYOND the reference's inventory (PredictionIO has no
 sequence models — SURVEY §5 records sequence parallelism "absent"), made
 natural here by the TPU-first substrate: a SASRec-style next-item
-predictor — item + position embeddings → one pre-LN causal
-self-attention block (the SAME blockwise-softmax kernel
+predictor — item + position embeddings → a stack of ``num_blocks``
+pre-LN causal self-attention blocks (the SAME blockwise-softmax kernel
 ``ops/ring_attention`` uses; at pod scale the ring path serves sequences
 longer than one device holds) → position-wise FFN → tied-embedding item
 scores — trained with sampled-softmax cross-entropy under ``jit`` on an
@@ -36,6 +36,7 @@ class SeqRecParams:
 
     dim: int = 48
     heads: int = 2
+    num_blocks: int = 1
     max_len: int = 50
     num_epochs: int = 10
     batch_size: int = 128
@@ -47,6 +48,9 @@ class SeqRecParams:
     def __post_init__(self):
         if self.dim % self.heads != 0:
             raise ValueError("dim must divide by heads")
+        if self.num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1 (0 would train an "
+                             "attention-free embedding model silently)")
 
 
 @jax.tree_util.register_dataclass
@@ -92,21 +96,27 @@ def sequences_from_ratings(users: np.ndarray, items: np.ndarray,
 
 
 def _init_weights(key, n_items: int, p: SeqRecParams) -> Dict[str, jax.Array]:
-    ks = jax.random.split(key, 6)
+    ks = jax.random.split(key, 2 + 4 * p.num_blocks)
     d = p.dim
     s = d ** -0.5
-    return {
+    w = {
         # one extra row: the padding id embeds to a learned-but-masked row
         "item_emb": jax.random.normal(ks[0], (n_items + 1, d)) * 0.02,
         "pos_emb": jax.random.normal(ks[1], (p.max_len, d)) * 0.02,
-        "qkv": jax.random.normal(ks[2], (d, 3 * d)) * s,
-        "attn_out": jax.random.normal(ks[3], (d, d)) * s,
-        "ff1": jax.random.normal(ks[4], (d, 4 * d)) * s,
-        "ff2": jax.random.normal(ks[5], (4 * d, d)) * (4 * d) ** -0.5,
-        "ln1": jnp.ones((d,)), "ln1b": jnp.zeros((d,)),
-        "ln2": jnp.ones((d,)), "ln2b": jnp.zeros((d,)),
         "lnf": jnp.ones((d,)), "lnfb": jnp.zeros((d,)),
     }
+    for blk in range(p.num_blocks):
+        o = 2 + 4 * blk
+        w.update({
+            f"qkv{blk}": jax.random.normal(ks[o], (d, 3 * d)) * s,
+            f"attn_out{blk}": jax.random.normal(ks[o + 1], (d, d)) * s,
+            f"ff1{blk}": jax.random.normal(ks[o + 2], (d, 4 * d)) * s,
+            f"ff2{blk}": (jax.random.normal(ks[o + 3], (4 * d, d))
+                          * (4 * d) ** -0.5),
+            f"ln1{blk}": jnp.ones((d,)), f"ln1b{blk}": jnp.zeros((d,)),
+            f"ln2{blk}": jnp.ones((d,)), f"ln2b{blk}": jnp.zeros((d,)),
+        })
+    return w
 
 
 def _layer_norm(x, g, b):
@@ -125,25 +135,50 @@ def _encode(w: Dict[str, jax.Array], seq: jax.Array, p: SeqRecParams
     x = w["item_emb"][ids] + w["pos_emb"][None, -L:]
     x = jnp.where(pad[..., None], 0.0, x)
 
-    h = _layer_norm(x, w["ln1"], w["ln1b"])
-    qkv = h @ w["qkv"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    shp = (B, L, H, d // H)
-    # the shared attention kernel via its PUBLIC API (ring-capable at
-    # pod scale; mesh=None here — L is the history window). key_valid
-    # masks the left-pad slots: without it, real positions attend to
-    # (learned) pad keys and scores drift with pad count — the classic
-    # SASRec padding bug.
-    attn = ring_attention(
-        q.reshape(shp), k.reshape(shp), v.reshape(shp), mesh=None,
-        causal=True, scale=(d // H) ** -0.5,
-        key_valid=~pad).reshape(B, L, d)
-    x = x + jnp.where(pad[..., None], 0.0, attn @ w["attn_out"])
-
-    h = _layer_norm(x, w["ln2"], w["ln2b"])
-    x = x + jnp.where(pad[..., None], 0.0,
-                      jax.nn.relu(h @ w["ff1"]) @ w["ff2"])
+    for blk in range(p.num_blocks):
+        h = _layer_norm(x, w[f"ln1{blk}"], w[f"ln1b{blk}"])
+        qkv = h @ w[f"qkv{blk}"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (B, L, H, d // H)
+        # the shared attention kernel via its PUBLIC API (ring-capable
+        # at pod scale; mesh=None here — L is the history window).
+        # key_valid masks the left-pad slots: without it, real
+        # positions attend to (learned) pad keys and scores drift with
+        # pad count — the classic SASRec padding bug.
+        attn = ring_attention(
+            q.reshape(shp), k.reshape(shp), v.reshape(shp), mesh=None,
+            causal=True, scale=(d // H) ** -0.5,
+            key_valid=~pad).reshape(B, L, d)
+        x = x + jnp.where(pad[..., None], 0.0,
+                          attn @ w[f"attn_out{blk}"])
+        h = _layer_norm(x, w[f"ln2{blk}"], w[f"ln2b{blk}"])
+        x = x + jnp.where(pad[..., None], 0.0,
+                          jax.nn.relu(h @ w[f"ff1{blk}"])
+                          @ w[f"ff2{blk}"])
     return _layer_norm(x, w["lnf"], w["lnfb"])
+
+
+def _compat_model(model: "SeqRecModel") -> "SeqRecModel":
+    """Models persisted by the first single-block revision used
+    unsuffixed weight keys and a params class without ``num_blocks`` —
+    map both forward so old blobs keep serving."""
+    w = model.weights
+    p = model.params
+    changed = False
+    if "qkv" in w and "qkv0" not in w:
+        ren = {"qkv": "qkv0", "attn_out": "attn_out0", "ff1": "ff10",
+               "ff2": "ff20", "ln1": "ln10", "ln1b": "ln1b0",
+               "ln2": "ln20", "ln2b": "ln2b0"}
+        w = {ren.get(k, k): v for k, v in w.items()}
+        changed = True
+    if not hasattr(p, "num_blocks"):
+        p = SeqRecParams(**{**p.__dict__, "num_blocks": 1})
+        changed = True
+    if not changed:
+        return model
+    import dataclasses
+
+    return dataclasses.replace(model, weights=w, params=p)
 
 
 def p_pad_id(w) -> int:
@@ -284,6 +319,7 @@ def recommend_next_batch(model: SeqRecModel,
     two (clamped to the catalog) so arbitrary serving batches reuse
     O(log²) compilations instead of re-tracing per (B, k) pair — the
     same jit-cache-bounding convention as the ALS serving path."""
+    model = _compat_model(model)
     p = model.params
     B = len(histories)
     k_req = min(k, model.n_items)
