@@ -1,6 +1,8 @@
 """Leave-one-out evaluation for the sequential template.
 
-Run:  ptpu eval evaluation:evaluation evaluation:engine_params_generator
+Run (repo root on PYTHONPATH, like the sibling examples):
+  ptpu eval examples.sequential.evaluation:evaluation \
+      examples.sequential.evaluation:engine_params_generator
 """
 
 from predictionio_tpu.controller.evaluation import (

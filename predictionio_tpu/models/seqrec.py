@@ -322,6 +322,11 @@ def recommend_next_batch(model: SeqRecModel,
     model = _compat_model(model)
     p = model.params
     B = len(histories)
+    if B > (1 << 16):
+        # a silent clamp would IndexError on the fill loop below;
+        # callers this large should chunk
+        raise ValueError(f"recommend_next_batch: batch of {B} exceeds "
+                         f"the {1 << 16} per-dispatch bound; chunk it")
     k_req = min(k, model.n_items)
     B_pad = _pow2_at_least(max(B, 1), 1 << 16)
     k_pad = _pow2_at_least(max(k_req, 1), model.n_items)

@@ -764,3 +764,44 @@ class TestRemoteBackend:
         assert s.models().get("m1").models == blob
         s.models().delete("m1")
         assert s.models().get("m1") is None
+
+    def test_concurrent_clients(self, served):
+        """8 threads × mixed insert/read traffic against one storage
+        server: exactly the expected rows land, reads stay consistent
+        (the SQLite-behind-HTTP locking story under real concurrency)."""
+        import threading
+
+        from predictionio_tpu.data.storage import Storage
+        s = Storage(env=self._env(served))
+        app_id = 31
+        s.events().init(app_id)
+        errors: list = []
+
+        def writer(t):
+            try:
+                st = Storage(env=self._env(served))
+                st.events().insert_batch(
+                    self._events(50, seed=t), app_id)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                st = Storage(env=self._env(served))
+                for _ in range(5):
+                    list(st.events().find(app_id))
+                    st.events().find_columnar(app_id, ordered=False,
+                                              with_props=False)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        threads += [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:2]
+        assert len(list(s.events().find(app_id))) == 400
+        assert s.events().find_columnar(app_id, ordered=False).n == 400
