@@ -199,7 +199,8 @@ class SegmentFSEventStore(EventStore):
                     log.sweep(_GC_GRACE_S)
         with self.c._seg_lock:
             self.c.replay_cache.pop(d, None)
-            self.c.replay_cache.pop(("columnar", d), None)
+            for wp in (False, True):
+                self.c.replay_cache.pop(("columnar", d, wp), None)
         return True
 
     def close(self) -> None:
@@ -360,7 +361,8 @@ class SegmentFSEventStore(EventStore):
                       float_props: Sequence[str] = ("rating",),
                       ordered: bool = True, with_props: bool = True):
         batch = self._sync_columnar(app_id, channel_id,
-                                    tuple(float_props))
+                                    tuple(float_props),
+                                    want_props=with_props)
         return batch.select(filter, ordered=ordered,
                             with_props=with_props)
 
@@ -369,7 +371,8 @@ class SegmentFSEventStore(EventStore):
                              entity_type: str, start_time=None,
                              until_time=None, required=None):
         from ..aggregation import AGGREGATION_EVENTS, aggregate_from_columnar
-        batch = self._sync_columnar(app_id, channel_id, ("rating",))
+        batch = self._sync_columnar(app_id, channel_id, ("rating",),
+                                    want_props=True)
         sub = batch.select(EventFilter(
             entity_type=entity_type, start_time=start_time,
             until_time=until_time,
@@ -382,13 +385,17 @@ class SegmentFSEventStore(EventStore):
         return result
 
     def _sync_columnar(self, app_id: int, channel_id: Optional[int],
-                       float_props: tuple):
+                       float_props: tuple, want_props: bool = True):
+        """``want_props=False`` (the training read) skips loading the
+        property-byte columns entirely — on an IO-bound shared mount
+        they are a large fraction of a cold read no trainer touches."""
         from ..columnar import ColumnarBatch, SegmentLog
 
         d = self._dir(app_id, channel_id)
         src = tuple(self._read_manifest(d))
+        ck = ("columnar", d, bool(want_props))
         with self.c._seg_lock:
-            cached = self.c.replay_cache.get(("columnar", d))
+            cached = self.c.replay_cache.get(ck)
         if cached is not None and cached[0] == src:
             return cached[1]
         if not src:
@@ -418,12 +425,12 @@ class SegmentFSEventStore(EventStore):
                 self._encode_columnar_delta(log, d, src, done, delta,
                                             float_props, app_id,
                                             channel_id)
-            batch, _ = log.load()
+            batch, _ = log.load(with_props=want_props)
             if batch is None:
                 batch = ColumnarBatch.empty(float_props=float_props)
             log.sweep(_GC_GRACE_S)
         with self.c._seg_lock:
-            self.c.replay_cache[("columnar", d)] = (src, batch)
+            self.c.replay_cache[ck] = (src, batch)
         return batch
 
     def _stored_id_hashes(self, log) -> "np.ndarray":
