@@ -122,12 +122,28 @@ def bulk_hash64(strings) -> np.ndarray:
 
 
 def bulk_iso_to_millis(strings) -> np.ndarray:
-    """ISO-8601 timestamps → epoch millis int64."""
+    """ISO-8601 timestamps → epoch millis int64.
+
+    ``asi8``'s unit follows the DatetimeIndex RESOLUTION, which pandas
+    infers (datetime64[us] for these strings — a raw ``// 1_000_000``
+    would silently yield epoch SECONDS); convert to an explicit ms
+    resolution first."""
     if _pd is not None:
-        return (_pd.to_datetime(list(strings), utc=True,
-                                format="ISO8601").asi8 // 1_000_000)
+        # format="ISO8601" itself requires pandas >= 2.0, which also has
+        # as_unit — no older-pandas branch is reachable here
+        return _pd.to_datetime(list(strings), utc=True,
+                               format="ISO8601").as_unit("ms").asi8
+    from datetime import datetime, timedelta, timezone
+
     from .event import parse_iso
-    return np.fromiter((to_millis(parse_iso(s)) for s in strings),
+
+    epoch = datetime(1970, 1, 1, tzinfo=timezone.utc)
+    one_ms = timedelta(milliseconds=1)
+    # timedelta floor-division FLOORS (exact integer math) — matching
+    # pandas' as_unit truncation for pre-epoch sub-ms times, where
+    # float timestamp()*1000 would truncate toward zero instead
+    return np.fromiter(((parse_iso(s) - epoch) // one_ms
+                        for s in strings),
                        dtype=np.int64, count=len(strings))
 
 __all__ = [
@@ -555,6 +571,11 @@ _DICTS = ("event_names", "entity_types", "entity_ids", "target_types",
 class SegmentLog:
     """Immutable columnar segments + manifest for one event log.
 
+    ``FORMAT`` versions the ENCODED CONTENT: readers invalidate and
+    re-encode sidecars written by older formats (v2: the event_time
+    column of v1 segmentfs sidecars could carry epoch seconds — the
+    pandas datetime64[us] ``asi8`` bug).
+
     Directory layout::
 
         <dir>/manifest.json        {"watermark": ..., "count": N,
@@ -567,8 +588,17 @@ class SegmentLog:
     20M-event log costs page-cache reads, not JSON parsing.
     """
 
+    #: encoded-content format version (bump forces re-encode)
+    FORMAT = 2
+
     def __init__(self, path: str):
         self.path = path
+
+    def format_stale(self, manifest: Optional[dict]) -> bool:
+        """True when ``manifest`` was written by an older format and
+        must be invalidated + re-encoded."""
+        return manifest is not None \
+            and int(manifest.get("format", 1)) < self.FORMAT
 
     @contextlib.contextmanager
     def lock(self):
@@ -647,7 +677,8 @@ class SegmentLog:
         os.makedirs(self.path, exist_ok=True)
         manifest = self.read_manifest() or {
             "count": 0, "segments": [], "float_props": [],
-            "watermark": None}
+            "watermark": None, "format": self.FORMAT}
+        manifest.setdefault("format", self.FORMAT)
         # unique across GENERATIONS: after an invalidate with a grace
         # period, retired segment dirs coexist with the new generation's
         # (readers may still mmap them) — names must never collide

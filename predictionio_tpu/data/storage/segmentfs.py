@@ -408,6 +408,11 @@ class SegmentFSEventStore(EventStore):
             # for changed history
             src = tuple(self._read_manifest(d))
             man = log.read_manifest()
+            if log.format_stale(man):
+                # older encoded format (e.g. the v1 epoch-seconds
+                # event_time bug): rebuild from the source log
+                log.invalidate(grace_s=_GC_GRACE_S)
+                man = None
             done: tuple = tuple((man or {}).get("watermark") or ())
             if man is not None and done != src[:len(done)]:
                 if done[:len(src)] == src:

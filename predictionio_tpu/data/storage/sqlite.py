@@ -341,6 +341,9 @@ class SQLiteEventStore(EventStore):
         log = SegmentLog(sidecar_dir)
         with log.lock():
             manifest = log.read_manifest()
+            if log.format_stale(manifest):
+                log.invalidate()
+                manifest = None
             wm = int((manifest or {}).get("watermark") or 0)
             count = int((manifest or {}).get("count") or 0)
             if manifest is not None:

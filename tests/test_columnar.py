@@ -494,3 +494,52 @@ class TestPropsDeferredSidecar:
         props = es.aggregate_properties(app_id, entity_type="user")
         assert props["u7"]["plan"] == "pro"
         assert props["u7"]["k"] == 7
+
+
+class TestBulkHelpers:
+    def test_iso_to_millis_keeps_milliseconds(self):
+        """pandas' DatetimeIndex resolution is INFERRED (datetime64[us]
+        here); a raw asi8 // 1e6 silently produced epoch SECONDS —
+        regression for the segmentfs sidecar time column (found by the
+        cross-backend fuzzer)."""
+        from predictionio_tpu.data.columnar import bulk_iso_to_millis
+        out = list(bulk_iso_to_millis(
+            ["2026-03-01T00:00:00.000Z", "2026-03-01T00:00:00.037Z",
+             "2026-03-01T12:34:56.789Z"]))
+        assert out == [1772323200000, 1772323200037, 1772368496789]
+
+    def test_iso_to_millis_fallback_matches_pandas(self):
+        import predictionio_tpu.data.columnar as col
+        strings = ["2026-03-01T00:00:00.000Z",
+                   "2026-03-01T00:00:00.037Z"]
+        a = list(col.bulk_iso_to_millis(strings))
+        saved = col._pd
+        try:
+            col._pd = None
+            b = list(col.bulk_iso_to_millis(strings))
+        finally:
+            col._pd = saved
+        assert a == b
+
+    def test_old_format_sidecar_invalidated(self, sq):
+        """Sidecars written by format v1 (whose event_time column could
+        carry epoch SECONDS — the pandas asi8 unit bug) must be
+        re-encoded, not trusted."""
+        import json as _json
+
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(synth_events(25, seed=9), app_id)
+        b1 = es.find_columnar(app_id, ordered=False, with_props=False)
+        d = es._columnar_dir(app_id, None)
+        mpath = d + "/manifest.json"
+        man = _json.loads(open(mpath).read())
+        assert man.get("format") == 2
+        # simulate a v1 sidecar: strip the format field
+        del man["format"]
+        open(mpath, "w").write(_json.dumps(man))
+        es.client.columnar_cache.clear()
+        b2 = es.find_columnar(app_id, ordered=False, with_props=False)
+        assert b2.n == b1.n == 25
+        man2 = _json.loads(open(mpath).read())
+        assert man2.get("format") == 2  # re-encoded under the new format
