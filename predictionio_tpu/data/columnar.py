@@ -678,7 +678,10 @@ class SegmentLog:
         manifest = self.read_manifest() or {
             "count": 0, "segments": [], "float_props": [],
             "watermark": None, "format": self.FORMAT}
-        manifest.setdefault("format", self.FORMAT)
+        # deliberately NO format backfill on existing manifests: blessing
+        # a v1 manifest as current would permanently exempt its old
+        # segments from the format_stale invalidation net — appends to a
+        # stale-format sidecar stay stale and get rebuilt on next read
         # unique across GENERATIONS: after an invalidate with a grace
         # period, retired segment dirs coexist with the new generation's
         # (readers may still mmap them) — names must never collide

@@ -342,8 +342,17 @@ class SQLiteEventStore(EventStore):
         with log.lock():
             manifest = log.read_manifest()
             if log.format_stale(manifest):
-                log.invalidate()
-                manifest = None
+                if int(manifest.get("format", 1)) == 1:
+                    # v1→v2 changed only how ISO strings became millis —
+                    # the SQLite encoder reads INTEGER millis straight
+                    # from SQL and never touched that path, so v1
+                    # sqlite sidecars are byte-identical to v2: stamp in
+                    # place instead of re-encoding millions of rows
+                    manifest["format"] = 2
+                    log._write_manifest(manifest)
+                if log.format_stale(manifest):
+                    log.invalidate()
+                    manifest = None
             wm = int((manifest or {}).get("watermark") or 0)
             count = int((manifest or {}).get("count") or 0)
             if manifest is not None:

@@ -521,10 +521,12 @@ class TestBulkHelpers:
             col._pd = saved
         assert a == b
 
-    def test_old_format_sidecar_invalidated(self, sq):
-        """Sidecars written by format v1 (whose event_time column could
-        carry epoch SECONDS — the pandas asi8 unit bug) must be
-        re-encoded, not trusted."""
+    def test_old_format_sidecar_stamped_in_place(self, sq):
+        """v1→v2 changed only the ISO→millis conversion, which the
+        SQLite encoder never used (INTEGER millis straight from SQL) —
+        a v1 sqlite sidecar is byte-identical to v2 and gets STAMPED,
+        not re-encoded (a 20M-row re-encode for correct data would be
+        pure waste)."""
         import json as _json
 
         storage, app_id = sq
@@ -535,6 +537,7 @@ class TestBulkHelpers:
         mpath = d + "/manifest.json"
         man = _json.loads(open(mpath).read())
         assert man.get("format") == 2
+        segs_before = [sg["name"] for sg in man["segments"]]
         # simulate a v1 sidecar: strip the format field
         del man["format"]
         open(mpath, "w").write(_json.dumps(man))
@@ -542,4 +545,30 @@ class TestBulkHelpers:
         b2 = es.find_columnar(app_id, ordered=False, with_props=False)
         assert b2.n == b1.n == 25
         man2 = _json.loads(open(mpath).read())
-        assert man2.get("format") == 2  # re-encoded under the new format
+        assert man2.get("format") == 2
+        assert [sg["name"] for sg in man2["segments"]] == segs_before
+
+    def test_old_format_segmentfs_sidecar_reencoded(self, tmp_path):
+        """segmentfs DID write corrupt v1 event_time columns (the
+        epoch-seconds bug): its v1 sidecars must be re-encoded."""
+        import json as _json
+
+        from predictionio_tpu.data.storage.segmentfs import (
+            SegmentFSClient,
+            SegmentFSEventStore,
+        )
+        es = SegmentFSEventStore(SegmentFSClient(str(tmp_path)))
+        es.init(1)
+        es.insert_batch(synth_events(20, seed=3), 1)
+        b1 = es.find_columnar(1, ordered=False, with_props=False)
+        mpath = tmp_path / "events" / "app_1" / "columnar" / "manifest.json"
+        man = _json.loads(mpath.read_text())
+        segs_before = [sg["name"] for sg in man["segments"]]
+        del man["format"]
+        mpath.write_text(_json.dumps(man))
+        es.c.replay_cache.clear()
+        b2 = es.find_columnar(1, ordered=False, with_props=False)
+        assert b2.n == b1.n == 20
+        man2 = _json.loads(mpath.read_text())
+        assert man2.get("format") == 2
+        assert [sg["name"] for sg in man2["segments"]] != segs_before

@@ -23,8 +23,30 @@ def proj(e):
             tuple(sorted(e.properties.to_dict().items())))
 
 
-@pytest.fixture(params=["sqlite", "localfs", "segmentfs"])
+@pytest.fixture(params=["sqlite", "localfs", "segmentfs", "remote"])
 def dut(request, tmp_path):
+    if request.param == "remote":
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.remote import (
+            RemoteClient,
+            RemoteEventStore,
+        )
+        from predictionio_tpu.server.storageserver import (
+            create_storage_server,
+        )
+        backing = Storage(env={
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "fz.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        })
+        srv = create_storage_server(backing, host="127.0.0.1", port=0)
+        srv.start_background()
+        yield RemoteEventStore(RemoteClient(
+            f"http://127.0.0.1:{srv.port}"))
+        srv.shutdown()
+        return
     if request.param == "sqlite":
         from predictionio_tpu.data.storage.sqlite import (
             SQLiteClient,
