@@ -34,3 +34,25 @@ def mesh8():
 
     assert len(jax.devices()) >= 8, "expected 8 virtual CPU devices"
     return make_mesh(data=4, model=2)
+
+
+def start_sqlite_backed_storage_server(tmp_path, secret=None):
+    """Shared bootstrap for remote-backend tests: a sqlite-backed
+    Storage served by a real storage server on a loopback port.
+    Returns (server, backing_storage); caller shuts the server down."""
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.server.storageserver import (
+        create_storage_server,
+    )
+
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "backing.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    srv = create_storage_server(backing, host="127.0.0.1", port=0,
+                                secret=secret)
+    srv.start_background()
+    return srv, backing

@@ -63,7 +63,7 @@ def backend(request, tmp_path):
         # the network-capable backend: a real storage server (sqlite-
         # backed) on a loopback port, driven through the REMOTE client —
         # same conformance surface as every in-process backend
-        from predictionio_tpu.data.storage import Storage
+        from conftest import start_sqlite_backed_storage_server
         from predictionio_tpu.data.storage.remote import (
             RemoteAccessKeys,
             RemoteApps,
@@ -74,19 +74,8 @@ def backend(request, tmp_path):
             RemoteEventStore,
             RemoteModels,
         )
-        from predictionio_tpu.server.storageserver import (
-            create_storage_server,
-        )
-        backing = Storage(env={
-            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
-            "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "backing.db"),
-            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
-            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
-            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
-        })
-        srv = create_storage_server(backing, host="127.0.0.1", port=0,
-                                    secret="testsecret")
-        srv.start_background()
+        srv, _ = start_sqlite_backed_storage_server(
+            tmp_path, secret="testsecret")
         client = RemoteClient(f"http://127.0.0.1:{srv.port}",
                               secret="testsecret")
         yield {
@@ -677,20 +666,9 @@ class TestRemoteBackend:
 
     @pytest.fixture()
     def served(self, tmp_path):
-        from predictionio_tpu.data.storage import Storage
-        from predictionio_tpu.server.storageserver import (
-            create_storage_server,
-        )
-        backing = Storage(env={
-            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
-            "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "b.db"),
-            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
-            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
-            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
-        })
-        srv = create_storage_server(backing, host="127.0.0.1", port=0,
-                                    secret="s3cret")
-        srv.start_background()
+        from conftest import start_sqlite_backed_storage_server
+        srv, _ = start_sqlite_backed_storage_server(tmp_path,
+                                                    secret="s3cret")
         yield srv
         srv.shutdown()
 
