@@ -132,7 +132,9 @@ class RemoteEventStore(EventStore):
 
     def _base(self, app_id: int,
               channel_id: Optional[int]) -> "tuple[str, str]":
-        q = f"?channel={channel_id}" if channel_id else ""
+        # `is not None`: channel 0 must reach the server, not alias the
+        # default channel
+        q = (f"?channel={channel_id}" if channel_id is not None else "")
         return f"/v1/events/{app_id}", q
 
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:

@@ -68,7 +68,10 @@ class SQLiteClient:
 
 
 def _table(app_id: int, channel_id: Optional[int]) -> str:
-    return f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
+    # `is not None`, never falsy: channel 0 must not alias the default
+    # channel (memory/localfs/segmentfs already keep it distinct)
+    return f"events_{app_id}" + (f"_{channel_id}"
+                                 if channel_id is not None else "")
 
 
 def _fork_context():

@@ -227,7 +227,9 @@ def test_channel_partitions_stay_isolated(dut):
     bleed in any backend is a silent data-corruption class."""
     rng = np.random.default_rng(11)
     oracle = MemoryEventStore()
-    chans = [None, 1, 2]
+    # 0 included deliberately: falsy `if channel_id` checks aliased
+    # channel 0 into the default channel on two backends (fixed)
+    chans = [None, 0, 1, 2]
     for c in chans:
         oracle.init(APP, c)
         dut.init(APP, c)
