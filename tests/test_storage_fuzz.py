@@ -99,6 +99,14 @@ def _compare(oracle, dut):
     f2 = EventFilter(entity_type="user", target_entity_type=None)
     assert sorted(proj(e) for e in oracle.find(APP, filter=f2)) == \
         sorted(proj(e) for e in dut.find(APP, filter=f2))
+    # ORDERED semantics: limit + reversed must agree between the row
+    # scan and the columnar projection as exact SEQUENCES (unique
+    # event times make the ordering deterministic)
+    f3 = EventFilter(reversed=True, limit=7)
+    ra = [proj(e) for e in oracle.find(APP, filter=f3)]
+    assert ra == [proj(e) for e in dut.find(APP, filter=f3)]
+    assert ra == [proj(e)
+                  for e in dut.find_columnar(APP, filter=f3).to_events()]
     # columnar projection == row scan (bulk-read fields)
     cb = sorted(proj(e) for e in dut.find_columnar(APP).to_events())
     assert cb == a
