@@ -105,12 +105,22 @@ def bulk_to_float64(values, assume_numeric: bool = False) -> np.ndarray:
                      for v in values], dtype=np.float64)
 
 
+def hash_impl() -> str:
+    """Which :func:`bulk_hash64` implementation this process uses
+    (``'pd'`` = pandas siphash, ``'blake2b'`` = stdlib fallback). The
+    two are mutually incompatible, so sidecar manifests record the
+    writer's implementation: a reader on a different stack must rebuild
+    rather than run a dup check that can never match (and so silently
+    fails open, appending duplicate rows on crash replay)."""
+    return "pd" if _pd is not None else "blake2b"
+
+
 def bulk_hash64(strings) -> np.ndarray:
     """Deterministic 64-bit hashes of strings (uint64) — stable across
     processes and hosts (pod hosts compare these on a shared fs), as
     long as every host runs the same stack: the pandas path (siphash,
     fixed key) and the fallback (blake2b) are each self-consistent but
-    differ from each other."""
+    differ from each other (see :func:`hash_impl`)."""
     if _pd is not None:
         return _pd.util.hash_array(np.asarray(strings, dtype=object))
     import hashlib
@@ -665,7 +675,8 @@ class SegmentLog:
     def append(self, batch: ColumnarBatch, watermark,
                prev_dict_counts: Dict[str, int],
                seq_range: Optional[Tuple[int, int]] = None,
-               has_props: bool = True) -> None:
+               has_props: bool = True,
+               hash_impl: Optional[str] = None) -> None:
         """Write ``batch`` as a new segment and commit the manifest.
 
         ``has_props=False`` defers the property-byte columns: the
@@ -705,6 +716,12 @@ class SegmentLog:
         manifest["watermark"] = watermark
         manifest["float_props"] = sorted(
             set(manifest["float_props"]) | set(batch.float_props))
+        if hash_impl is not None:
+            # writers that store id-hash columns beside segments record
+            # their bulk_hash64 implementation; readers on a different
+            # stack rebuild instead of dup-checking against hashes that
+            # can never match (segmentfs pod sidecars)
+            manifest["hash_impl"] = hash_impl
         self._write_manifest(manifest)
 
     def ensure_props(self, fetch) -> None:

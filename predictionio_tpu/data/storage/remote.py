@@ -203,13 +203,24 @@ class RemoteEventStore(EventStore):
                       ordered: bool = True, with_props: bool = True):
         base, q = self._base(app_id, channel_id)
         sep = "&" if q else "?"
+        # the wire protocol is comma-separated, so ',' in a name is
+        # unrepresentable — reject it rather than silently request
+        # different columns; quote() guards '&'/'='/spaces (the sqlite
+        # path gates names to alnum/underscore; remote must not be the
+        # one backend where a crafted name rewrites the query string)
+        for p in float_props:
+            if "," in p:
+                raise ValueError(
+                    f"float prop name may not contain ',': {p!r}")
         key = (app_id, channel_id, with_props, tuple(float_props))
         with self.c.lock:
             etag, cached = self.c.columnar_cache.get(key, (None, None))
         headers = {"If-None-Match": etag} if etag else {}
+        fp_q = ",".join(urllib.parse.quote(p, safe="")
+                        for p in float_props)
         path = (f"{base}/columnar{q}{sep}props="
                 f"{'1' if with_props else '0'}"
-                f"&float_props={','.join(float_props)}")
+                f"&float_props={fp_q}")
         status, resp_headers, body = self.c.request(
             "GET", path, headers=headers)
         if status == 304 and cached is not None:

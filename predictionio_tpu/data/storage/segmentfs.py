@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import threading
 import time
@@ -47,7 +48,13 @@ from .base import EventFilter, EventStore
 from .localfs import _flock, atomic_write
 
 #: compact when tombstoned/overwritten records outnumber live events
+log_ = logging.getLogger("predictionio_tpu.storage.segmentfs")
+
 _COMPACT_RATIO = 1.0
+#: watermark sentinel committed by intermediate rebuild chunks — can
+#: never equal a jsonl segment name, so a crash mid-rebuild reads as
+#: "history changed → invalidate + re-encode", never as complete
+_REBUILD_WM = "__rebuild-incomplete__"
 #: seconds an unreferenced segment survives before gc (reader grace)
 _GC_GRACE_S = 300.0
 
@@ -413,6 +420,22 @@ class SegmentFSEventStore(EventStore):
                 # event_time bug): rebuild from the source log
                 log.invalidate(grace_s=_GC_GRACE_S)
                 man = None
+            from ..columnar import hash_impl
+            if man is not None and man.get("hash_impl") != hash_impl():
+                # the writer's bulk_hash64 differs from ours (pandas
+                # siphash vs blake2b): stored id_hash columns can never
+                # match, so the crash-replay dup check would fail open
+                # and append duplicate rows — rebuild instead. Loud:
+                # MIXED-stack pods ping-pong full re-encodes forever;
+                # the fix is homogeneous stacks, not silent rebuilds.
+                log_.warning(
+                    "segmentfs sidecar %s was hashed with %r but this "
+                    "host uses %r — rebuilding; mixed pandas/non-pandas "
+                    "hosts on one mount will thrash rebuilds",
+                    self._columnar_dir(d),
+                    (man or {}).get("hash_impl"), hash_impl())
+                log.invalidate(grace_s=_GC_GRACE_S)
+                man = None
             done: tuple = tuple((man or {}).get("watermark") or ())
             if man is not None and done != src[:len(done)]:
                 if done[:len(src)] == src:
@@ -565,7 +588,7 @@ class SegmentFSEventStore(EventStore):
                                channel_id: Optional[int]) -> None:
         import numpy as np
 
-        from ..columnar import bulk_hash64
+        from ..columnar import bulk_hash64, hash_impl
 
         def rebuild() -> None:
             # deletes/replacements: rebuild the projection of LIVE
@@ -577,7 +600,8 @@ class SegmentFSEventStore(EventStore):
             if not live:
                 from ..columnar import ColumnarBatch
                 log.append(ColumnarBatch.empty(float_props=float_props),
-                           watermark=list(src), prev_dict_counts={})
+                           watermark=list(src), prev_dict_counts={},
+                           hash_impl=hash_impl())
                 self._write_id_hashes(log, np.empty(0, np.uint64))
                 return
             events = list(live.values())
@@ -589,8 +613,17 @@ class SegmentFSEventStore(EventStore):
                 batch = columnar_from_events(
                     events[s:s + self.COLUMNAR_CHUNK], dicts=dicts,
                     float_props=float_props)
-                log.append(batch, watermark=list(src),
-                           prev_dict_counts=prev_counts)
+                # only the FINAL chunk's manifest commit may claim the
+                # src watermark: a crash between chunk appends must
+                # leave a sidecar the next reader detects as stale
+                # (sentinel ⇒ invalidate+rebuild), not serve a
+                # truncated batch as the complete training read
+                final = s + self.COLUMNAR_CHUNK >= len(events)
+                log.append(batch,
+                           watermark=list(src) if final
+                           else [_REBUILD_WM],
+                           prev_dict_counts=prev_counts,
+                           hash_impl=hash_impl())
                 self._write_id_hashes(
                     log, bulk_hash64(ids[s:s + self.COLUMNAR_CHUNK]))
 
@@ -674,7 +707,11 @@ class SegmentFSEventStore(EventStore):
         numbers-only gate) as a sidecar segment."""
         import numpy as np
 
-        from ..columnar import bulk_iso_to_millis, columnar_from_columns
+        from ..columnar import (
+            bulk_iso_to_millis,
+            columnar_from_columns,
+            hash_impl,
+        )
 
         dicts, prev_counts = log.dicts_and_counts()
         times = bulk_iso_to_millis(cols["time_iso"])
@@ -686,7 +723,8 @@ class SegmentFSEventStore(EventStore):
             np.asarray(times, dtype=np.int64), cols["props_raw"],
             float_props=float_props, float_prop_values=fpv)
         log.append(batch, watermark=list(consumed),
-                   prev_dict_counts=prev_counts)
+                   prev_dict_counts=prev_counts,
+                   hash_impl=hash_impl())
         self._write_id_hashes(log, new_h)
 
     def _write_id_hashes(self, log, hashes) -> None:

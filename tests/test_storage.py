@@ -588,6 +588,82 @@ class TestSegmentFSColumnarSidecar:
         props = es.aggregate_properties(1, entity_type="item")
         assert props["i3"]["cat"] == "c1"
 
+    def test_foreign_hash_impl_forces_rebuild(self, tmp_path):
+        """A sidecar written by a host with the OTHER bulk_hash64
+        implementation (pandas siphash vs blake2b) must be rebuilt, not
+        dup-checked against hashes that can never match (advisor r3)."""
+        import json
+
+        from predictionio_tpu.data.columnar import hash_impl
+        from predictionio_tpu.data.storage.segmentfs import (
+            SegmentFSClient,
+            SegmentFSEventStore,
+        )
+        es = self._store(tmp_path)
+        self._seed(es, n=20)
+        es.find_columnar(1)
+        mpath = (tmp_path / "events" / "app_1" / "columnar"
+                 / "manifest.json")
+        man = json.loads(mpath.read_text())
+        assert man["hash_impl"] == hash_impl()
+        old_segs = {s["name"] for s in man["segments"]}
+        man["hash_impl"] = ("blake2b" if hash_impl() == "pd" else "pd")
+        mpath.write_text(json.dumps(man))
+        # a fresh host (cold replay cache) must invalidate + re-encode
+        es2 = SegmentFSEventStore(SegmentFSClient(str(tmp_path)))
+        b = es2.find_columnar(1, ordered=False)
+        assert b.n == 20
+        man2 = json.loads(mpath.read_text())
+        assert man2["hash_impl"] == hash_impl()
+        assert not old_segs & {s["name"] for s in man2["segments"]}
+
+    def test_partial_multichunk_rebuild_self_heals(self, tmp_path,
+                                                   monkeypatch):
+        """A crash BETWEEN chunk appends of a multi-chunk rebuild must
+        not leave a manifest claiming completeness over a partial
+        sidecar (advisor r3 medium): intermediate chunks carry a
+        sentinel watermark, so the next reader rebuilds and serves the
+        full projection."""
+        from predictionio_tpu.data import columnar as col_mod
+        from predictionio_tpu.data.storage.segmentfs import (
+            SegmentFSClient,
+            SegmentFSEventStore,
+        )
+
+        monkeypatch.setattr(SegmentFSEventStore, "COLUMNAR_CHUNK", 8)
+        es = self._store(tmp_path)
+        ids = self._seed(es, n=25)
+        es.find_columnar(1)
+        assert es.delete(ids[3], 1)  # delete ⇒ next sync rebuilds
+
+        real_append = col_mod.SegmentLog.append
+        calls = {"n": 0}
+
+        def crashing_append(self, *a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:  # die between chunk 1 and chunk 2
+                raise RuntimeError("simulated crash mid-rebuild")
+            return real_append(self, *a, **k)
+
+        monkeypatch.setattr(col_mod.SegmentLog, "append",
+                            crashing_append)
+        try:
+            es.find_columnar(1, ordered=False)
+        except RuntimeError:
+            pass
+        monkeypatch.setattr(col_mod.SegmentLog, "append", real_append)
+        # the partially-rebuilt sidecar must NOT be trusted: a fresh
+        # host sees the sentinel watermark, rebuilds, and serves all
+        # 24 live events (not the 8 rows of the crashed first chunk)
+        es2 = SegmentFSEventStore(SegmentFSClient(str(tmp_path)))
+        b = es2.find_columnar(1, ordered=False)
+        assert b.n == 24
+        rows = sorted((e.event, e.entity_id, e.target_entity_id)
+                      for e in es2.find(1))
+        cols = sorted((e.event, e.entity_id, e.target_entity_id)
+                      for e in b.to_events())
+        assert cols == rows
+
     def test_missing_hash_file_crash_window_self_heals(self, tmp_path):
         """A crash between the sidecar segment commit and its id-hash
         write leaves a hash-less segment; the next sync must rebuild
@@ -723,6 +799,66 @@ class TestRemoteBackend:
         b3 = es.find_columnar(app_id, ordered=False, with_props=False)
         assert b3.n == 35
         assert cached[key][0] != etag_before
+
+    def test_float_prop_names_escaped_on_wire(self, served):
+        """Prop names ride the URL query; '&' must not rewrite the
+        query string (advisor r3) and ',' — unrepresentable in the
+        comma-joined wire format — is rejected loudly."""
+        from predictionio_tpu.data.storage import App, Storage
+        s = Storage(env=self._env(served))
+        app_id = s.apps().insert(App(0, "netesc"))
+        s.events().init(app_id)
+        s.events().insert_batch(self._events(10, seed=7), app_id)
+        # 'a&b' is quoted on the wire: the request still carries BOTH
+        # names (sqlite's alnum gate then drops the unsafe one), so
+        # 'rating' survives — unescaped it would truncate the list
+        b = s.events().find_columnar(
+            app_id, ordered=False, float_props=("a&b", "rating"))
+        assert "rating" in b.float_props
+        with pytest.raises(ValueError):
+            s.events().find_columnar(app_id, float_props=("a,b",))
+
+    def test_etag_full_content_hash(self):
+        """Two same-length, same-sum batches differing only at
+        positions a strided sample misses must get DIFFERENT ETags
+        (advisor r3: compensated edits served stale 304s forever)."""
+        import numpy as np
+
+        from predictionio_tpu.server.storageserver import _batch_version
+
+        def mk(rating):
+            class B:
+                pass
+            b = B()
+            n = len(rating)
+            b.n = n
+            z = np.zeros(n, np.int32)
+            b.event = b.entity_type = b.entity_id = z
+            b.target_type = b.target_id = z
+            b.event_time = np.zeros(n, np.int64)
+            b.props_offsets = np.zeros(n + 1, np.int64)
+            b.props_blob = np.zeros(0, np.uint8)
+            b.float_props = {"rating": rating}
+            return b
+
+        n = 200_000
+        a = np.zeros(n, np.float64)
+        c = a.copy()
+        c[100_001] += 1.0  # not on the stride-3 sample grid
+        c[100_003] -= 1.0  # sum unchanged
+        va, vc = _batch_version(mk(a)), _batch_version(mk(c))
+        assert va != vc
+        # memoized per request identity, anchored on the event column:
+        # a select-style view sharing the parent's event array hits the
+        # memo; a re-encoded batch (new arrays) recomputes
+        ba, bc = mk(a), mk(c)
+        k = ("t", None, False, ("rating",))
+        v1 = _batch_version(ba, memo_key=k)
+        view = mk(a)
+        view.event = ba.event  # zero-copy select shares the anchor
+        view.float_props = {"rating": c}  # memo must NOT re-hash
+        assert _batch_version(view, memo_key=k) == v1
+        assert _batch_version(bc, memo_key=k) == vc  # new anchor
 
     def test_bad_secret_rejected(self, served):
         from predictionio_tpu.data.storage import Storage
