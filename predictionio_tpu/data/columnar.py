@@ -383,13 +383,50 @@ class ColumnarBatch:
             idx = idx[: f.limit]
         return self.take(idx, with_props=with_props)
 
-    def shard(self, index: int, count: int) -> "ColumnarBatch":
+    def slice_rows(self, lo: int, hi: int,
+                   with_props: bool = True) -> "ColumnarBatch":
+        """Zero-copy contiguous row range ``[lo, hi)``: basic numpy
+        slicing, so mmap-backed columns touch no pages outside the
+        range — the storage-level shard-pushdown primitive. The props
+        blob stays a view too (offsets are rebased, an O(rows) int64
+        copy, never an O(bytes) blob copy)."""
+        if not 0 <= lo <= hi <= self.n:
+            raise ValueError(f"slice [{lo}, {hi}) of {self.n} rows")
+        if with_props:
+            offs = self.props_offsets[lo:hi + 1] - self.props_offsets[lo]
+            blob = self.props_blob[self.props_offsets[lo]:
+                                   self.props_offsets[hi]]
+        else:
+            offs = np.zeros(hi - lo + 1, dtype=np.int64)
+            blob = np.empty(0, dtype=np.uint8)
+        return ColumnarBatch(
+            event=self.event[lo:hi], entity_type=self.entity_type[lo:hi],
+            entity_id=self.entity_id[lo:hi],
+            target_type=self.target_type[lo:hi],
+            target_id=self.target_id[lo:hi],
+            event_time=self.event_time[lo:hi],
+            props_offsets=offs, props_blob=blob,
+            float_props={k: v[lo:hi]
+                         for k, v in self.float_props.items()},
+            dicts=self.dicts)
+
+    @staticmethod
+    def shard_bounds(n: int, count: int) -> np.ndarray:
+        """The canonical ``count + 1`` split points every backend's
+        ``shard=`` pushdown uses over ``n`` storage-order rows — shards
+        computed by different backends/hosts must tile identically."""
+        return np.linspace(0, n, count + 1).astype(np.int64)
+
+    def shard(self, index: int, count: int,
+              with_props: bool = True) -> "ColumnarBatch":
         """Contiguous host shard ``index`` of ``count`` — the role of
-        ``PEvents``' RDD partitions for multi-host feeding."""
+        ``PEvents``' RDD partitions for multi-host feeding. Zero-copy
+        (see :meth:`slice_rows`)."""
         if not 0 <= index < count:
             raise ValueError(f"shard {index} of {count}")
-        bounds = np.linspace(0, self.n, count + 1).astype(np.int64)
-        return self.take(np.arange(bounds[index], bounds[index + 1]))
+        bounds = self.shard_bounds(self.n, count)
+        return self.slice_rows(int(bounds[index]), int(bounds[index + 1]),
+                               with_props=with_props)
 
     # -- property access ---------------------------------------------------
     def props_json(self, i: int) -> dict:

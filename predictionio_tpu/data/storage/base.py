@@ -26,7 +26,8 @@ import base64
 import uuid
 from dataclasses import dataclass, field, replace
 from datetime import datetime
-from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterable, Iterator, List, Optional, \
+    Sequence, Tuple
 
 from ..datamap import PropertyMap
 from ..event import Event
@@ -168,17 +169,61 @@ class EventStore(abc.ABC):
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       filter: EventFilter = EventFilter(),
                       float_props: Sequence[str] = ("rating",),
-                      ordered: bool = True, with_props: bool = True):
+                      ordered: bool = True, with_props: bool = True,
+                      shard: Optional[Tuple[int, int]] = None):
         """Bulk columnar read — the ``PEvents`` role
         (``data/.../storage/PEvents.scala:38-189``): the whole matching log
         as dictionary-encoded numpy columns ready for device transfer,
         instead of a per-event Python object stream. Backends with a
         persistent columnar sidecar (SQLite) override this; the default
         encodes from :meth:`find`, which is correct everywhere.
-        """
+
+        ``shard=(i, n)`` is the partitioned-scan contract
+        (``JDBCPEvents.scala:49-89``'s time-range split, done by row
+        range): the UNFILTERED storage-order projection is tiled into
+        ``n`` contiguous ranges by ``ColumnarBatch.shard_bounds`` and
+        only range ``i`` is returned — filter/ordering then apply WITHIN
+        the shard, so the union over all shards of a filtered read
+        equals the unsharded filtered read. Backends push the range
+        down (mmap page ranges, SQL row ranges, an HTTP row-range
+        request); this default slices after a full local encode, which
+        is correct but saves no IO. The returned batch carries
+        ``shard_offset`` (global storage-row index of its first row)
+        and ``shard_total`` (global unfiltered row count) so callers
+        can reconstruct global row positions."""
         from ..columnar import columnar_from_events
-        return columnar_from_events(self.find(app_id, channel_id, filter),
-                                    float_props=float_props)
+        batch = columnar_from_events(
+            self.find(app_id, channel_id,
+                      EventFilter() if shard is not None else filter),
+            float_props=float_props)
+        if shard is None:
+            return batch
+        return self._shard_and_select(batch, shard, filter,
+                                      ordered=ordered,
+                                      with_props=with_props)
+
+    @staticmethod
+    def _shard_and_select(batch, shard: Tuple[int, int],
+                          filter: EventFilter, *,
+                          ordered: bool, with_props: bool):
+        """Shared tail of every backend's ``shard=`` path: slice shard
+        ``i`` of ``n`` off the full unfiltered projection (zero-copy),
+        apply the filter within it, and stamp ``shard_offset`` /
+        ``shard_total`` (global row position bookkeeping for the
+        multihost feeding layer; positions are meaningful for the
+        unordered training read — an ``ordered=True`` select reorders
+        rows within the shard)."""
+        from ..columnar import ColumnarBatch
+        i, n = shard
+        if not 0 <= i < n:
+            raise ValueError(f"shard {i} of {n}")
+        bounds = ColumnarBatch.shard_bounds(batch.n, n)
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        sub = batch.slice_rows(lo, hi, with_props=with_props)
+        sub = sub.select(filter, ordered=ordered, with_props=with_props)
+        sub.shard_offset = lo
+        sub.shard_total = batch.n
+        return sub
 
     def aggregate_properties(
             self, app_id: int, channel_id: Optional[int] = None,

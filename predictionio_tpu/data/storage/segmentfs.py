@@ -366,10 +366,17 @@ class SegmentFSEventStore(EventStore):
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       filter: EventFilter = EventFilter(),
                       float_props: Sequence[str] = ("rating",),
-                      ordered: bool = True, with_props: bool = True):
+                      ordered: bool = True, with_props: bool = True,
+                      shard=None):
         batch = self._sync_columnar(app_id, channel_id,
                                     tuple(float_props),
                                     want_props=with_props)
+        if shard is not None:
+            # zero-copy row range over the shared-mount mmap: each pod
+            # host's shard touches only its own segment pages
+            return self._shard_and_select(batch, shard, filter,
+                                          ordered=ordered,
+                                          with_props=with_props)
         return batch.select(filter, ordered=ordered,
                             with_props=with_props)
 

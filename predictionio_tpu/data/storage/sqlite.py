@@ -303,20 +303,29 @@ class SQLiteEventStore(EventStore):
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       filter: EventFilter = EventFilter(),
                       float_props=("rating",),
-                      ordered: bool = True, with_props: bool = True):
+                      ordered: bool = True, with_props: bool = True,
+                      shard=None):
         """Columnar bulk read backed by a persistent segment sidecar
         (``<db>.columnar/<table>/``): the row store stays authoritative;
         immutable numpy segments are synced forward by rowid watermark and
         mmap-loaded, so training-scale scans run at memory bandwidth
         instead of per-row Python (the ``JDBCPEvents.scala:49-89``
-        partitioned-scan role)."""
+        partitioned-scan role). ``shard=(i, n)`` slices the mmap'd
+        projection by row range — pages outside the shard stay
+        untouched (the rowid-range scan, done at the page-cache level)."""
         d = self._columnar_dir(app_id, channel_id)
         if d is None:  # :memory: database — encode per call
             return super().find_columnar(app_id, channel_id, filter,
-                                         float_props)
+                                         float_props, ordered=ordered,
+                                         with_props=with_props,
+                                         shard=shard)
         batch = self._sync_columnar(d, app_id, channel_id,
                                     tuple(float_props),
                                     want_props=with_props)
+        if shard is not None:
+            return self._shard_and_select(batch, shard, filter,
+                                          ordered=ordered,
+                                          with_props=with_props)
         return batch.select(filter, ordered=ordered, with_props=with_props)
 
     def _change_stamp(self) -> tuple:

@@ -85,28 +85,29 @@ class EventStoreFacade:
 
         ``host_sharded=True`` returns only THIS process's contiguous
         slice under a multi-controller runtime (the RDD-partition-per-
-        executor role; single-process it is the identity)."""
+        executor role; single-process it is the identity). The shard is
+        PUSHED DOWN to the storage layer (``shard=(i, n)``): a remote
+        backend transfers only this host's row range, a shared-mount
+        sidecar touches only this host's mmap pages — the shard slices
+        the unfiltered storage-order projection, with the filter
+        applied within it (union over hosts == the unsharded read)."""
         app_id, channel_id = self.resolve(app_name, channel_name)
-        batch = self.storage.events().find_columnar(
-            app_id, channel_id, EventFilter(
-                start_time=start_time, until_time=until_time,
-                entity_type=entity_type, entity_id=entity_id,
-                event_names=event_names,
-                target_entity_type=target_entity_type,
-                target_entity_id=target_entity_id),
-            float_props=float_props, ordered=ordered,
-            with_props=with_props)
+        filt = EventFilter(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id)
+        shard = None
         if host_sharded:
             import jax
 
-            from ..parallel.multihost import host_shard_bounds
-
             if jax.process_count() > 1:  # single-process: identity, free
-                import numpy as _np
-
-                start, stop = host_shard_bounds(batch.n)
-                batch = batch.take(_np.arange(start, stop))
-        return batch
+                shard = (jax.process_index(), jax.process_count())
+        return self.storage.events().find_columnar(
+            app_id, channel_id, filt,
+            float_props=float_props, ordered=ordered,
+            with_props=with_props, shard=shard)
 
     # -- property aggregation (PEventStore.aggregateProperties, :99) -------
     def aggregate_properties(

@@ -82,7 +82,13 @@ def _batch_version(batch, memo_key=None) -> str:
     backend re-encodes."""
     import numpy as np
 
+    # anchor on the ROOT buffer: shard/select views allocate a fresh
+    # view object per request, but all of them chain (.base) back to
+    # the backend's cached parent array / mmap, which is replaced
+    # exactly when the log re-encodes
     anchor = batch.event
+    while getattr(anchor, "base", None) is not None:
+        anchor = anchor.base
     if memo_key is not None:
         with _VER_LOCK:
             ent = _VER_MEMO.get(memo_key)
@@ -209,18 +215,29 @@ def build_app(storage: Storage, secret: Optional[str] = None) -> HTTPApp:
         fp = tuple(p for p in
                    (req.query.get("float_props") or "rating").split(",")
                    if p)
+        shard = None
+        if req.query.get("shard_n"):
+            shard = (int(req.query.get("shard_i", "0")),
+                     int(req.query["shard_n"]))
         batch = storage.events().find_columnar(
             int(req.path_params["app_id"]), chan(req), EventFilter(),
-            float_props=fp, ordered=False, with_props=with_props)
+            float_props=fp, ordered=False, with_props=with_props,
+            shard=shard)
         version = _batch_version(
             batch, memo_key=(int(req.path_params["app_id"]), chan(req),
-                             with_props, fp))
+                             with_props, fp, shard))
+        headers = {"ETag": version}
+        if shard is not None:
+            # global-row bookkeeping for the multihost feeding layer
+            headers["X-Shard-Offset"] = str(
+                getattr(batch, "shard_offset", 0))
+            headers["X-Shard-Total"] = str(
+                getattr(batch, "shard_total", batch.n))
         if hdr(req, "if-none-match") == version:
-            return Response(status=304, body=b"",
-                            headers={"ETag": version})
+            return Response(status=304, body=b"", headers=headers)
         return Response(status=200, body=batch_to_npz(batch),
                         content_type="application/octet-stream",
-                        headers={"ETag": version})
+                        headers=headers)
 
     # -- metadata ----------------------------------------------------------
     @app.route("POST", r"/v1/meta/(?P<dao>[a-z_]+)/(?P<method>[a-z_]+)")
