@@ -425,8 +425,11 @@ class ColumnarBatch:
         if not 0 <= index < count:
             raise ValueError(f"shard {index} of {count}")
         bounds = self.shard_bounds(self.n, count)
-        return self.slice_rows(int(bounds[index]), int(bounds[index + 1]),
-                               with_props=with_props)
+        sub = self.slice_rows(int(bounds[index]), int(bounds[index + 1]),
+                              with_props=with_props)
+        sub.shard_offset = int(bounds[index])
+        sub.shard_total = self.n
+        return sub
 
     # -- property access ---------------------------------------------------
     def props_json(self, i: int) -> dict:

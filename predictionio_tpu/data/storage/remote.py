@@ -241,9 +241,18 @@ class RemoteEventStore(EventStore):
         else:
             batch = batch_from_npz(body)
             if shard is not None:
-                batch.shard_offset = int(lower.get("x-shard-offset", 0))
-                batch.shard_total = int(lower.get("x-shard-total",
-                                                  batch.n))
+                if "x-shard-total" not in lower:
+                    # a pre-shard server ignores the query params and
+                    # returns the FULL log — treating that as a shard
+                    # would feed every rating N times across a pod
+                    # (silently wrong factors). Fail loudly.
+                    raise StorageError(
+                        "storage server ignored the shard request "
+                        "(no X-Shard-Total header) — server too old "
+                        "for shard pushdown; upgrade it or read "
+                        "unsharded")
+                batch.shard_offset = int(lower["x-shard-offset"])
+                batch.shard_total = int(lower["x-shard-total"])
             with self.c.lock:
                 self.c.columnar_cache[key] = (lower.get("etag"), batch)
         out = batch.select(filter, ordered=ordered,

@@ -37,6 +37,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         int(os.environ.get("PIO_NUM_PROCESSES", "0")) or None
     pid = process_id if process_id is not None else \
         int(os.environ.get("PIO_PROCESS_ID", "-1"))
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        # CPU-host pods (and tests): cross-process collectives need the
+        # gloo backend; must be configured before the backend exists
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception as e:  # noqa: BLE001 — older/newer jax
+            log.debug("gloo collectives config unavailable: %s", e)
     if coordinator is None and n is None:
         # single-process or TPU-pod auto-detect path
         try:
@@ -85,3 +93,108 @@ def from_process_local(local: np.ndarray, mesh, spec) -> "object":
 
     return jax.make_array_from_process_local_data(
         NamedSharding(mesh, spec), local)
+
+
+# ---------------------------------------------------------------------------
+# Host-side collectives for the sharded training read
+# ---------------------------------------------------------------------------
+#
+# The storage layer hands each pod host 1/N of the log (``find_columnar
+# (shard=(i, n))``); assembling per-factor-row histories from that needs
+# a shuffle — the role Spark's exchange played in the reference. Here it
+# rides the SAME collective fabric training uses (gloo on CPU hosts,
+# ICI/DCN on pods), which is exactly where a TPU system wants bulk
+# redistribution: storage bandwidth is the scarce resource, fabric
+# bandwidth the abundant one. All helpers are SPMD-collective: every
+# process must call them at the same point with same-shaped inputs.
+# Payloads cross as raw bytes so int64 survives JAX's default-32-bit
+# lowering.
+
+
+def _allgather_parts(x: np.ndarray) -> list:
+    """Collective: every process's same-shaped ``x``, in process order,
+    dtype preserved exactly."""
+    import jax
+
+    x = np.ascontiguousarray(x)
+    if jax.process_count() == 1:
+        return [x]
+    from jax.experimental import multihost_utils
+
+    raw = np.frombuffer(x.tobytes(), dtype=np.uint8)
+    g = np.asarray(multihost_utils.process_allgather(raw))
+    return [np.frombuffer(g[p].tobytes(), dtype=x.dtype)
+            .reshape(x.shape) for p in range(g.shape[0])]
+
+
+def broadcast_str(s: str, max_len: int = 256) -> str:
+    """Collective: process 0's string to everyone (the engine-instance
+    id a single-writer workflow mints on process 0 and every process
+    needs for manifest paths/logging)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return s
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(max_len, np.uint8)
+    b = s.encode("utf-8")[:max_len]
+    buf[:len(b)] = np.frombuffer(b, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return bytes(out[out != 0]).decode("utf-8")
+
+
+def allreduce_sum(x: np.ndarray) -> np.ndarray:
+    """Collective element-wise sum across processes — the per-code
+    count agreement that lets every host derive IDENTICAL factor-row
+    indexation from its 1/N storage shard."""
+    parts = _allgather_parts(np.ascontiguousarray(x))
+    if len(parts) == 1:
+        return parts[0]
+    return np.sum(parts, axis=0, dtype=x.dtype)
+
+
+def exchange_filtered(arrays: Sequence[np.ndarray], keep,
+                      chunk: int = 4_000_000) -> list:
+    """Collective shuffle with bounded memory: every process
+    contributes parallel 1-D ``arrays`` (its local rows, any length —
+    lengths may differ across processes); every process receives, for
+    EVERY process's rows in process-then-local order, the subset where
+    ``keep(first_array_chunk, ...)`` → bool mask. Rounds are fixed-size
+    (``chunk`` rows, padded), so peak transient memory is
+    ``n_proc × chunk`` rows + the kept output, never the global log.
+
+    Returns the kept columns as a list of concatenated arrays (same
+    order/dtypes as ``arrays``)."""
+    import jax
+
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    n_local = len(arrays[0])
+    assert all(len(a) == n_local for a in arrays), "parallel arrays"
+    if jax.process_count() == 1:
+        m = keep(*arrays)
+        return [a[m] for a in arrays]
+    lens = _allgather_parts(np.asarray([n_local], dtype=np.int64))
+    rounds = int(max(int(p[0]) for p in lens) + chunk - 1) // chunk
+    outs: list = [[] for _ in arrays]
+    for r in range(rounds):
+        lo = r * chunk
+        padded = []
+        for a in arrays:
+            part = a[lo:lo + chunk]
+            if len(part) < chunk:
+                pad = np.zeros(chunk - len(part), dtype=a.dtype)
+                part = np.concatenate([part, pad])
+            padded.append(part)
+        gathered = [_allgather_parts(p) for p in padded]
+        for p in range(len(lens)):
+            valid = min(max(int(lens[p][0]) - lo, 0), chunk)
+            if valid == 0:
+                continue
+            cols = [g[p][:valid] for g in gathered]
+            m = keep(*cols)
+            for o, c in zip(outs, cols):
+                o.append(c[m])
+    return [np.concatenate(o) if o else
+            np.empty(0, dtype=a.dtype)
+            for o, a in zip(outs, arrays)]

@@ -111,6 +111,15 @@ def _batch_version(batch, memo_key=None) -> str:
         except TypeError:
             ref = lambda: None  # noqa: E731 — non-ndarray anchors
         with _VER_LOCK:
+            if len(_VER_MEMO) >= 4096:
+                # keys carry client-controlled params (float_props,
+                # shard_n): bound the table. Dead-anchor entries go
+                # first; an adversarial residue is dropped wholesale.
+                for k in [k for k, (r, _) in _VER_MEMO.items()
+                          if r() is None]:
+                    del _VER_MEMO[k]
+                if len(_VER_MEMO) >= 4096:
+                    _VER_MEMO.clear()
             _VER_MEMO[memo_key] = (ref, version)
     return version
 
@@ -217,8 +226,14 @@ def build_app(storage: Storage, secret: Optional[str] = None) -> HTTPApp:
                    if p)
         shard = None
         if req.query.get("shard_n"):
-            shard = (int(req.query.get("shard_i", "0")),
-                     int(req.query["shard_n"]))
+            try:
+                shard = (int(req.query.get("shard_i", "0")),
+                         int(req.query["shard_n"]))
+            except ValueError:
+                raise HTTPError(400, "shard_i/shard_n must be integers")
+            if not 0 <= shard[0] < shard[1]:
+                raise HTTPError(400,
+                                f"shard {shard[0]} of {shard[1]}")
         batch = storage.events().find_columnar(
             int(req.path_params["app_id"]), chan(req), EventFilter(),
             float_props=fp, ordered=False, with_props=with_props,

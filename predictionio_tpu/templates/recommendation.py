@@ -78,12 +78,17 @@ class PredictedResult:
 
 @dataclass
 class TrainingData(SanityCheck):
+    #: a :class:`RatingsCOO`, or (multihost) a sharded ratings source
+    #: (``read_rows``/``row_counts`` — duck-typed through the pack)
     ratings: RatingsCOO
     user_ids: object  # BiMap
     item_ids: object  # BiMap
 
     def sanity_check(self):
-        if self.ratings.users.size == 0:
+        r = self.ratings
+        nnz = (int(np.asarray(r.row_counts("user")).sum())
+               if hasattr(r, "row_counts") else r.users.size)
+        if nnz == 0:
             raise ValueError("TrainingData has no ratings; check that "
                              "rate/buy events exist for the app")
 
@@ -122,7 +127,10 @@ class RecommendationDataSource(DataSource):
         self.params = params
 
     def _read_ratings(self, ctx: Context):
+        import jax
+
         weights = self.params.event_weights
+        multihost = jax.process_count() > 1
         batch = ctx.event_store.find_columnar(
             self.params.app_name or ctx.app_name,
             channel_name=self.params.channel_name,
@@ -130,7 +138,17 @@ class RecommendationDataSource(DataSource):
             event_names=(list(weights) if weights is not None
                          else ["rate", "buy"]),
             # a bulk COO build needs neither time order nor raw JSON
-            ordered=False, with_props=False)
+            ordered=False, with_props=False,
+            # multihost: the storage layer hands this process ONLY its
+            # shard (shard pushdown — a remote backend ships 1/N of the
+            # bytes); the sharded source below re-assembles per-factor-
+            # row triples over the collective fabric
+            host_sharded=multihost)
+        if multihost:
+            from ..models.data import ShardedColumnarRatingsSource
+            src = ShardedColumnarRatingsSource(
+                batch, event_weights=weights)
+            return src, src.user_ids, src.item_ids
         return ratings_from_columnar(batch, event_weights=weights)
 
     def read_training(self, ctx: Context) -> TrainingData:
@@ -146,6 +164,11 @@ class RecommendationDataSource(DataSource):
         if p.eval_k <= 1:
             raise ValueError("eval_k must be >= 2 for read_eval")
         ratings, user_ids, item_ids = self._read_ratings(ctx)
+        if hasattr(ratings, "to_coo"):
+            # k-fold splitting slices entry arrays; materialize the
+            # global COO (collective under multihost — eval is not the
+            # memory-bound path training is)
+            ratings = ratings.to_coo()
         inv_u = user_ids.inverse
         inv_i = item_ids.inverse
         folds = []

@@ -44,26 +44,43 @@ def run_train(ctx: Context, engine: Engine, engine_params: EngineParams,
               engine_id: str = "default", engine_version: str = "1",
               engine_variant: str = "engine.json",
               engine_factory: str = "") -> str:
-    """Train and persist: returns the COMPLETED engine-instance id."""
+    """Train and persist: returns the COMPLETED engine-instance id.
+
+    Multihost (``jax.process_count() > 1``): run_train is SPMD — every
+    process executes the collective parts (training, the replicating
+    ``to_host`` inside ``make_persistent_model``) — but process 0 is
+    the SINGLE WRITER of engine-instance metadata and the model blob
+    (the driver-program role of ``CoreWorkflow.scala:45-102``): the
+    instance transitions INIT→COMPLETED exactly once however many
+    hosts train."""
     import json as _json
 
+    import jax
+
+    from ..parallel.multihost import broadcast_str
+
+    is_writer = jax.process_count() == 1 or jax.process_index() == 0
     storage = ctx.storage
     instances = storage.engine_instances()
     ep = engine_params
-    instance = EngineInstance(
-        id="", status=STATUS_INIT, start_time=_now(), end_time=_now(),
-        engine_id=engine_id, engine_version=engine_version,
-        engine_variant=engine_variant, engine_factory=engine_factory,
-        batch=ctx.batch,
-        data_source_params=_json.dumps(
-            {ep.datasource[0]: params_to_json(ep.datasource[1])}),
-        preparator_params=_json.dumps(
-            {ep.preparator[0]: params_to_json(ep.preparator[1])}),
-        algorithms_params=_json.dumps(
-            [{name: params_to_json(p)} for name, p in ep.algorithms]),
-        serving_params=_json.dumps(
-            {ep.serving[0]: params_to_json(ep.serving[1])}))
-    instance_id = instances.insert(instance)
+    instance_id = ""
+    if is_writer:
+        instance = EngineInstance(
+            id="", status=STATUS_INIT, start_time=_now(),
+            end_time=_now(),
+            engine_id=engine_id, engine_version=engine_version,
+            engine_variant=engine_variant, engine_factory=engine_factory,
+            batch=ctx.batch,
+            data_source_params=_json.dumps(
+                {ep.datasource[0]: params_to_json(ep.datasource[1])}),
+            preparator_params=_json.dumps(
+                {ep.preparator[0]: params_to_json(ep.preparator[1])}),
+            algorithms_params=_json.dumps(
+                [{name: params_to_json(p)} for name, p in ep.algorithms]),
+            serving_params=_json.dumps(
+                {ep.serving[0]: params_to_json(ep.serving[1])}))
+        instance_id = instances.insert(instance)
+    instance_id = broadcast_str(instance_id)
     log.info("engine instance %s: training started", instance_id)
 
     result = engine.train(ctx, engine_params)
@@ -75,13 +92,15 @@ def run_train(ctx: Context, engine: Engine, engine_params: EngineParams,
     algos = engine.make_algorithms(engine_params)
     stored: List[Any] = []
     for i, (algo, model) in enumerate(zip(algos, result.models)):
+        # collective on every process (replicates sharded leaves)
         stored.append(algo.make_persistent_model(model, instance_id, i))
-    storage.models().insert(
-        Model(id=instance_id, models=persistence.dumps_models(stored)))
-
-    done = instances.get(instance_id)
-    assert done is not None
-    instances.update(done.copy(status=STATUS_COMPLETED, end_time=_now()))
+    if is_writer:
+        storage.models().insert(
+            Model(id=instance_id, models=persistence.dumps_models(stored)))
+        done = instances.get(instance_id)
+        assert done is not None
+        instances.update(done.copy(status=STATUS_COMPLETED,
+                                   end_time=_now()))
     log.info("engine instance %s: training completed", instance_id)
     return instance_id
 

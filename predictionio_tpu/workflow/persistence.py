@@ -19,10 +19,28 @@ import jax
 import numpy as np
 
 
+def _leaf_to_host(x):
+    if not isinstance(x, jax.Array):
+        return x
+    if x.is_fully_addressable:
+        return np.asarray(x)
+    # multihost: a globally-sharded array has remote shards — replicate
+    # through the compiled program (XLA all-gather over the fabric),
+    # then read the local copy. COLLECTIVE: every process must reach
+    # this point (run_train is SPMD — all processes persist together,
+    # only process 0 writes the blob).
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = x.sharding.mesh
+    rep = jax.jit(lambda a: a,
+                  out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+    return np.asarray(rep.addressable_data(0))
+
+
 def to_host(model: Any) -> Any:
-    """Replace every jax.Array leaf with numpy (pickle-safe)."""
-    return jax.tree.map(
-        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, model)
+    """Replace every jax.Array leaf with numpy (pickle-safe); multihost
+    sharded leaves are replicated collectively first."""
+    return jax.tree.map(_leaf_to_host, model)
 
 
 def to_device(model: Any) -> Any:

@@ -691,6 +691,39 @@ class TestColumnarRatingsSource:
             src.row_counts("user"),
             np.bincount(ref.users, minlength=ref.n_users))
 
+    def test_sharded_source_single_process_identity(self):
+        """ShardedColumnarRatingsSource (v3: storage shard + collective
+        shuffle) under ONE process: shard (0, 1) is the whole log, the
+        exchange is the identity, and every read must match the plain
+        source — including global-storage-order restoration (order
+        affects max_history truncation)."""
+        from predictionio_tpu.models.data import (
+            ColumnarRatingsSource,
+            ShardedColumnarRatingsSource,
+        )
+        batch = self._batch()
+        batch.shard_offset = 0
+        plain = ColumnarRatingsSource(batch, chunk=64)
+        sharded = ShardedColumnarRatingsSource(batch, chunk=64,
+                                               exchange_chunk=97)
+        assert sharded.n_users == plain.n_users
+        assert sharded.n_items == plain.n_items
+        np.testing.assert_array_equal(sharded.row_counts("user"),
+                                      plain.row_counts("user"))
+        for side, a, b in (("user", 7, 23), ("item", 0, plain.n_items)):
+            r1, c1, v1 = plain.read_rows(side, a, b)
+            r2, c2, v2 = sharded.read_rows(side, a, b)
+            np.testing.assert_array_equal(r1, r2)  # exact order match
+            np.testing.assert_array_equal(c1, c2)
+            np.testing.assert_array_equal(v1, v2)
+        mask = np.zeros(plain.n_users, dtype=bool)
+        mask[::3] = True
+        r1, c1, v1 = plain.read_row_mask("user", mask)
+        r2, c2, v2 = sharded.read_row_mask("user", mask)
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(v1, v2)
+
     def test_buy_weight_and_nan_rating_semantics(self):
         from predictionio_tpu.data.columnar import (
             ColumnarDicts,

@@ -121,6 +121,54 @@ WORKER = textwrap.dedent("""
     Ub_full = np.asarray(rep(Ub).addressable_data(0))
     Vb_full = np.asarray(rep(Vb).addressable_data(0))
 
+    # v4: FULL shard pushdown — each process holds ONLY its storage
+    # shard (1/2 of the log's rows), agrees on indexation via the
+    # count-allreduce, and re-assembles factor-row triples through the
+    # chunked gloo shuffle (exchange_filtered). Factors must match the
+    # v1 run (same problem, same indexation) tightly — the shuffle
+    # restores global storage order, so packing is identical.
+    from predictionio_tpu.models.data import ShardedColumnarRatingsSource
+
+    my_shard = batch.shard(pid, 2, with_props=False)
+    assert my_shard.n < nnz, (my_shard.n, nnz)
+    src4 = ShardedColumnarRatingsSource(my_shard, chunk=113,
+                                        exchange_chunk=151)
+    assert src4.n_users == src.n_users and src4.n_items == src.n_items
+    packed4 = pack_ratings_multihost(src4, params, mesh)
+    U4, V4 = train_als(None, params, mesh=mesh, packed=packed4)
+    U4_full = np.asarray(rep(U4).addressable_data(0))
+    V4_full = np.asarray(rep(V4).addressable_data(0))
+    np.testing.assert_allclose(U4_full, U3_full, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(V4_full, V3_full, rtol=1e-4, atol=1e-5)
+
+    # v4 bucketed: the drop-free layout's arbitrary row masks through
+    # the shuffle
+    batch_b = columnar_from_columns(
+        ColumnarDicts(),
+        ["rate"] * nnz2, ["user"] * nnz2,
+        [f"u{u:05d}" for u in r2.users],
+        ["item"] * nnz2,
+        [f"i{i:05d}" for i in r2.items],
+        np.arange(nnz2, dtype=np.int64),
+        [None] * nnz2, float_props=())
+    batch_b.float_props["rating"] = r2.ratings.astype(np.float64)
+    src4b = ShardedColumnarRatingsSource(batch_b.shard(pid, 2),
+                                         exchange_chunk=173)
+    packed4b = pack_ratings_multihost(src4b, params2, mesh)
+    U4b, V4b = train_als(None, params2, mesh=mesh, packed=packed4b)
+    U4b_full = np.asarray(rep(U4b).addressable_data(0))
+    V4b_full = np.asarray(rep(V4b).addressable_data(0))
+    # baseline with the SAME (code-order) indexation: the full batch's
+    # COO fed the v1 way (plain source — no collective, every process
+    # derives it locally)
+    coo_b = ColumnarRatingsSource(batch_b).to_coo()
+    packed5b = pack_ratings_multihost(coo_b, params2, mesh)
+    U5b, V5b = train_als(None, params2, mesh=mesh, packed=packed5b)
+    U5b_full = np.asarray(rep(U5b).addressable_data(0))
+    V5b_full = np.asarray(rep(V5b).addressable_data(0))
+    np.testing.assert_allclose(U4b_full, U5b_full, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(V4b_full, V5b_full, rtol=1e-4, atol=1e-5)
+
     if pid == 0:
         np.save(os.path.join(outdir, "U.npy"), U_full)
         np.save(os.path.join(outdir, "V.npy"), V_full)

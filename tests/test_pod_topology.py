@@ -1,0 +1,197 @@
+"""The pod topology end-to-end (VERDICT r3 task 4): one storage server
++ N=4 ``ptpu train`` processes driven through the REAL CLI
+(``PIO_COORDINATOR``/``PIO_NUM_PROCESSES`` envs, gloo collectives, 2
+virtual CPU devices per process), REMOTE backend with shard pushdown.
+
+Asserts the whole ``docs/deployment.md`` story at once:
+- every worker exits 0; factors match the single-process CLI run;
+- each worker transferred ~1/4 of the log's columnar bytes (the shard
+  pushdown actually engaged over the wire);
+- engine-instance metadata transitioned INIT→COMPLETED exactly once
+  (single-writer workflow), one model blob.
+
+The reference never had a test like this — its multi-node story needed
+a real Spark cluster (SURVEY §4 "Multi-node without a cluster: they
+don't").
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+
+    pid = int(sys.argv[1])
+    outdir = sys.argv[2]
+    engine_json = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    # count the bulk-read bytes this worker pulls off the wire
+    from predictionio_tpu.data.storage import remote
+    real = remote.RemoteClient.request
+    stats = {"columnar_bytes": 0}
+    def wrapped(self, method, path, body=None, **kw):
+        st, hd, bd = real(self, method, path, body, **kw)
+        if "/columnar" in path:
+            stats["columnar_bytes"] += len(bd or b"")
+        return st, hd, bd
+    remote.RemoteClient.request = wrapped
+
+    from predictionio_tpu.cli import main
+    rc = main(["train", "--engine-json", engine_json])
+    json.dump({"rc": rc, "pid": pid, **stats},
+              open(os.path.join(outdir, f"worker{pid}.json"), "w"))
+    sys.exit(rc)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _remote_env(port: int) -> dict:
+    return {
+        "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{port}",
+        "PIO_STORAGE_SOURCES_NET_SECRET": "podsecret",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+    }
+
+
+def test_four_process_cli_train_over_storage_server(tmp_path):
+    from conftest import start_sqlite_backed_storage_server
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, Storage
+
+    srv, _ = start_sqlite_backed_storage_server(tmp_path,
+                                                secret="podsecret")
+    try:
+        env_remote = _remote_env(srv.port)
+        s = Storage(env=env_remote)
+        app_id = s.apps().insert(App(0, "PodApp"))
+        s.events().init(app_id)
+        rng = np.random.default_rng(11)
+        n = 1500
+        s.events().insert_batch(
+            [Event(event="rate", entity_type="user",
+                   entity_id=f"u{int(u)}", target_entity_type="item",
+                   target_entity_id=f"i{int(i)}",
+                   properties=DataMap({"rating": float(r)}))
+             for u, i, r in zip(rng.integers(0, 60, n),
+                                rng.integers(0, 30, n),
+                                rng.integers(1, 6, n))], app_id)
+
+        engine_json = tmp_path / "engine.json"
+        engine_json.write_text(json.dumps({
+            "id": "podrec", "version": "1",
+            "engineFactory": "predictionio_tpu.templates."
+                             "recommendation:recommendation_engine",
+            "datasource": {"params": {"app_name": "PodApp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "num_iterations": 2, "reg": 0.05,
+                "seed": 5}}],
+        }))
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER)
+
+        coord_port = _free_port()
+        base_env = {k: v for k, v in os.environ.items()
+                    if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        base_env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + base_env.get("PYTHONPATH", "").split(os.pathsep))
+        base_env.update(env_remote)
+        base_env.update({
+            "PIO_COORDINATOR": f"127.0.0.1:{coord_port}",
+            "PIO_NUM_PROCESSES": "4",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        })
+        procs = []
+        for pid in range(4):
+            env = dict(base_env)
+            env["PIO_PROCESS_ID"] = str(pid)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker), str(pid), str(tmp_path),
+                 str(engine_json)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+        # metadata: INIT→COMPLETED exactly once, one model blob
+        instances = [i for i in s.engine_instances().get_all()]
+        assert len(instances) == 1, [
+            (i.id, i.status) for i in instances]
+        inst = instances[0]
+        assert inst.status == "COMPLETED"
+        blob = s.models().get(inst.id)
+        assert blob is not None
+
+        from predictionio_tpu.workflow import persistence
+        model_multi = persistence.loads_models(blob.models)[0]
+
+        # single-process reference through the SAME CLI against the
+        # same storage (its own instance id; remove multihost envs)
+        env1 = dict(base_env)
+        for k in ("PIO_COORDINATOR", "PIO_NUM_PROCESSES",
+                  "PIO_PROCESS_ID"):
+            env1.pop(k, None)
+        p1 = subprocess.run(
+            [sys.executable, str(worker), "9", str(tmp_path),
+             str(engine_json)],
+            env=env1, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=420)
+        assert p1.returncode == 0, p1.stdout.decode()[-4000:]
+        instances2 = [i for i in s.engine_instances().get_all()]
+        assert len(instances2) == 2
+        single_id = next(i.id for i in instances2 if i.id != inst.id)
+        model_single = persistence.loads_models(
+            s.models().get(single_id).models)[0]
+
+        # factors match after aligning rows by entity-id string (both
+        # runs index by ascending dictionary code — same sidecar, same
+        # codes — so this should be the identity permutation, but align
+        # anyway to keep the assertion about MATH, not layout)
+        for side, attr in (("user_ids", "user_factors"),
+                           ("item_ids", "item_factors")):
+            ids_m = getattr(model_multi, side)
+            ids_s = getattr(model_single, side)
+            assert set(ids_m) == set(ids_s)
+            fm = np.asarray(getattr(model_multi, attr))
+            fs = np.asarray(getattr(model_single, attr))
+            perm_m = [ids_m[k] for k in sorted(ids_m)]
+            perm_s = [ids_s[k] for k in sorted(ids_s)]
+            np.testing.assert_allclose(fm[perm_m], fs[perm_s],
+                                       rtol=2e-3, atol=2e-4)
+
+        # shard pushdown engaged: each worker pulled ~1/4 of the bytes
+        # the single-process run pulled
+        single_bytes = json.load(
+            open(tmp_path / "worker9.json"))["columnar_bytes"]
+        for pid in range(4):
+            wb = json.load(
+                open(tmp_path / f"worker{pid}.json"))["columnar_bytes"]
+            assert wb <= 0.4 * single_bytes, \
+                (pid, wb, single_bytes)
+    finally:
+        srv.shutdown()

@@ -857,6 +857,30 @@ class TestRemoteBackend:
         with pytest.raises(ValueError):
             s.events().find_columnar(app_id, float_props=("a,b",))
 
+    def test_shard_request_against_preshard_server_fails_loudly(
+            self, served):
+        """A pre-shard server ignores shard_i/shard_n and returns the
+        FULL log; the client must raise (treating it as a shard would
+        feed every rating N times across a pod), not proceed."""
+        from predictionio_tpu.data.storage import App, Storage
+        from predictionio_tpu.data.storage.base import StorageError
+        s = Storage(env=self._env(served))
+        app_id = s.apps().insert(App(0, "netold"))
+        s.events().init(app_id)
+        s.events().insert_batch(self._events(12), app_id)
+        es = s.events()
+        real = es.c.request
+
+        def old_server(method, path, body=None, **kw):
+            # strip the shard params the way an old server ignores them
+            path = path.split("&shard_i=")[0]
+            st, hd, bd = real(method, path, body, **kw)
+            return st, {k: v for k, v in hd.items()
+                        if not k.lower().startswith("x-shard")}, bd
+        es.c.request = old_server
+        with pytest.raises(StorageError, match="shard"):
+            es.find_columnar(app_id, ordered=False, shard=(0, 4))
+
     def test_etag_full_content_hash(self):
         """Two same-length, same-sum batches differing only at
         positions a strided sample misses must get DIFFERENT ETags
