@@ -158,11 +158,18 @@ def exchange_filtered(arrays: Sequence[np.ndarray], keep,
                       chunk: int = 4_000_000) -> list:
     """Collective shuffle with bounded memory: every process
     contributes parallel 1-D ``arrays`` (its local rows, any length —
-    lengths may differ across processes); every process receives, for
-    EVERY process's rows in process-then-local order, the subset where
-    ``keep(first_array_chunk, ...)`` → bool mask. Rounds are fixed-size
-    (``chunk`` rows, padded), so peak transient memory is
-    ``n_proc × chunk`` rows + the kept output, never the global log.
+    lengths may differ across processes); every process receives the
+    union of every process's rows where ``keep(*column_chunks)`` → bool
+    mask. Rounds are fixed-size (``chunk`` rows, padded), so peak
+    transient memory is ``n_proc × chunk`` rows + the kept output,
+    never the global log.
+
+    ORDER IS NOT GUARANTEED: output is round-interleaved
+    (``[p0 chunk0, p1 chunk0, ..., p0 chunk1, ...]``), so any caller
+    that needs a deterministic order must carry a position column
+    through the shuffle and sort on it afterwards (as
+    ``ShardedColumnarRatingsSource`` does — packing truncation is
+    order-sensitive).
 
     Returns the kept columns as a list of concatenated arrays (same
     order/dtypes as ``arrays``)."""

@@ -11,12 +11,24 @@ serving/eval code puts them on a mesh). Custom persistence (the reference's
 
 from __future__ import annotations
 
+import functools
 import io
 import pickle
 from typing import Any, List
 
 import jax
 import numpy as np
+
+
+@functools.lru_cache(maxsize=8)
+def _replicator(mesh):
+    """One compiled identity-with-replication program per mesh — a
+    fresh ``jax.jit(lambda ...)`` per leaf would recompile the
+    all-gather for every sharded leaf of every persist."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.jit(lambda a: a,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
 def _leaf_to_host(x):
@@ -29,11 +41,7 @@ def _leaf_to_host(x):
     # then read the local copy. COLLECTIVE: every process must reach
     # this point (run_train is SPMD — all processes persist together,
     # only process 0 writes the blob).
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    mesh = x.sharding.mesh
-    rep = jax.jit(lambda a: a,
-                  out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+    rep = _replicator(x.sharding.mesh)(x)
     return np.asarray(rep.addressable_data(0))
 
 
