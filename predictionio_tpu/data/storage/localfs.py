@@ -166,7 +166,12 @@ class LocalFSEventStore(EventStore):
         ``expected_size`` is given (the size our replay cache is based on)
         and another process appended in between, returns None — the caller
         must invalidate its cache instead of publishing a live-set that
-        silently misses the other process's events."""
+        silently misses the other process's events.
+
+        The whole payload goes through ONE ``write`` call: a crashed
+        writer leaves at most one torn trailing line (which replay
+        detects and truncates), never a valid prefix of a multi-record
+        append."""
         with _flock(path):
             clean = True
             if expected_size is not None:
@@ -176,8 +181,7 @@ class LocalFSEventStore(EventStore):
                     current = 0  # about to be created by the append
                 clean = current == max(expected_size, 0)
             with open(path, "a", encoding="utf-8") as f:
-                for r in records:
-                    f.write(json.dumps(r) + "\n")
+                f.write("".join(json.dumps(r) + "\n" for r in records))
                 f.flush()
                 return f.tell() if clean else None
 
@@ -192,13 +196,22 @@ class LocalFSEventStore(EventStore):
             live, dead = self._state(path)
             cached = self.c.event_cache.get(path)
             prior_size = cached[0] if cached is not None else -1
-            records, ids, stored_events = [], [], []
+            ids, stored_events = [], []
             for e in events:
                 eid = e.event_id or uuid.uuid4().hex
                 stored = e.copy(event_id=eid)
-                records.append({"op": "put", "event": stored.to_json()})
                 stored_events.append(stored)
                 ids.append(eid)
+            # ONE "putb" record per batch = one log line = one write
+            # call: a process killed mid-insert leaves the batch fully
+            # present or (as a truncated torn tail) fully absent —
+            # never a committed prefix of fresh ids (the all-or-nothing
+            # insert_batch contract under crashes, not just exceptions)
+            records = [{"op": "putb",
+                        "events": [s.to_json() for s in stored_events]}] \
+                if len(stored_events) > 1 else \
+                [{"op": "put", "event": stored_events[0].to_json()}] \
+                if stored_events else []
             # disk first: a failed append must not leave ghost events in
             # the cache
             size = self._append(path, records, expected_size=prior_size)
@@ -225,30 +238,79 @@ class LocalFSEventStore(EventStore):
         import time as _time
         out: Dict[str, Event] = {}
         dead = 0
+
+        def apply(rec: dict) -> int:
+            """Replay one record; returns dead-record delta."""
+            d = 0
+            if rec["op"] == "put":
+                e = Event.from_json(rec["event"])
+                if e.event_id in out:
+                    d += 1
+                out[e.event_id] = e
+            elif rec["op"] == "putb":  # atomic batch (one line)
+                for doc in rec["events"]:
+                    e = Event.from_json(doc)
+                    if e.event_id in out:
+                        d += 1
+                    out[e.event_id] = e
+            elif rec["op"] == "del":
+                if out.pop(rec["eventId"], None) is not None:
+                    d += 2  # the put and the tombstone
+                else:
+                    d += 1
+            return d
+
         if size >= 0:
             # flock against cross-process writers: without it a reader can
             # see a torn trailing record mid-flush and crash on json.loads
-            with _flock(path), open(path, "r", encoding="utf-8") as f:
+            with _flock(path), open(path, "rb") as f:
                 size = os.path.getsize(path)  # re-stat now that we hold it
-                for ln, line in enumerate(f):
+                offset = 0
+                truncate_to = None
+                needs_newline = False
+                ln = 0
+                while True:
+                    line = f.readline()  # streamed, never the whole file
+                    if not line:
+                        break
+                    ln += 1
                     if deadline is not None and ln % 4096 == 0 \
                             and _time.monotonic() > deadline:
                         raise TimeoutError(
                             "event-log replay exceeded its deadline")
-                    line = line.strip()
-                    if not line:
-                        continue
-                    rec = json.loads(line)
-                    if rec["op"] == "put":
-                        e = Event.from_json(rec["event"])
-                        if e.event_id in out:
-                            dead += 1
-                        out[e.event_id] = e
-                    elif rec["op"] == "del":
-                        if out.pop(rec["eventId"], None) is not None:
-                            dead += 2  # the put and the tombstone
-                        else:
-                            dead += 1
+                    has_nl = line.endswith(b"\n")
+                    s = line.strip()
+                    if s:
+                        try:
+                            rec = json.loads(s)
+                        except json.JSONDecodeError:
+                            if not has_nl:
+                                # newline-less torn trailing line — the
+                                # residue of a writer killed mid-append
+                                # (the newline is the LAST byte of every
+                                # committed append, so a record whose
+                                # newline landed can never be torn-
+                                # writer residue). Drop it AND truncate,
+                                # or the next append would concatenate
+                                # onto the partial line and corrupt the
+                                # log permanently.
+                                truncate_to = offset
+                                break
+                            raise  # committed-line corruption: surface
+                        dead += apply(rec)
+                        if not has_nl:
+                            # parsed fine but the newline never landed:
+                            # patch it so the next append starts fresh
+                            needs_newline = True
+                    offset += len(line)
+                if truncate_to is not None:
+                    with open(path, "r+b") as wf:
+                        wf.truncate(truncate_to)
+                    size = truncate_to
+                elif needs_newline:
+                    with open(path, "ab") as wf:
+                        wf.write(b"\n")
+                    size += 1
         if dead > max(len(out), 16):
             compacted = self._compact(path, out, size)
             if compacted is not None:
