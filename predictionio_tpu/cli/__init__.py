@@ -20,6 +20,7 @@ import importlib
 import json
 import os
 import sys
+import time
 from typing import Any, List, Optional
 
 from .. import __version__
@@ -437,6 +438,192 @@ def cmd_dashboard(args, storage: Storage) -> int:
     return 0
 
 
+#: servers `start-all` supervises: name → (default port, needs_secret)
+_START_ALL = {
+    "eventserver": (7070, False),
+    "adminserver": (7071, False),
+    "dashboard": (9000, False),
+    "storageserver": (7077, True),
+}
+
+
+def _pid_dir(args) -> str:
+    d = os.path.expanduser(getattr(args, "pid_dir", "") or
+                           os.environ.get("PIO_PID_DIR", "~/.ptpu"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _pid_alive(pid: int) -> bool:
+    # if the process is OUR child, reap a potential zombie first —
+    # kill(pid, 0) succeeds on zombies, which would read as "alive"
+    # forever when start-all and stop-all share a process (tests,
+    # embedding); standalone CLIs never are the parent and the
+    # waitpid is a cheap no-op error
+    try:
+        os.waitpid(pid, os.WNOHANG)
+    except ChildProcessError:
+        pass
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def cmd_start_all(args, storage: Storage) -> int:
+    """``ptpu start-all`` — the ``bin/pio-start-all`` role
+    (``/root/reference/bin/pio-start-all:1-30``) for bare-metal
+    operators: spawn the long-running servers as daemons with pidfiles
+    and per-server logs, wait for each to answer its port, report.
+    Docker users get the same topology from docker/docker-compose.yml;
+    this is the no-docker path."""
+    import socket
+    import subprocess
+
+    d = _pid_dir(args)
+    names = ["eventserver", "adminserver", "dashboard"]
+    if args.with_storageserver:
+        names.insert(0, "storageserver")
+    started, failed = [], []
+    ports = {"eventserver": args.event_port,
+             "adminserver": args.admin_port,
+             "dashboard": args.dash_port,
+             "storageserver": args.storage_port}
+    for name in names:
+        port = ports[name] or _START_ALL[name][0]
+        pidfile = os.path.join(d, f"{name}.pid")
+        if os.path.exists(pidfile):
+            try:
+                old = int(open(pidfile).read().strip())
+            except ValueError:
+                old = -1
+            if old > 0 and _pid_alive(old):
+                _err(f"{name} already running (pid {old}, {pidfile}); "
+                     f"run stop-all first")
+                failed.append(name)
+                continue
+            os.unlink(pidfile)  # stale pidfile from a dead process
+        cmd = [sys.executable, "-m", "predictionio_tpu.cli", name,
+               "--ip", args.ip, "--port", str(port)]
+        if name == "storageserver" and args.storage_secret:
+            cmd += ["--secret", args.storage_secret]
+        log_path = os.path.join(d, f"{name}.log")
+        with open(log_path, "ab") as log_f:
+            proc = subprocess.Popen(
+                cmd, stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True)  # survives this CLI's exit
+        with open(pidfile, "w") as f:
+            f.write(str(proc.pid))
+        # wait for the port to answer (the server binds before serving)
+        deadline = time.monotonic() + args.start_timeout
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break  # died during startup; log has the reason
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1" if args.ip == "0.0.0.0"
+                         else args.ip, port), timeout=1.0):
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.1)
+        if up:
+            # the port answering is not proof OUR child owns it: a
+            # foreign listener (port collision) answers while the
+            # child dies on bind-EADDRINUSE a beat later
+            time.sleep(0.3)
+            if proc.poll() is not None:
+                up = False
+        if up:
+            _out(f"{name}: up on port {port} (pid {proc.pid}, "
+                 f"log {log_path})")
+            started.append(name)
+        else:
+            _err(f"{name}: failed to come up on port {port} within "
+                 f"{args.start_timeout}s — see {log_path}")
+            if proc.poll() is None:
+                # escalate and CONFIRM death before dropping the
+                # pidfile: a server stuck in native init ignores
+                # SIGTERM and would otherwise survive as an orphan
+                # no stop-all can find
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    try:
+                        proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        _err(f"{name}: pid {proc.pid} survived "
+                             f"SIGKILL; keeping pidfile for stop-all")
+                        failed.append(name)
+                        continue
+            os.unlink(pidfile)
+            failed.append(name)
+    if failed:
+        return 1
+    _out(f"All servers up ({', '.join(started)}). "
+         f"`ptpu stop-all` stops them.")
+    return 0
+
+
+def cmd_stop_all(args, storage: Storage) -> int:
+    """``ptpu stop-all`` — SIGTERM every pidfile'd server, escalate to
+    SIGKILL after a grace period, clean up pidfiles (the
+    ``bin/pio-stop-all`` role)."""
+    import signal as _signal
+
+    d = _pid_dir(args)
+    stopped = 0
+    for name in _START_ALL:
+        pidfile = os.path.join(d, f"{name}.pid")
+        if not os.path.exists(pidfile):
+            continue
+        try:
+            pid = int(open(pidfile).read().strip())
+        except ValueError:
+            os.unlink(pidfile)
+            continue
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, _signal.SIGTERM)
+            except PermissionError:
+                # we spawned our servers as this user; a pid we cannot
+                # signal was recycled by someone else's process after a
+                # crash/reboot — stale pidfile, nothing of ours to stop
+                _out(f"{name}: pid {pid} now belongs to a foreign "
+                     f"process (recycled after crash?); dropping "
+                     f"stale pidfile")
+                os.unlink(pidfile)
+                continue
+            deadline = time.monotonic() + args.stop_timeout
+            while time.monotonic() < deadline and _pid_alive(pid):
+                time.sleep(0.1)
+            if _pid_alive(pid):
+                _err(f"{name} (pid {pid}) ignored SIGTERM; killing")
+                os.kill(pid, _signal.SIGKILL)
+                kill_deadline = time.monotonic() + 10.0
+                while _pid_alive(pid) and \
+                        time.monotonic() < kill_deadline:
+                    time.sleep(0.05)
+                if _pid_alive(pid):
+                    _err(f"{name} (pid {pid}) survived SIGKILL "
+                         f"(unreaped?); leaving pidfile")
+                    continue
+            _out(f"{name}: stopped (pid {pid})")
+            stopped += 1
+        else:
+            _out(f"{name}: not running (stale pidfile)")
+        os.unlink(pidfile)
+    if stopped == 0:
+        _out("Nothing to stop.")
+    return 0
+
+
 def cmd_status(args, storage: Storage) -> int:
     """``pio status`` (``commands/Management.scala:99``): environment +
     storage smoke check."""
@@ -724,6 +911,30 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--cert", default="")
     s.add_argument("--key", default="")
 
+    s = sub.add_parser("start-all", help="start event/admin/dashboard "
+                       "(and optionally storage) servers as daemons "
+                       "with pidfiles")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--pid-dir", default="",
+                   help="pidfile/log dir (default ~/.ptpu or "
+                        "$PIO_PID_DIR)")
+    s.add_argument("--eventserver-port", dest="event_port", type=int,
+                   default=0)
+    s.add_argument("--adminserver-port", dest="admin_port", type=int,
+                   default=0)
+    s.add_argument("--dashboard-port", dest="dash_port", type=int,
+                   default=0)
+    s.add_argument("--with-storageserver", action="store_true",
+                   help="also start the remote-backend storage server")
+    s.add_argument("--storageserver-port", dest="storage_port",
+                   type=int, default=0)
+    s.add_argument("--storage-secret", default="")
+    s.add_argument("--start-timeout", type=float, default=30.0)
+
+    s = sub.add_parser("stop-all", help="stop every start-all daemon")
+    s.add_argument("--pid-dir", default="")
+    s.add_argument("--stop-timeout", type=float, default=10.0)
+
     sub.add_parser("status", help="check environment and storage")
 
     s = sub.add_parser("export", help="export events to a JSON-lines file")
@@ -757,6 +968,8 @@ COMMANDS = {
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
     "batchpredict": cmd_batchpredict,
+    "start-all": cmd_start_all,
+    "stop-all": cmd_stop_all,
     "eventserver": cmd_eventserver,
     "storageserver": cmd_storageserver,
     "adminserver": cmd_adminserver,

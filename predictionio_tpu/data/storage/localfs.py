@@ -283,7 +283,11 @@ class LocalFSEventStore(EventStore):
                     if s:
                         try:
                             rec = json.loads(s)
-                        except json.JSONDecodeError:
+                        except (json.JSONDecodeError,
+                                UnicodeDecodeError):
+                            # UnicodeDecodeError: the tear landed inside
+                            # a multi-byte UTF-8 character — same torn-
+                            # writer residue, different exception
                             if not has_nl:
                                 # newline-less torn trailing line — the
                                 # residue of a writer killed mid-append

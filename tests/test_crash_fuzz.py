@@ -349,7 +349,11 @@ def test_localfs_torn_tail_recovers_and_next_append_is_clean(tmp_path):
                target_entity_type="item", target_entity_id=f"i{j}",
                properties=DataMap({"rating": 1.0}))
          for j in range(5)], 1)
-    # simulate the killed writer: append half a record, no newline
+    # simulate the killed writer: append half a record, no newline —
+    # torn INSIDE a multi-byte UTF-8 character (the é of "café"), which
+    # surfaces as UnicodeDecodeError rather than JSONDecodeError
+    torn = '{"op": "putb", "events": [{"event": "café'.encode()[:-1]
+    assert torn[-1] == 0xC3  # ends on a lead byte: mid-character tear
     log = None
     for dirpath, _, files in os.walk(root):
         for fn in files:
@@ -358,7 +362,7 @@ def test_localfs_torn_tail_recovers_and_next_append_is_clean(tmp_path):
                 break
     assert log, os.listdir(root)
     with open(log, "ab") as f:
-        f.write(b'{"op": "putb", "events": [{"event": "rate", "entit')
+        f.write(torn)
     # a FRESH client must read the 5 good rows, drop the torn tail...
     s2 = _storage_for("localfs", root)
     assert len(list(s2.events().find(1))) == 5
