@@ -271,6 +271,17 @@ def main():
                 if d < best_dt:
                     best_dt, best_gm, best_params = d, gm, p_run
         assert best_params is not None
+        if gram_mode == "auto" and len(cands) > 1:
+            # persist the measured winner so every trainer entry (not
+            # just the bench) picks it up via gram_autotune.best_mode
+            try:
+                from predictionio_tpu.ops.gram_autotune import record
+                record(rank_r, best_gm,
+                       device_kind=jax.devices()[0].device_kind,
+                       measured={"source": "bench_race",
+                                 "best_s": round(best_dt, 3)})
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
         fl = als_flops_per_iter(packed[0], packed[1], best_params)
         ach = fl * iterations / best_dt  # raw; display-rounded once
         return {

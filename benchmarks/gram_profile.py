@@ -34,8 +34,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def main() -> None:
-    B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-    L = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    B = int(pos[0]) if len(pos) > 0 else 4096
+    L = int(pos[1]) if len(pos) > 1 else 256
     ranks = [int(r) for r in
              os.environ.get("GRAM_RANKS", "32,64,128").split(",")]
     reps = int(os.environ.get("GRAM_REPS", "3"))
@@ -58,16 +59,68 @@ def main() -> None:
     def sync(x):
         np.asarray(jax.device_get(jnp.ravel(x)[:1]))
 
+    # Per-dispatch overhead through a REMOTE device tunnel is large
+    # (~80-100ms RTT measured on the axon tunnel — same order as the
+    # ops themselves), so a single-op timing would measure the tunnel.
+    # Each stage therefore runs K times inside ONE jitted fori_loop —
+    # the carry feeds the next rep's input so nothing is DCE'd or
+    # hoisted — and per-rep time is (T_loop - T_zero)/K with T_zero a
+    # measured empty-dispatch baseline.
+    K = int(os.environ.get("GRAM_INNER_REPS", "16"))
+
     def timeit(fn, *args):
-        fn(*args)  # compile + warm
-        sync(fn(*args))
+        # every stage's first arg is a float array; the carry feeds it
+        # so reps can't be hoisted, and the carry is a FULL-output sum
+        # so XLA can't slice-sink/DCE the op being timed
+        assert args[0].dtype.kind == "f", "first arg must be float"
+
+        def looped(*a):
+            def body(_i, carry):
+                out = fn(a[0] + carry * 1e-30, *a[1:])
+                return jax.tree_util.tree_reduce(
+                    lambda acc, leaf: acc + jnp.sum(leaf).astype(
+                        jnp.float32),
+                    out, jnp.float32(0.0))
+
+            return jax.lax.fori_loop(0, K, body, jnp.float32(0.0))
+
+        lfn = jax.jit(looped)
+        lfn(*args)  # compile + warm
+        sync(lfn(*args))
         best = float("inf")
         for _ in range(reps):
             t0 = time.monotonic()
-            out = fn(*args)
+            out = lfn(*args)
             sync(out)
             best = min(best, time.monotonic() - t0)
-        return best
+        dt = (best - t_zero) / K
+        if dt <= t_zero * 0.5 / K:
+            return None  # below measurement resolution — don't report
+        return dt
+
+    # empty-dispatch baseline: same jit/sync plumbing, ~no compute
+    _zero = jax.jit(lambda x: x + 1.0)
+    z = jnp.float32(0.0)
+    _zero(z)
+    sync(_zero(z))
+    t_zero = float("inf")
+    for _ in range(max(reps, 3)):
+        t0 = time.monotonic()
+        sync(_zero(z))
+        t_zero = min(t_zero, time.monotonic() - t0)
+    print(json.dumps({"stage": "dispatch_baseline",
+                      "ms": round(t_zero * 1e3, 3)}), flush=True)
+
+    def emit(stage, r, dt, flops=None, **extra):
+        """One output contract for every stage: ms/useful_tflops are
+        null with below_resolution=true when dt is None."""
+        print(json.dumps({
+            "stage": stage, "rank": r, "B": B, "L": L,
+            "ms": (round(dt * 1e3, 3) if dt else None),
+            **({"below_resolution": True} if dt is None else {}),
+            "useful_tflops": (round(flops / dt / 1e12, 3)
+                              if dt and flops else None),
+            "device": dev, **extra}), flush=True)
 
     for r in ranks:
         fixed = jnp.asarray(
@@ -95,6 +148,10 @@ def main() -> None:
             "gram_pair_fused": (
                 jax.jit(lambda f, i, w: gram_pairs(f[i], w)),
                 fixed, idx, w),
+            "gram_fused_bf16": (
+                jax.jit(lambda f, i, w: gram_weighted(f[i], w,
+                                                      bf16=True)),
+                fixed, idx, w),
             "gram_pair_fused_bf16": (
                 jax.jit(lambda f, i, w: gram_pairs(f[i], w, bf16=True)),
                 fixed, idx, w),
@@ -102,16 +159,13 @@ def main() -> None:
         # useful FLOPs of the weighted gram (the pair layout does 2x the
         # multiplies; report against USEFUL work so variants compare)
         gram_flops = 2.0 * B * L * r * r
+        stage_ms: dict[str, float] = {}
         for name, (fn, *args) in stages.items():
             dt = timeit(fn, *args)
-            flops = gram_flops if "gram" in name else None
-            print(json.dumps({
-                "stage": name, "rank": r, "B": B, "L": L,
-                "ms": round(dt * 1e3, 3),
-                "useful_tflops": (round(gram_flops / dt / 1e12, 3)
-                                  if flops else None),
-                "device": dev,
-            }), flush=True)
+            emit(name, r, dt,
+                 flops=(gram_flops if "gram" in name else None))
+            if dt is not None:
+                stage_ms[name] = dt
 
         # fused VMEM-table kernel: the user-half-step scenario (gather
         # from the ITEM table, which fits VMEM at MovieLens shapes)
@@ -139,24 +193,40 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — report, keep going
                 skip = f"compile/run failed at real shape: {e}"[:300]
             else:
-                print(json.dumps({
-                    "stage": "gram_table_pallas", "rank": r, "B": B,
-                    "L": L, "ms": round(dt * 1e3, 3),
-                    "useful_tflops": round(gram_flops / dt / 1e12, 3),
-                    "device": dev}), flush=True)
+                emit("gram_table_pallas", r, dt, flops=gram_flops)
         if skip is not None:
             print(json.dumps({
                 "stage": "gram_table_pallas", "rank": r,
                 "skipped": skip, "device": dev}), flush=True)
+
+        # --record: persist the fused-variant winners (the half-step's
+        # actual realization: gather+gram in one jit) into the
+        # shape-keyed autotune table consulted by gram_mode="auto"
+        if "--record" in sys.argv:
+            from predictionio_tpu.ops.gram_autotune import record
+
+            for bf16, ein, pair in (
+                    (False, "gram_fused", "gram_pair_fused"),
+                    (True, "gram_fused_bf16", "gram_pair_fused_bf16")):
+                if ein in stage_ms and pair in stage_ms:
+                    win = ("pair" if stage_ms[pair] < stage_ms[ein]
+                           else "einsum")
+                    record(r, win, bf16=bf16, device_kind=dev,
+                           measured={
+                               "source": "gram_profile",
+                               "einsum_ms": round(stage_ms[ein] * 1e3, 3),
+                               "pair_ms": round(stage_ms[pair] * 1e3, 3),
+                           })
+                    print(json.dumps({
+                        "recorded": win, "rank": r, "bf16": bf16,
+                        "device": dev}), flush=True)
 
         A_h = rng.standard_normal((B, r, r)).astype(np.float32)
         A = jnp.asarray(A_h @ A_h.transpose(0, 2, 1)
                         + 10.0 * np.eye(r, dtype=np.float32))
         b = jnp.asarray(rng.standard_normal((B, r)).astype(np.float32))
         dt = timeit(jax.jit(solve_spd_batch), A, b)
-        print(json.dumps({
-            "stage": "solve_spd", "rank": r, "B": B,
-            "ms": round(dt * 1e3, 3), "device": dev}), flush=True)
+        emit("solve_spd", r, dt)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,221 @@
+"""North-star run: MovieLens-20M (documented surrogate) through the REAL
+CLI — app new → import → train → eval (VERDICT r3 task 6).
+
+The reference's end-to-end is ``pio build && pio train && pio eval`` on
+the scala-parallel-recommendation template over ml-20m
+(``BASELINE.json`` north_star; ``Evaluation.scala:32-89`` metric grid).
+This script drives the same flow through ``predictionio_tpu.cli``
+subprocesses: the surrogate events land in a segmentfs store via
+``ptpu import``, ``ptpu train`` runs the recommendation engine at the
+requested scale on the attached device, and ``ptpu eval`` runs the
+shipped Precision@K grid + NDCG@10 over k folds.
+
+Every stage is wall-clocked; the result is ONE JSON document for
+BASELINE.md's real-data-vs-synthetic table.
+
+Usage:
+  python benchmarks/northstar_ml20m.py --scale 1.0 \
+      [--npz /tmp/ml20m_full.npz] [--rank 64] [--eval-scale 0.1]
+
+``--eval-scale`` bounds the k-fold grid's cost: the eval app holds a
+seeded subsample of the ratings (1.0 = the full set). The train stage
+always runs at --scale.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def cli_env(home: Path, events_dir: Path, platform: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PIO_HOME": str(home),
+        "PYTHONPATH": str(REPO),
+        # segmentfs event data (the TPU-pod backend, native codec);
+        # sqlite metadata rides the default under PIO_HOME
+        "PIO_STORAGE_SOURCES_SEG_TYPE": "segmentfs",
+        "PIO_STORAGE_SOURCES_SEG_PATH": str(events_dir),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SEG",
+    })
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    return env
+
+
+def run_cli(env: dict, *args, timeout=7200):
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=str(REPO))
+    dt = time.monotonic() - t0
+    if proc.returncode != 0:
+        sys.stderr.write(f"FAILED {args}: rc={proc.returncode}\n"
+                         f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}\n")
+        raise SystemExit(1)
+    return proc, dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--npz", default="")
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--eval-scale", type=float, default=0.1,
+                    help="fraction of ratings in the eval app's store")
+    ap.add_argument("--eval-k", type=int, default=2)
+    ap.add_argument("--platform", default="",
+                    help="JAX_PLATFORMS override ('' = leave as-is)")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+
+    from benchmarks.ml20m_surrogate import (
+        generate,
+        verify_marginals,
+        write_events_jsonl,
+    )
+
+    result: dict = {"metric": "northstar_ml20m",
+                    "scale": args.scale, "rank": args.rank}
+
+    # --- dataset ---
+    t0 = time.monotonic()
+    if args.npz and os.path.exists(args.npz):
+        d = np.load(args.npz)
+        users, items, stars, ts = (d["users"], d["items"], d["stars"],
+                                   d["ts"])
+        n_users, n_movies = int(d["n_users"]), int(d["n_movies"])
+    else:
+        users, items, stars, ts, n_users, n_movies = generate(args.scale)
+    result["marginals"] = verify_marginals(users, items, stars, ts,
+                                           n_users, n_movies, args.scale)
+    result["gen_s"] = round(time.monotonic() - t0, 1)
+
+    workdir = Path(args.workdir) if args.workdir else \
+        Path(tempfile.mkdtemp(prefix="northstar_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    home = workdir / "pio_home"
+    home.mkdir(exist_ok=True)
+    events_dir = workdir / "segmentfs"
+    env = cli_env(home, events_dir, args.platform)
+
+    # --- JSONL + import through the real CLI ---
+    t0 = time.monotonic()
+    jsonl = workdir / "events.jsonl"
+    write_events_jsonl(jsonl, users, items, stars, ts)
+    result["jsonl_write_s"] = round(time.monotonic() - t0, 1)
+
+    run_cli(env, "app", "new", "ml20m")
+    _, dt = run_cli(env, "import", "--app", "ml20m",
+                    "--input", str(jsonl))
+    result["import_s"] = round(dt, 1)
+    result["import_ev_per_s"] = round(len(users) / dt, 1)
+
+    # --- train via ptpu train (the full-data flagship run) ---
+    variant = {
+        "id": "northstar", "version": "1",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation:"
+            "recommendation_engine",
+        "datasource": {"params": {"app_name": "ml20m"}},
+        "algorithms": [{
+            "name": "als",
+            "params": {"rank": args.rank, "num_iterations": args.iters,
+                       "reg": 0.01, "seed": 3, "implicit_prefs": True,
+                       "alpha": 40.0}}],
+    }
+    ej = workdir / "engine.json"
+    ej.write_text(json.dumps(variant))
+    _, dt = run_cli(env, "train", "--engine-json", str(ej))
+    result["train_s"] = round(dt, 1)
+    result["train_ratings_per_s_per_iter"] = round(
+        len(users) * args.iters / dt, 1)
+
+    # --- eval: shipped Precision@K grid + NDCG@10, k-fold, through
+    # ptpu eval on a seeded subsample app (documented --eval-scale) ---
+    if args.eval_scale > 0:
+        rng = np.random.default_rng(17)
+        if args.eval_scale < 1.0:
+            sel = rng.random(len(users)) < args.eval_scale
+        else:
+            sel = np.ones(len(users), bool)
+        ejsonl = workdir / "events_eval.jsonl"
+        write_events_jsonl(ejsonl, users[sel], items[sel], stars[sel],
+                           ts[sel])
+        run_cli(env, "app", "new", "ml20m_eval")
+        run_cli(env, "import", "--app", "ml20m_eval",
+                "--input", str(ejsonl))
+        evmod = workdir / "northstar_eval.py"
+        evmod.write_text(f"""
+from predictionio_tpu.controller import Evaluation
+from predictionio_tpu.controller.evaluation import EngineParamsGenerator
+from predictionio_tpu.controller.params import EngineParams
+from predictionio_tpu.models.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    DataSourceParams, NDCGAtK, PrecisionAtK, recommendation_engine)
+
+APP = "ml20m_eval"
+evaluation = Evaluation(
+    engine=recommendation_engine(),
+    metric=NDCGAtK(k=10, rating_threshold=2.0),
+    other_metrics=[PrecisionAtK(k=1, rating_threshold=4.0),
+                   PrecisionAtK(k=3, rating_threshold=4.0),
+                   PrecisionAtK(k=10, rating_threshold=4.0)],
+)
+
+
+class _Gen(EngineParamsGenerator):
+    engine_params_list = [
+        EngineParams(
+            datasource=("", DataSourceParams(app_name=APP,
+                                             eval_k={args.eval_k})),
+            algorithms=[("als", ALSParams(
+                rank={args.rank}, num_iterations={args.iters}, reg=reg,
+                seed=3, implicit_prefs=True, alpha=40.0))])
+        for reg in (0.01, 0.1)
+    ]
+
+
+engine_params_generator = _Gen()
+""")
+        env_eval = dict(env, PYTHONPATH=f"{workdir}:{REPO}")
+        proc, dt = run_cli(env_eval, "eval",
+                           "northstar_eval:evaluation",
+                           "northstar_eval:engine_params_generator")
+        result["eval_s"] = round(dt, 1)
+        result["eval_scale"] = args.eval_scale
+        result["eval_one_liner"] = proc.stdout.strip().splitlines()[-1]
+
+    # device probe in a CHILD with the same env the CLI stages ran
+    # under (reports what they actually used), bounded: backend init
+    # through a hung tunnel blocks indefinitely and must not eat a
+    # finished multi-hour run
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            env=env, capture_output=True, text=True, timeout=180)
+        result["device"] = probe.stdout.strip().splitlines()[-1] \
+            if probe.returncode == 0 and probe.stdout.strip() \
+            else "unknown"
+    except Exception:  # noqa: BLE001 — timeout/crash: don't die
+        result["device"] = "unknown"
+    result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

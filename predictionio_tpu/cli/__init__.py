@@ -591,6 +591,10 @@ def cmd_stop_all(args, storage: Storage) -> int:
         if _pid_alive(pid):
             try:
                 os.kill(pid, _signal.SIGTERM)
+            except ProcessLookupError:
+                # exited between the aliveness check and the signal —
+                # already what we wanted; fall through to cleanup
+                pass
             except PermissionError:
                 # we spawned our servers as this user; a pid we cannot
                 # signal was recycled by someone else's process after a
@@ -605,7 +609,10 @@ def cmd_stop_all(args, storage: Storage) -> int:
                 time.sleep(0.1)
             if _pid_alive(pid):
                 _err(f"{name} (pid {pid}) ignored SIGTERM; killing")
-                os.kill(pid, _signal.SIGKILL)
+                try:
+                    os.kill(pid, _signal.SIGKILL)
+                except ProcessLookupError:
+                    pass  # exited in the TERM→KILL window
                 kill_deadline = time.monotonic() + 10.0
                 while _pid_alive(pid) and \
                         time.monotonic() < kill_deadline:
@@ -688,14 +695,37 @@ def cmd_import(args, storage: Storage) -> int:
             _err(f"Channel {args.channel} does not exist. Aborting.")
             return 1
         channel_id = ch.id
-    events = []
-    with open(args.input, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                events.append(Event.from_json(json.loads(line)))
-    storage.events().insert_batch(events, a.id, channel_id)
-    _out(f"Imported {len(events)} event(s).")
+    # stream in chunks: a 20M-line import must not materialize every
+    # Event at once. Each chunk keeps insert_batch's all-or-nothing
+    # contract, so a mid-file failure leaves exactly the reported
+    # earlier chunks committed — say so instead of dying with a
+    # traceback and an unknown amount of half-imported data.
+    chunk = int(os.environ.get("PIO_IMPORT_BATCH", "100000"))
+    events: list = []
+    total = 0
+    lineno = 0
+    try:
+        with open(args.input, "r", encoding="utf-8") as f:
+            for line in f:
+                lineno += 1
+                line = line.strip()
+                if line:
+                    events.append(Event.from_json(json.loads(line)))
+                if len(events) >= chunk:
+                    storage.events().insert_batch(events, a.id,
+                                                  channel_id)
+                    total += len(events)
+                    events = []
+        if events:
+            storage.events().insert_batch(events, a.id, channel_id)
+            total += len(events)
+    except Exception as e:  # noqa: BLE001 — report durable progress
+        _err(f"Import failed near line {lineno}: {e}")
+        _err(f"{total} event(s) from earlier chunks are already "
+             f"committed; fix the input and re-import the remainder "
+             f"(or app data-delete to start over).")
+        return 1
+    _out(f"Imported {total} event(s).")
     return 0
 
 

@@ -58,8 +58,18 @@ def gram_pairs(F: jax.Array, w: jax.Array,
 
 def gram_dispatch(F: jax.Array, w: jax.Array, mode: str,
                   bf16: bool = False) -> jax.Array:
-    """``mode``: "einsum" (baseline), "pair", or "auto" (currently the
-    baseline; flips per-shape once gram_profile.py numbers land)."""
+    """``mode``: "einsum" (baseline), "pair", or "auto".
+
+    "auto" resolves through the persistent shape-keyed table
+    (:mod:`.gram_autotune`): measured winners recorded by the bench's
+    gram race / ``gram_profile.py --record``, then packaged defaults,
+    then an MXU-tile-occupancy heuristic. The resolution happens at
+    trace time (mode and shapes are static), so the choice costs
+    nothing at run time."""
+    if mode == "auto":
+        from .gram_autotune import best_mode
+
+        mode = best_mode(F.shape[-1], bf16=bf16)
     if mode == "pair" and F.shape[-3] % 2 == 0:
         return gram_pairs(F, w, bf16=bf16)
     return gram_weighted(F, w, bf16=bf16)

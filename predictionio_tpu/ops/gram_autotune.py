@@ -1,0 +1,155 @@
+"""Persistent, shape-keyed gram-mode selection (VERDICT r3 task 2).
+
+``ALSParams(gram_mode="auto")`` needs a concrete realization (baseline
+einsum vs. the pair-packed MXU tiling, ``ops/gram.py``) at trace time.
+Round 3 raced the candidates at *bench* time only; this module makes the
+choice persistent and shape-keyed so every trainer entry benefits:
+
+resolution order for ``best_mode(rank, bf16)``:
+
+1. the user cache file (``PIO_GRAM_AUTOTUNE_CACHE``, default
+   ``~/.cache/predictionio_tpu/gram_autotune.json``) — written by
+   ``record()`` whenever a measured race runs (bench.py's gram race,
+   ``benchmarks/gram_profile.py --record``);
+2. the packaged defaults (``gram_autotune_defaults.json`` next to this
+   file) — the committed table measured on real hardware;
+3. a hardware heuristic: on TPU, "pair" below rank 128 (two rank<128
+   systems share one 128-wide MXU tile; a full-rank system doesn't),
+   "einsum" otherwise and on every non-TPU backend.
+
+Keys are ``<device family>|r<rank bucket>|<f32|bf16>`` — the L/B batch
+axes move the absolute time but not the winner (measured: the winner is
+set by how full the MXU tile is, i.e. by rank and dtype), so they are
+deliberately not in the key.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+_LOCK = threading.Lock()
+_DEFAULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "gram_autotune_defaults.json")
+_cache_mem: dict | None = None
+
+
+def _cache_path() -> str:
+    return os.environ.get(
+        "PIO_GRAM_AUTOTUNE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "predictionio_tpu", "gram_autotune.json"))
+
+
+def device_family(kind: str | None = None) -> str:
+    """Coarse device family ("TPU v5 lite", "TPU v4", "cpu", ...) — fine
+    enough to key tuning, coarse enough to survive kind-string noise."""
+    if kind is None:
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — no backend: untuned
+            return "unknown"
+    kind = str(kind)
+    # "TPU v5 lite0" -> "TPU v5 lite"; "TPU v4" -> "TPU v4" (the version
+    # digit is part of the family; only a trailing chip INDEX is noise)
+    m = re.match(r"^(TPU v\d+[a-z]*(?: lite)?)", kind)
+    if m:
+        return m.group(1)
+    if kind.lower().startswith("tpu"):
+        return kind
+    return kind.split(" ")[0].lower() or "unknown"
+
+
+def _rank_bucket(rank: int) -> int:
+    for b in (32, 64, 128):
+        if rank <= b:
+            return b
+    return 256
+
+
+def _key(family: str, rank: int, bf16: bool) -> str:
+    return f"{family}|r{_rank_bucket(rank)}|{'bf16' if bf16 else 'f32'}"
+
+
+def _load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _table() -> dict:
+    """defaults overlaid by the user cache (cache wins: it's measured on
+    THIS machine)."""
+    global _cache_mem
+    with _LOCK:
+        if _cache_mem is None:
+            t = _load(_DEFAULTS_PATH)
+            t.update(_load(_cache_path()))
+            _cache_mem = t
+        return dict(_cache_mem)
+
+
+def best_mode(rank: int, bf16: bool = False,
+              device_kind: str | None = None) -> str:
+    """Concrete gram mode ("einsum" | "pair") for ``gram_mode="auto"``."""
+    fam = device_family(device_kind)
+    ent = _table().get(_key(fam, rank, bf16))
+    if isinstance(ent, dict) and ent.get("mode") in ("einsum", "pair"):
+        return ent["mode"]
+    # heuristic: pair-packing helps exactly when two systems fit one
+    # 128-wide MXU tile; CPUs/GPUs gain nothing from the extra flops
+    if fam.startswith("TPU") and _rank_bucket(rank) < 128:
+        return "pair"
+    return "einsum"
+
+
+def record(rank: int, mode: str, bf16: bool = False,
+           device_kind: str | None = None,
+           measured: dict | None = None) -> None:
+    """Persist a measured winner (atomic write; merge-on-write so
+    concurrent processes tuning different shapes don't clobber)."""
+    if mode not in ("einsum", "pair"):
+        return
+    fam = device_family(device_kind)
+    if fam in ("unknown", "cpu"):
+        return  # only persist real-accelerator measurements
+    path = _cache_path()
+    ent = {"mode": mode}
+    if measured:
+        ent.update(measured)
+    global _cache_mem
+    # whole-training measurements (bench_race) beat single-op profile
+    # measurements for the same key: the end-to-end number includes the
+    # fusion context the op actually runs in
+    prio = {"bench_race": 2, "gram_profile": 1}
+    with _LOCK:
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            cur = _load(path)
+            key = _key(fam, rank, bf16)
+            old = cur.get(key)
+            if (isinstance(old, dict)
+                    and prio.get(old.get("source"), 0)
+                    > prio.get(ent.get("source"), 0)):
+                return
+            cur[key] = ent
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(cur, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # cache is advisory; never fail the caller
+        _cache_mem = None  # re-overlay on next lookup
+
+
+def reset_for_tests() -> None:
+    global _cache_mem
+    with _LOCK:
+        _cache_mem = None

@@ -133,23 +133,18 @@ def _solve_spd_pallas(A: jax.Array, b: jax.Array,
     return jnp.transpose(xt, (1, 0))[:n, :r]
 
 
-def _use_pallas() -> bool:
+def _solver_mode() -> str:
+    """"pallas" | "xla" | "auto" — "auto" defers the choice to LOWERING
+    time via ``lax.platform_dependent``, so the decision tracks the
+    platform the arrays actually compile for. (Consulting
+    ``jax.devices()[0]`` here is wrong on hosts where a TPU tunnel
+    plugin is the default backend but the computation runs on a virtual
+    CPU mesh — the dryrun topology — and picked the Pallas kernel for a
+    CPU lowering.)"""
     if not _HAVE_PALLAS:
-        return False
+        return "xla"
     mode = os.environ.get("PTPU_SPD_SOLVER", "auto")
-    if mode == "pallas":
-        return True
-    if mode == "xla":
-        return False
-    try:
-        # Mosaic lowers on TPU only — a GPU backend must fall back to
-        # XLA. Match on the device kind, not the backend name: TPU
-        # tunnel/plugin platforms (e.g. "axon") report kinds like
-        # "TPU v5 lite" while default_backend() returns the plugin name.
-        dev = jax.devices()[0]
-        return dev.platform == "tpu" or dev.device_kind.startswith("TPU")
-    except Exception:  # pragma: no cover
-        return False
+    return mode if mode in ("pallas", "xla") else "auto"
 
 
 def solve_spd_batch(A: jax.Array, b: jax.Array,
@@ -165,14 +160,26 @@ def solve_spd_batch(A: jax.Array, b: jax.Array,
     """
     r = A.shape[-1]
     A = A + jitter * jnp.eye(r, dtype=A.dtype)
-    # the Pallas kernel's VMEM scratch is f32; non-f32 systems take the
-    # XLA path rather than hitting a dtype-mismatched kernel
-    if A.dtype == jnp.float32 and _use_pallas():
+
+    def _pallas(A, b):
         lead = A.shape[:-2]  # arbitrary leading batch dims, like LAPACK's
         x = _solve_spd_pallas(A.reshape(-1, r, r), b.reshape(-1, r))
         return x.reshape(*lead, r)
-    chol, lower = jax.scipy.linalg.cho_factor(A)
-    return jax.scipy.linalg.cho_solve((chol, lower), b[..., None])[..., 0]
+
+    def _xla(A, b):
+        chol, lower = jax.scipy.linalg.cho_factor(A)
+        return jax.scipy.linalg.cho_solve((chol, lower),
+                                          b[..., None])[..., 0]
+
+    # the Pallas kernel's VMEM scratch is f32; non-f32 systems take the
+    # XLA path rather than hitting a dtype-mismatched kernel
+    mode = _solver_mode()
+    if A.dtype != jnp.float32 or mode == "xla":
+        return _xla(A, b)
+    if mode == "pallas":
+        return _pallas(A, b)
+    # "auto": pick per LOWERING platform (Mosaic lowers on TPU only)
+    return jax.lax.platform_dependent(A, b, tpu=_pallas, default=_xla)
 
 
 def gramian(factors: jax.Array) -> jax.Array:

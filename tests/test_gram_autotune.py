@@ -1,0 +1,94 @@
+"""Shape-keyed persistent gram-mode selection (VERDICT r3 task 2)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import gram_autotune as ga
+
+_REAL_DEFAULTS = ga._DEFAULTS_PATH
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_GRAM_AUTOTUNE_CACHE",
+                       str(tmp_path / "tune.json"))
+    # isolate from the PACKAGED defaults too — these tests check the
+    # resolution machinery, not the shipped measurements
+    monkeypatch.setattr(ga, "_DEFAULTS_PATH",
+                        str(tmp_path / "no_defaults.json"))
+    ga.reset_for_tests()
+    yield
+    ga.reset_for_tests()
+
+
+def test_packaged_defaults_ship_measured_r64_winner(monkeypatch,
+                                                    tmp_path):
+    """The committed defaults carry the on-chip r64 measurement."""
+    monkeypatch.setenv("PIO_GRAM_AUTOTUNE_CACHE",
+                       str(tmp_path / "empty.json"))
+    monkeypatch.setattr(ga, "_DEFAULTS_PATH", _REAL_DEFAULTS)
+    ga.reset_for_tests()
+    assert ga.best_mode(64, device_kind="TPU v5 lite0") == "einsum"
+    ga.reset_for_tests()
+
+
+def test_heuristic_fallback_tpu_vs_cpu():
+    # untuned TPU: pair below rank 128 (two systems per MXU tile)
+    assert ga.best_mode(64, device_kind="TPU v5 lite0") == "pair"
+    assert ga.best_mode(32, device_kind="TPU v4") == "pair"
+    assert ga.best_mode(128, device_kind="TPU v5 lite0") == "einsum"
+    # CPU gains nothing from pair's 2x multiplies
+    assert ga.best_mode(64, device_kind="cpu") == "einsum"
+
+
+def test_recorded_winner_overrides_heuristic(tmp_path):
+    ga.record(64, "einsum", device_kind="TPU v5 lite0",
+              measured={"source": "test"})
+    assert ga.best_mode(64, device_kind="TPU v5 lite0") == "einsum"
+    # rank bucketing: 48 shares the r64 bucket
+    assert ga.best_mode(48, device_kind="TPU v5 lite0") == "einsum"
+    # other buckets / dtypes untouched
+    assert ga.best_mode(32, device_kind="TPU v5 lite0") == "pair"
+    assert ga.best_mode(64, bf16=True,
+                        device_kind="TPU v5 lite0") == "pair"
+    # the cache file is merge-written valid JSON
+    data = json.loads((tmp_path / "tune.json").read_text())
+    assert data["TPU v5 lite|r64|f32"]["mode"] == "einsum"
+    assert data["TPU v5 lite|r64|f32"]["source"] == "test"
+
+
+def test_cpu_measurements_not_persisted(tmp_path):
+    ga.record(64, "pair", device_kind="cpu")
+    assert not (tmp_path / "tune.json").exists()
+
+
+def test_device_family_normalizes_kind_strings():
+    assert ga.device_family("TPU v5 lite0") == "TPU v5 lite"
+    assert ga.device_family("TPU v5 lite") == "TPU v5 lite"
+    assert ga.device_family("TPU v4") == "TPU v4"
+    assert ga.device_family("cpu") == "cpu"
+
+
+def test_corrupt_cache_falls_back(tmp_path):
+    (tmp_path / "tune.json").write_text("{not json")
+    assert ga.best_mode(64, device_kind="TPU v5 lite0") == "pair"
+
+
+def test_auto_dispatch_matches_concrete_modes():
+    """gram_dispatch("auto") must produce the same numbers as whichever
+    concrete mode the table picks (CPU here: einsum)."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.gram import gram_dispatch
+
+    rng = np.random.default_rng(0)
+    F = jnp.asarray(rng.standard_normal((6, 9, 8)).astype(np.float32))
+    w = jnp.asarray(rng.random((6, 9)).astype(np.float32))
+    out_auto = np.asarray(gram_dispatch(F, w, "auto"))
+    out_ein = np.asarray(gram_dispatch(F, w, "einsum"))
+    out_pair = np.asarray(gram_dispatch(F, w, "pair"))
+    np.testing.assert_allclose(out_auto, out_ein, rtol=1e-6)
+    np.testing.assert_allclose(out_pair, out_ein, rtol=1e-5,
+                               atol=1e-5)
