@@ -429,7 +429,14 @@ def supervise() -> int:
                                 f"line: {json_line[:200]}")
                     sys.stderr.write(last_err + "\n")
             if parsed is not None:
-                if "TPU" in str(parsed.get("device", "")):
+                # only a FULL battery (quality + serving present) may
+                # become the stale-fallback artifact; ad-hoc partial
+                # runs (BENCH_SKIP_QUALITY, BENCH_SERVING=0, alternate
+                # ranks) must not degrade the driver's last-good
+                full = (parsed.get("ndcg10") is not None
+                        and parsed.get("serving") is not None
+                        and parsed.get("rank") == 64)
+                if full and "TPU" in str(parsed.get("device", "")):
                     # remember the last real-chip result for the
                     # stale-fallback path (atomic: tmp + replace)
                     try:

@@ -211,15 +211,19 @@ def main() -> None:
                 if ein in stage_ms and pair in stage_ms:
                     win = ("pair" if stage_ms[pair] < stage_ms[ein]
                            else "einsum")
-                    record(r, win, bf16=bf16, device_kind=dev,
-                           measured={
-                               "source": "gram_profile",
-                               "einsum_ms": round(stage_ms[ein] * 1e3, 3),
-                               "pair_ms": round(stage_ms[pair] * 1e3, 3),
-                           })
+                    persisted = record(r, win, bf16=bf16,
+                                       device_kind=dev,
+                                       measured={
+                                           "source": "gram_profile",
+                                           "einsum_ms": round(
+                                               stage_ms[ein] * 1e3, 3),
+                                           "pair_ms": round(
+                                               stage_ms[pair] * 1e3, 3),
+                                       })
                     print(json.dumps({
-                        "recorded": win, "rank": r, "bf16": bf16,
-                        "device": dev}), flush=True)
+                        "recorded": win if persisted else None,
+                        "persisted": persisted, "rank": r,
+                        "bf16": bf16, "device": dev}), flush=True)
 
         A_h = rng.standard_normal((B, r, r)).astype(np.float32)
         A = jnp.asarray(A_h @ A_h.transpose(0, 2, 1)

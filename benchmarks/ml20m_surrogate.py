@@ -181,15 +181,22 @@ def generate(scale: float = 1.0, seed: int = 20):
         idx = (np.flatnonzero(np.isin(users, light)) if sel is None
                else sel)
         need = idx
+        check = idx  # first round must examine every light position
         for _round in range(30):
             items[need] = rng.choice(n_movies, size=len(need), p=p)
-            key = users[idx].astype(np.int64) * n_movies + items[idx]
+            # only rows of users owning a resampled position can have
+            # gained a duplicate — checking all ~20M light positions
+            # every round costs an O(n log n) argsort for a handful of
+            # collisions after round 1
+            key = users[check].astype(np.int64) * n_movies + items[check]
             order = np.argsort(key, kind="stable")
-            dup = np.zeros(len(idx), dtype=bool)
+            dup = np.zeros(len(check), dtype=bool)
             dup[order[1:]] = key[order[1:]] == key[order[:-1]]
-            need = idx[dup]
+            need = check[dup]
             if len(need) == 0:
                 break
+            hot = np.isin(users[idx], np.unique(users[need]))
+            check = idx[hot]
         if len(need):  # final repair: uniform over the user's unseen
             for j in need:
                 u = users[j]

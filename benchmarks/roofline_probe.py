@@ -1,0 +1,101 @@
+"""Roofline accounting for the fused ALS trainer on the attached device.
+
+The round-4 on-chip gram profile showed every hot stage (gather, gram,
+solve) running at multi-TF/s while the WHOLE iteration achieves only
+0.83 TF/s — so the binding constraint is something the per-stage view
+doesn't see. This probe asks XLA itself: it captures the exact
+``_train_fused`` invocation ``train_als`` makes (shim capture — zero
+argument-assembly duplication), lowers/compiles that same program, and
+prints ``cost_analysis()`` (flops, bytes accessed, optimal seconds).
+
+bytes_accessed / measured_iteration_time vs the chip's HBM bandwidth
+says whether the iteration is HBM-bound; flops / time vs peak says
+MXU-bound; neither ≈ dispatch/serialization-bound.
+
+Usage: python benchmarks/roofline_probe.py   (from the repo root)
+Env:   BENCH_SCALE, BENCH_RANK as for bench.py; PROBE_ITERS (default 1)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    rank = int(os.environ.get("BENCH_RANK", "64"))
+    iters = int(os.environ.get("PROBE_ITERS", "1"))
+    n_users = int(138_000 * scale)
+    n_items = int(27_000 * scale)
+    nnz = int(20_000_000 * scale)
+
+    import jax
+
+    import predictionio_tpu.models.als as als
+
+    rng = np.random.default_rng(0)
+    items = (np.random.default_rng(1).zipf(1.3, size=nnz)
+             % n_items).astype(np.int32)
+    users = rng.integers(0, n_users, nnz).astype(np.int32)
+    vals = np.ones(nnz, dtype=np.float32)
+    ratings = als.RatingsCOO(users, items, vals, n_users, n_items)
+    params = als.ALSParams(rank=rank, num_iterations=iters,
+                           implicit_prefs=True, alpha=40.0, reg=0.01,
+                           seed=3)
+
+    captured: dict = {}
+    orig = als._train_fused
+
+    def shim(*a, **k):
+        captured["a"], captured["k"] = a, k
+        return orig(*a, **k)
+
+    als._train_fused = shim
+    try:
+        t0 = time.monotonic()
+        U, V = als.train_als(ratings, params)
+        np.asarray(jax.device_get(V[0, :1]))  # hard sync
+        run_s = time.monotonic() - t0
+    finally:
+        als._train_fused = orig
+    if "a" not in captured:
+        print(json.dumps({"error": "train_als did not take the fused "
+                                   "path (checkpointing active?)"}))
+        return
+
+    lowered = orig.lower(*captured["a"], **captured["k"])
+    comp = lowered.compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    out = {
+        "metric": "als_fused_roofline",
+        "device": jax.devices()[0].device_kind,
+        "rank": rank, "nnz": nnz, "iters_in_program": iters,
+        "xla_flops": flops,
+        "xla_bytes_accessed": byts,
+        "xla_optimal_seconds": ca.get("optimal_seconds"),
+        "run_s_including_dispatch": round(run_s, 3),
+        "model_flops_per_iter": als.als_flops_per_iter(
+            *als.pack_ratings(ratings, params)[:2], params),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+    }
+    if byts and run_s:
+        out["implied_GBps_if_run_s_is_compute"] = round(
+            byts / run_s / 1e9, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -93,7 +93,18 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     rng = np.random.default_rng(1)
     users = rng.integers(0, model.n_users, n_requests)
 
-    # warm the compiled path (first device dispatch compiles)
+    # wait for the server-side warmup (ServerConfig.warm_start compiles
+    # the single-query + pow2 batch ladder), then a few real queries
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status.json",
+                timeout=30) as resp:
+            if json.loads(resp.read()).get("servingWarm"):
+                break
+        time.sleep(0.5)
+    else:
+        raise RuntimeError(f"{label}: serving warmup did not finish")
     for u in users[:3]:
         body = json.dumps({"user": f"u{u}", "num": 10}).encode()
         urllib.request.urlopen(urllib.request.Request(

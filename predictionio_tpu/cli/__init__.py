@@ -704,6 +704,7 @@ def cmd_import(args, storage: Storage) -> int:
     events: list = []
     total = 0
     lineno = 0
+    committed_through = 0  # last LINE NUMBER fully committed
     try:
         with open(args.input, "r", encoding="utf-8") as f:
             for line in f:
@@ -715,15 +716,19 @@ def cmd_import(args, storage: Storage) -> int:
                     storage.events().insert_batch(events, a.id,
                                                   channel_id)
                     total += len(events)
+                    committed_through = lineno
                     events = []
         if events:
             storage.events().insert_batch(events, a.id, channel_id)
             total += len(events)
     except Exception as e:  # noqa: BLE001 — report durable progress
         _err(f"Import failed near line {lineno}: {e}")
-        _err(f"{total} event(s) from earlier chunks are already "
-             f"committed; fix the input and re-import the remainder "
-             f"(or app data-delete to start over).")
+        _err(f"{total} event(s) (input lines 1-{committed_through}) "
+             f"are already committed. Re-importing this file would "
+             f"DUPLICATE them — resume with the remainder only, e.g.: "
+             f"tail -n +{committed_through + 1} {args.input} > rest."
+             f"jsonl && ptpu import --input rest.jsonl (or app "
+             f"data-delete to start over).")
         return 1
     _out(f"Imported {total} event(s).")
     return 0

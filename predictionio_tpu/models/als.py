@@ -1299,6 +1299,12 @@ def recommend_products(model: ALSModel, user_index: int, k: int
     return np.asarray(ids[0][:k]), np.asarray(scores[0][:k])
 
 
+#: device top-k rows per dispatch — bounds the [chunk, n_items]
+#: score matrix (~230MB at ML-20M catalog) and keeps ONE compiled
+#: shape for large eval sweeps
+_TOPK_CHUNK = 2048
+
+
 def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Micro-batched top-k for many users (one device dispatch, or the
@@ -1308,11 +1314,36 @@ def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
             np.asarray(model.user_factors)[np.asarray(user_indices)],
             model.item_factors, k, model.n_items)
     k_dev = _compiled_k(k, model.n_items)
-    vecs = jnp.asarray(model.user_factors)[jnp.asarray(user_indices)]
+    # pad the BATCH axis to a power of two as well: the serving
+    # micro-batcher produces arbitrary batch sizes, and every distinct
+    # [B, r] shape is a fresh XLA compile — measured ~10-20s each
+    # through the device tunnel, which turned the batched path's p90
+    # into seconds (BENCH_LASTGOOD round 4). O(log) shapes instead.
+    # Past _TOPK_CHUNK rows, process fixed-size chunks: an eval sweep
+    # hands over EVERY test user at once, and one [B_pow2, n_items]
+    # score matrix at that size is an HBM OOM (measured: 131072×27k f32
+    # = 14.5GB on a 16GB v5e during the north-star eval).
+    B = len(user_indices)
+    k = min(k, model.n_items)
+    if B > _TOPK_CHUNK:
+        ids_parts, score_parts = [], []
+        for s in range(0, B, _TOPK_CHUNK):
+            i, sc = recommend_batch(
+                model, user_indices[s:s + _TOPK_CHUNK], k)
+            ids_parts.append(i)
+            score_parts.append(sc)
+        return (np.concatenate(ids_parts, axis=0),
+                np.concatenate(score_parts, axis=0))
+    Bp = 1
+    while Bp < B:
+        Bp *= 2
+    idx_dev = np.empty(Bp, dtype=np.int64)
+    idx_dev[:B] = user_indices
+    idx_dev[B:] = user_indices[0] if B else 0  # pad rows: any valid row
+    vecs = jnp.asarray(model.user_factors)[jnp.asarray(idx_dev)]
     scores, ids = _topk_scores(vecs, jnp.asarray(model.item_factors),
                                k=k_dev, n_items=model.n_items)
-    k = min(k, model.n_items)
-    return np.asarray(ids[:, :k]), np.asarray(scores[:, :k])
+    return (np.asarray(ids[:B, :k]), np.asarray(scores[:B, :k]))
 
 
 def predict_rating(model: ALSModel, user_index: int, item_index: int) -> float:

@@ -70,6 +70,17 @@ def gram_dispatch(F: jax.Array, w: jax.Array, mode: str,
         from .gram_autotune import best_mode
 
         mode = best_mode(F.shape[-1], bf16=bf16)
+        if mode == "pair" and F.shape[-3] % 2 == 0:
+            # the autotuned winner describes the ACCELERATOR; on a CPU
+            # lowering of the same trace (virtual-mesh dryruns on hosts
+            # where the TPU plugin is the default backend) pair's 2x
+            # multiplies are a pure loss — pick per lowering platform,
+            # mirroring solve.py's platform gate
+            return jax.lax.platform_dependent(
+                F, w,
+                tpu=lambda F, w: gram_pairs(F, w, bf16=bf16),
+                default=lambda F, w: gram_weighted(F, w, bf16=bf16))
+        return gram_weighted(F, w, bf16=bf16)
     if mode == "pair" and F.shape[-3] % 2 == 0:
         return gram_pairs(F, w, bf16=bf16)
     return gram_weighted(F, w, bf16=bf16)

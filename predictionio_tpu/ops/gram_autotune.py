@@ -112,14 +112,16 @@ def best_mode(rank: int, bf16: bool = False,
 
 def record(rank: int, mode: str, bf16: bool = False,
            device_kind: str | None = None,
-           measured: dict | None = None) -> None:
+           measured: dict | None = None) -> bool:
     """Persist a measured winner (atomic write; merge-on-write so
-    concurrent processes tuning different shapes don't clobber)."""
+    concurrent processes tuning different shapes don't clobber).
+    Returns whether anything was persisted — callers reporting
+    "recorded" must not claim success for a refused write."""
     if mode not in ("einsum", "pair"):
-        return
+        return False
     fam = device_family(device_kind)
     if fam in ("unknown", "cpu"):
-        return  # only persist real-accelerator measurements
+        return False  # only persist real-accelerator measurements
     path = _cache_path()
     ent = {"mode": mode}
     if measured:
@@ -138,15 +140,16 @@ def record(rank: int, mode: str, bf16: bool = False,
             if (isinstance(old, dict)
                     and prio.get(old.get("source"), 0)
                     > prio.get(ent.get("source"), 0)):
-                return
+                return False
             cur[key] = ent
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(cur, f, indent=1, sort_keys=True)
             os.replace(tmp, path)
         except OSError:
-            pass  # cache is advisory; never fail the caller
+            return False  # cache is advisory; never fail the caller
         _cache_mem = None  # re-overlay on next lookup
+        return True
 
 
 def reset_for_tests() -> None:

@@ -25,18 +25,36 @@ import jax.numpy as jnp
 _LANES = 128
 
 
-def _chol_solve_kernel(a_ref, b_ref, x_ref, A, acc):
+#: past this padded rank the [rp, rp, 128] block + a same-size scratch
+#: exceed VMEM (measured chip OOM at rp=128: 2×8.4MB). Up to _RP_ALIAS
+#: the kernel factors IN PLACE in an aliased input/output block (one
+#: buffer); beyond it no 128-lane layout fits (the lane dim cannot
+#: shrink below 128 — Mosaic rejects sub-lane minor blocks) and
+#: ``solve_spd_batch`` routes to XLA.
+_RP_SCRATCH = 88   # scratch variant: 2·rp²·128·4B ≤ ~8MB
+_RP_ALIAS = 128    # in-place variant: rp²·128·4B ≤ ~8.4MB
+_PANEL = 8         # column-panel width of the big-rank trailing update
+
+
+def _chol_body(A, b_ref, x_ref, acc, lref=None):
     """Factor + solve 128 SPD systems in lockstep.
 
-    a_ref: [r, r, B] (column, row, batch-in-lanes); b_ref/x_ref: [r, B].
-    ``A`` scratch holds the in-place factorization: after step k its
-    leading index k is column k of L (zeros above the diagonal). Both
-    substitution sweeps are formulated column-access-only (forward
-    right-looking, backward left-looking), so L is never transposed.
+    A: writable [r, r, B] ref (column, row, batch-in-lanes) already
+    holding the input; b_ref/x_ref: [r, B]. The factorization happens
+    in place: after step k, leading index k is column k of L (zeros
+    above the diagonal). Both substitution sweeps are formulated
+    column-access-only (forward right-looking, backward left-looking),
+    so L is never transposed.
+
+    With ``lref`` (a [r, B] scratch), the trailing rank-1 update runs
+    in COLUMN PANELS of ``_PANEL`` instead of one full-matrix
+    expression: ``A[:] - l⊗l`` materializes two matrix-sized
+    temporaries on the VMEM stack (2×8.4MB at r=128 — the measured
+    chip OOM even after the input/scratch aliasing), while the
+    panelized form's temporaries are ``_PANEL``·r·B floats.
     """
-    r = a_ref.shape[0]
-    B = a_ref.shape[2]
-    A[:] = a_ref[:]
+    r = A.shape[0]
+    B = A.shape[2]
     rows = jax.lax.broadcasted_iota(jnp.int32, (r, B), 0)
 
     def at_row(v, k):
@@ -49,7 +67,20 @@ def _chol_solve_kernel(a_ref, b_ref, x_ref, A, acc):
         piv = at_row(colk, k)  # [1, B]
         inv_sqrt = jax.lax.rsqrt(jnp.maximum(piv, 1e-30))
         l = colk * inv_sqrt * (rows >= k)
-        A[:] = A[:] - l[:, None, :] * l[None, :, :]
+        if lref is None:
+            A[:] = A[:] - l[:, None, :] * l[None, :, :]
+        else:
+            lref[:] = l
+
+            def panel(ci, c):
+                c0 = ci * _PANEL
+                lp = lref[pl.ds(c0, _PANEL)]          # [P, B]
+                A[pl.ds(c0, _PANEL)] = (
+                    A[pl.ds(c0, _PANEL)]
+                    - lp[:, None, :] * l[None, :, :])  # [P, r, B] temps
+                return c
+
+            jax.lax.fori_loop(0, r // _PANEL, panel, 0, unroll=False)
         A[k] = l
         return carry
 
@@ -84,6 +115,22 @@ def _chol_solve_kernel(a_ref, b_ref, x_ref, A, acc):
     x_ref[:] = acc[:]
 
 
+def _chol_solve_kernel(a_ref, b_ref, x_ref, A, acc):
+    """Scratch variant (rp <= _RP_SCRATCH): copy the input block into
+    VMEM scratch and factor there."""
+    A[:] = a_ref[:]
+    _chol_body(A, b_ref, x_ref, acc)
+
+
+def _chol_solve_kernel_inplace(a_ref, b_ref, aout_ref, x_ref, acc,
+                               lref):
+    """Aliased variant (rp <= _RP_ALIAS): ``aout_ref`` IS ``a_ref``
+    (input_output_aliases), so the factorization reuses the one block;
+    the panelized update (``lref``) keeps kernel temporaries off the
+    matrix scale — together these are what let rank 128 fit VMEM."""
+    _chol_body(aout_ref, b_ref, x_ref, acc, lref=lref)
+
+
 try:  # pallas import kept lazy-safe: CPU-only installs still work
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -96,10 +143,14 @@ except Exception:  # pragma: no cover
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _solve_spd_pallas(A: jax.Array, b: jax.Array,
                       interpret: bool = False) -> jax.Array:
-    """Pallas path: A [n, r, r] SPD (jitter already applied), b [n, r]."""
+    """Pallas path: A [n, r, r] SPD (jitter already applied), b [n, r].
+    Requires r <= _RP_ALIAS after sublane padding (the caller routes
+    larger ranks to XLA)."""
     n, r = A.shape[0], A.shape[-1]
     rp = max(((r + 7) // 8) * 8, 8)
-    np_ = ((n + _LANES - 1) // _LANES) * _LANES
+    assert rp <= _RP_ALIAS, f"rank {r} exceeds the Pallas VMEM budget"
+    lanes = _LANES
+    np_ = ((n + lanes - 1) // lanes) * lanes
     # pad rank with identity (keeps matrices SPD) and batch with identity
     if rp != r or np_ != n:
         eye = jnp.eye(rp, dtype=A.dtype)
@@ -112,24 +163,59 @@ def _solve_spd_pallas(A: jax.Array, b: jax.Array,
     # (row, col) vs (col, row) choice is immaterial on input)
     At = jnp.transpose(Ap, (2, 1, 0))
     bt = jnp.transpose(bp, (1, 0))
-    xt = pl.pallas_call(
-        _chol_solve_kernel,
-        grid=(np_ // _LANES,),
-        in_specs=[
-            pl.BlockSpec((rp, rp, _LANES), lambda i: (0, 0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((rp, _LANES), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((rp, _LANES), lambda i: (0, i),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rp, np_), A.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((rp, rp, _LANES), jnp.float32),
-            pltpu.VMEM((rp, _LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(At, bt)
+    mat_spec = pl.BlockSpec((rp, rp, lanes), lambda i: (0, 0, i),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((rp, lanes), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    if rp <= _RP_SCRATCH:
+        # scratch variant: input block + same-size scratch fit VMEM
+        xt = pl.pallas_call(
+            _chol_solve_kernel,
+            grid=(np_ // lanes,),
+            in_specs=[mat_spec, vec_spec],
+            out_specs=vec_spec,
+            out_shape=jax.ShapeDtypeStruct((rp, np_), A.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((rp, rp, lanes), jnp.float32),
+                pltpu.VMEM((rp, lanes), jnp.float32),
+            ],
+            interpret=interpret,
+        )(At, bt)
+    else:
+        # in-place variant for big ranks. Two VMEM tricks, both
+        # necessary at rp=128 (measured chip OOMs otherwise):
+        # - the matrix block doubles as an output (input_output_aliases)
+        #   and the factorization runs in place, and
+        # - each 128-lane slice is a GRIDLESS pallas_call driven by
+        #   ``lax.map``: with a grid, Mosaic double-buffers the in and
+        #   out blocks for pipelining (4×8.4MB > the 16MB scoped limit);
+        #   gridless, one buffer suffices.
+        nb = np_ // lanes
+        Ab = jnp.moveaxis(At.reshape(rp, rp, nb, lanes), 2, 0)
+        bb = jnp.moveaxis(bt.reshape(rp, nb, lanes), 1, 0)
+        whole = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+        def one(args):
+            a, b2 = args
+            _, x = pl.pallas_call(
+                _chol_solve_kernel_inplace,
+                in_specs=[whole, whole],
+                out_specs=[whole, whole],
+                out_shape=[
+                    jax.ShapeDtypeStruct((rp, rp, lanes), A.dtype),
+                    jax.ShapeDtypeStruct((rp, lanes), A.dtype),
+                ],
+                input_output_aliases={0: 0},
+                scratch_shapes=[
+                    pltpu.VMEM((rp, lanes), jnp.float32),
+                    pltpu.VMEM((rp, lanes), jnp.float32),
+                ],
+                interpret=interpret,
+            )(a, b2)
+            return x
+
+        xs = jax.lax.map(one, (Ab, bb))          # [nb, rp, lanes]
+        xt = jnp.moveaxis(xs, 0, 1).reshape(rp, np_)
     return jnp.transpose(xt, (1, 0))[:n, :r]
 
 
@@ -172,9 +258,11 @@ def solve_spd_batch(A: jax.Array, b: jax.Array,
                                           b[..., None])[..., 0]
 
     # the Pallas kernel's VMEM scratch is f32; non-f32 systems take the
-    # XLA path rather than hitting a dtype-mismatched kernel
+    # XLA path rather than hitting a dtype-mismatched kernel. Ranks past
+    # the VMEM budget (_RP_ALIAS) have no 128-lane Pallas layout at all.
     mode = _solver_mode()
-    if A.dtype != jnp.float32 or mode == "xla":
+    rp = max(((r + 7) // 8) * 8, 8)
+    if A.dtype != jnp.float32 or mode == "xla" or rp > _RP_ALIAS:
         return _xla(A, b)
     if mode == "pallas":
         return _pallas(A, b)

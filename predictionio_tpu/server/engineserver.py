@@ -70,6 +70,13 @@ class ServerConfig:
     #: ``CreateServer.scala:435-446``); never fails the query.
     log_url: Optional[str] = None
     log_prefix: str = ""
+    #: Compile the serving device kernels for every batch size the
+    #: micro-batcher can produce (the pow2 ladder) BEFORE traffic hits
+    #: them. Each novel shape is a fresh XLA compile — measured 6-20s
+    #: through a device tunnel, which is exactly the round-4 microbatch
+    #: p90/p99 pathology. Runs in a background thread; ``/status.json``
+    #: exposes ``servingWarm``.
+    warm_start: bool = True
 
 
 class QueryServer:
@@ -100,6 +107,30 @@ class QueryServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        self.warm_done = threading.Event()
+        if self.config.warm_start:
+            threading.Thread(target=self._warm_serving, daemon=True,
+                             name="serving-warmup").start()
+        else:
+            self.warm_done.set()
+
+    def _warm_serving(self) -> None:
+        """Pre-compile the serving path's device shapes (single query +
+        the batcher's pow2 ladder) so first traffic never pays a
+        compile. Algorithms opt in by implementing
+        ``warm_serving(model, max_batch)``; failures only log — a cold
+        cache is slow, not broken."""
+        max_b = self.config.max_batch if self.config.batching else 1
+        for algo, model in zip(self.algorithms, self.models):
+            warm = getattr(algo, "warm_serving", None)
+            if warm is None:
+                continue
+            try:
+                warm(model, max_b)
+            except Exception as e:  # noqa: BLE001 — warm the rest
+                log.warning("serving warmup failed for %s: %s",
+                            type(algo).__name__, e)
+        self.warm_done.set()
 
     def _bind(self, engine_params: EngineParams, models: List[Any],
               instance: EngineInstance) -> None:
@@ -264,6 +295,14 @@ class QueryServer:
         models = wf.load_models_for_deploy(self.ctx, self.engine, latest,
                                            engine_params)
         self._bind(engine_params, models, latest)
+        # the swapped-in models may have new device shapes (catalog
+        # growth changes the compiled [B, n_items] kernels) — re-warm so
+        # post-reload traffic doesn't pay cold compiles while
+        # /status.json still says warm
+        if self.config.warm_start:
+            self.warm_done.clear()
+            threading.Thread(target=self._warm_serving, daemon=True,
+                             name="serving-rewarm").start()
         log.info("reloaded engine instance %s", latest.id)
         return latest.id
 
@@ -301,6 +340,7 @@ def build_app(server: QueryServer) -> HTTPApp:
             "requestCount": server.request_count,
             "avgServingSec": server.avg_serving_sec,
             "lastServingSec": server.last_serving_sec,
+            "servingWarm": server.warm_done.is_set(),
         })
 
     @app.route("POST", "/queries.json")

@@ -232,6 +232,21 @@ class ALSAlgorithm(Algorithm):
         return PredictedResult(tuple(
             ItemScore(item=inv[i], score=s) for i, s in out))
 
+    def warm_serving(self, model: ALSModel, max_batch: int = 1) -> None:
+        """Pre-compile the serving device kernels for the single-query
+        path and every pow2 batch size the micro-batcher can produce
+        (each novel shape is a fresh XLA compile — 6-20s through a
+        device tunnel; cf. ``ServerConfig.warm_start``)."""
+        if model.user_ids is None or len(model.user_ids) == 0:
+            return
+        from ..models.als import recommend_batch, recommend_products
+
+        recommend_products(model, 0, 10)
+        b = 1
+        while b <= max(max_batch, 1):
+            recommend_batch(model, np.zeros(b, dtype=np.int64), 10)
+            b *= 2
+
     def batch_predict(self, model: ALSModel, queries: Sequence[Query]
                       ) -> List[PredictedResult]:
         """One batched device dispatch for all known users

@@ -255,6 +255,18 @@ class SeqRecAlgorithm(Algorithm):
         return PredictedResult(tuple(
             ItemScore(item=inv[i], score=s) for i, s in out))
 
+    def warm_serving(self, model: SeqRecModel,
+                     max_batch: int = 1) -> None:
+        """Pre-compile the serving kernels for the pow2 batch ladder
+        (cf. ``ServerConfig.warm_start``; each novel shape is a fresh
+        XLA compile, 6-20s through a device tunnel)."""
+        if model.n_items <= 0:
+            return
+        b = 1
+        while b <= max(max_batch, 1):
+            recommend_next_batch(model, [[0]] * b, k=10)
+            b *= 2
+
     def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
         # single-query = batch of one: exactly one over-fetch rule
         return self.batch_predict(model, [query])[0]

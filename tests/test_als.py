@@ -211,6 +211,31 @@ class TestRecommend:
             np.testing.assert_array_equal(ids_b[row], ids_s)
             np.testing.assert_allclose(scores_b[row], scores_s, rtol=1e-6)
 
+    def test_batch_axis_padded_to_pow2_shapes(self):
+        """Serving-path jit-cache bound: arbitrary micro-batch sizes
+        must collapse onto power-of-two compiled shapes (each novel
+        [B, r] shape is a fresh XLA compile — measured 10-20s through
+        the device tunnel, the round-4 microbatch p90 pathology)."""
+        from predictionio_tpu.models.als import _topk_scores
+
+        model, _ = self._model()
+        # force the device path regardless of model size heuristics
+        import predictionio_tpu.models.als as als
+
+        orig = als._serve_on_host
+        als._serve_on_host = lambda *a, **k: False
+        try:
+            before = _topk_scores._cache_size()
+            for batch in ([0], [0, 1], [0, 1, 2], [0, 1, 2, 3],
+                          [0] * 5, [0] * 7):
+                ids, _ = recommend_batch(model, np.array(batch), 3)
+                assert ids.shape[0] == len(batch)
+            added = _topk_scores._cache_size() - before
+            # sizes {1,2,3,4,5,7} collapse to padded {1,2,4,8}
+            assert added <= 4, f"cache grew by {added} (> 4 shapes)"
+        finally:
+            als._serve_on_host = orig
+
     def test_padded_items_never_recommended(self, mesh8):
         ratings, _, _ = make_synthetic(n_users=16, n_items=10, seed=5)
         params = ALSParams(rank=3, num_iterations=2, seed=0)

@@ -371,3 +371,35 @@ class TestPluginREST:
                         "/plugins/badtype/echo")[0] == 404
         finally:
             srv.shutdown()
+
+
+class TestServingWarmup:
+    def test_warm_serving_flag_and_hook(self, trained_ctx):
+        """ServerConfig.warm_start pre-compiles the serving shapes via
+        the algorithm's warm_serving hook and flips /status.json's
+        servingWarm (round-4: each cold batch shape cost a 6-20s XLA
+        compile through the device tunnel DURING serving)."""
+        from predictionio_tpu.server.engineserver import (
+            QueryServer,
+            ServerConfig,
+        )
+        from predictionio_tpu.workflow.core import (
+            get_latest_completed,
+            load_models_for_deploy,
+        )
+
+        ctx, engine, ep = trained_ctx
+        inst = get_latest_completed(ctx, engine_id="srv")
+        models = load_models_for_deploy(ctx, engine, inst, ep)
+
+        # the hook exists on the shipped template and runs clean
+        assert hasattr(engine.make_algorithms(ep)[0], "warm_serving")
+
+        qs = QueryServer(ctx, engine, ep, models, inst,
+                         ServerConfig(batching=True, max_batch=8))
+        assert qs.warm_done.wait(timeout=60)
+
+        # warm_start=False: no thread, immediately "warm"
+        qs2 = QueryServer(ctx, engine, ep, models, inst,
+                          ServerConfig(warm_start=False))
+        assert qs2.warm_done.is_set()
