@@ -433,8 +433,11 @@ def supervise() -> int:
                 # become the stale-fallback artifact; ad-hoc partial
                 # runs (BENCH_SKIP_QUALITY, BENCH_SERVING=0, alternate
                 # ranks) must not degrade the driver's last-good
+                serving_ok = isinstance(
+                    (parsed.get("serving") or {}).get("per_query"),
+                    dict)
                 full = (parsed.get("ndcg10") is not None
-                        and parsed.get("serving") is not None
+                        and serving_ok
                         and parsed.get("rank") == 64)
                 if full and "TPU" in str(parsed.get("device", "")):
                     # remember the last real-chip result for the

@@ -1,0 +1,96 @@
+"""Whole-iteration scaling law on the attached device.
+
+The round-4 on-chip gram profile showed every profiled stage (gather,
+gram, solve) at multi-TF/s while the full training iteration achieves
+0.83 TF/s — so the bound is something the per-stage view misses. This
+probe fits the iteration's scaling empirically: time the fused trainer
+across an (nnz, rank) grid with the packing amortized.
+
+- time ∝ nnz, flat in rank      → HBM/gather-bound (bytes per entry)
+- time ∝ nnz·rank²              → compute-bound (the gram/solve math)
+- large nnz-independent offset  → dispatch/fusion overhead
+
+Each cell reports seconds/iteration (best of GRID_REPS, hard-synced)
+and the padded-FLOP-model TF/s, one JSON line per cell.
+
+Usage: python benchmarks/iter_scaling.py   (from the repo root)
+Env:   GRID_NNZ="2000000,20000000" GRID_RANKS="32,64" GRID_REPS=3
+       GRID_ITERS=5
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    nnzs = [int(x) for x in os.environ.get(
+        "GRID_NNZ", "2000000,6000000,20000000").split(",")]
+    ranks = [int(x) for x in os.environ.get(
+        "GRID_RANKS", "32,64,128").split(",")]
+    reps = int(os.environ.get("GRID_REPS", "3"))
+    iters = int(os.environ.get("GRID_ITERS", "5"))
+
+    import jax
+
+    from predictionio_tpu.models.als import (
+        ALSParams,
+        RatingsCOO,
+        als_flops_per_iter,
+        pack_ratings,
+        train_als,
+    )
+
+    dev = jax.devices()[0].device_kind
+
+    def hard_sync(x):
+        np.asarray(jax.device_get(x[0, :1]))
+
+    for nnz in nnzs:
+        n_users = max(int(138_000 * nnz / 20_000_000), 64)
+        n_items = max(int(27_000 * nnz / 20_000_000), 64)
+        items = (np.random.default_rng(1).zipf(1.3, size=nnz)
+                 % n_items).astype(np.int32)
+        users = np.random.default_rng(0).integers(
+            0, n_users, nnz).astype(np.int32)
+        ratings = RatingsCOO(users, items,
+                             np.ones(nnz, np.float32), n_users, n_items)
+        for rank in ranks:
+            params = ALSParams(rank=rank, num_iterations=iters,
+                               implicit_prefs=True, alpha=40.0,
+                               reg=0.01, seed=3)
+            try:
+                packed = pack_ratings(ratings, params)
+                U, V = train_als(ratings, params, packed=packed)  # warm
+                hard_sync(V)
+                best = float("inf")
+                for _ in range(reps):
+                    t0 = time.monotonic()
+                    U, V = train_als(ratings, params, packed=packed)
+                    hard_sync(V)
+                    best = min(best, time.monotonic() - t0)
+                fl = als_flops_per_iter(packed[0], packed[1], params)
+                print(json.dumps({
+                    "nnz": nnz, "rank": rank,
+                    "s_per_iter": round(best / iters, 4),
+                    "ratings_per_s_per_iter": round(
+                        nnz * iters / best, 1),
+                    "model_tflops": round(fl * iters / best / 1e12, 3),
+                    "device": dev,
+                }), flush=True)
+            except Exception as e:  # noqa: BLE001 — next cell
+                print(json.dumps({
+                    "nnz": nnz, "rank": rank,
+                    "error": str(e)[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -723,12 +723,14 @@ def cmd_import(args, storage: Storage) -> int:
             total += len(events)
     except Exception as e:  # noqa: BLE001 — report durable progress
         _err(f"Import failed near line {lineno}: {e}")
+        app_flag = f"--app {args.app}" if args.app \
+            else f"--appid {args.appid}"
         _err(f"{total} event(s) (input lines 1-{committed_through}) "
              f"are already committed. Re-importing this file would "
              f"DUPLICATE them — resume with the remainder only, e.g.: "
              f"tail -n +{committed_through + 1} {args.input} > rest."
-             f"jsonl && ptpu import --input rest.jsonl (or app "
-             f"data-delete to start over).")
+             f"jsonl && ptpu import {app_flag} --input rest.jsonl "
+             f"(or app data-delete to start over).")
         return 1
     _out(f"Imported {total} event(s).")
     return 0

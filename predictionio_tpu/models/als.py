@@ -589,8 +589,15 @@ def _pack(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
         else:
             counts = np.bincount(rows, minlength=n_rows)
             L_full = int(counts.max(initial=1))
-            mode = "pad" if n_rows * L_full <= AUTO_CAP_ENTRIES \
-                else "bucket"
+            slots = n_rows * L_full
+            # pad must fit the absolute cap AND not waste HBM: at skew,
+            # rows padded to the longest history can blow memory by 30x+
+            # (measured: a 5%-sample eval fold padded 0.5M entries into
+            # 33M slots per side — RESOURCE_EXHAUSTED through the device
+            # tunnel). The bucketed layout bounds waste at ~2x.
+            dense_enough = slots <= max(4 * len(rows), 1_000_000)
+            mode = "pad" if (slots <= AUTO_CAP_ENTRIES
+                             and dense_enough) else "bucket"
     if mode == "bucket":
         return pack_histories_bucketed_device(
             rows, cols, vals, n_rows, pad_rows_to=n_dev,

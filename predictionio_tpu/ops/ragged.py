@@ -194,8 +194,12 @@ def pack_histories_split_device(rows: np.ndarray, cols: np.ndarray,
         jnp.asarray(groups, dtype=jnp.int32),
         n_rows=n_rows, L=L, n_vpad=n_vpad, n_virtual=n_virtual,
         n_rows_pad=n_rows_pad)
-    return SplitHistories(indices=idx, values=val, counts=vcnt,
-                          row_ids=row_ids, real_counts=real_counts,
+    # host-land for the same reason as the bucketed pack: only the
+    # blocked copies belong in HBM
+    return SplitHistories(indices=np.asarray(idx), values=np.asarray(val),
+                          counts=np.asarray(vcnt),
+                          row_ids=np.asarray(row_ids),
+                          real_counts=np.asarray(real_counts),
                           n_rows=n_rows)
 
 
@@ -358,6 +362,13 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
         jnp.asarray(row_base, dtype=jnp.int32),
         jnp.asarray(counts, dtype=jnp.int32),  # post-cap per-row budget
         n_rows=n_rows, S=S)
+    # land the packed layout on HOST: the only device-resident form
+    # should be the BLOCKED (mesh-shaped) copies that training actually
+    # reads (``PackedRatings.blocked``). Keeping these slices on device
+    # made every pack live twice in HBM — measured as the eval sweep's
+    # RESOURCE_EXHAUSTED with fold packs held by the fast-eval cache.
+    flat_idx = np.asarray(flat_idx)
+    flat_val = np.asarray(flat_val)
     buckets = []
     for L, rows_k, n_bk_pad, off in plan:
         n_bk = len(rows_k)
@@ -464,7 +475,12 @@ def pack_histories_device(rows: np.ndarray, cols: np.ndarray,
         jnp.asarray(cols, dtype=jnp.int32),
         jnp.asarray(vals, dtype=jnp.float32),
         n_rows=n_rows, L=L, n_pad=n_pad)
-    return PaddedHistories(indices=idx, values=val, counts=cnt)
+    # host-land (same reason as the bucketed/split packs): the only
+    # device-resident form should be the blocked copies training reads —
+    # keeping these too doubled every pack's HBM footprint
+    return PaddedHistories(indices=np.asarray(idx),
+                           values=np.asarray(val),
+                           counts=np.asarray(cnt))
 
 
 def _pack_on_device(r, c, v, *, n_rows: int, L: int, n_pad: int):

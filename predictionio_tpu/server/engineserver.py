@@ -108,18 +108,21 @@ class QueryServer:
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self.warm_done = threading.Event()
+        self._warm_gen = 0  # stale warm threads must not set the event
         if self.config.warm_start:
-            threading.Thread(target=self._warm_serving, daemon=True,
-                             name="serving-warmup").start()
+            threading.Thread(target=self._warm_serving, args=(0,),
+                             daemon=True, name="serving-warmup").start()
         else:
             self.warm_done.set()
 
-    def _warm_serving(self) -> None:
+    def _warm_serving(self, gen: int) -> None:
         """Pre-compile the serving path's device shapes (single query +
         the batcher's pow2 ladder) so first traffic never pays a
         compile. Algorithms opt in by implementing
         ``warm_serving(model, max_batch)``; failures only log — a cold
-        cache is slow, not broken."""
+        cache is slow, not broken. ``gen`` guards against a stale
+        deploy-time thread flipping ``warm_done`` while a post-reload
+        re-warm (newer generation) is still compiling new shapes."""
         max_b = self.config.max_batch if self.config.batching else 1
         for algo, model in zip(self.algorithms, self.models):
             warm = getattr(algo, "warm_serving", None)
@@ -130,7 +133,8 @@ class QueryServer:
             except Exception as e:  # noqa: BLE001 — warm the rest
                 log.warning("serving warmup failed for %s: %s",
                             type(algo).__name__, e)
-        self.warm_done.set()
+        if gen == self._warm_gen:
+            self.warm_done.set()
 
     def _bind(self, engine_params: EngineParams, models: List[Any],
               instance: EngineInstance) -> None:
@@ -301,7 +305,9 @@ class QueryServer:
         # /status.json still says warm
         if self.config.warm_start:
             self.warm_done.clear()
-            threading.Thread(target=self._warm_serving, daemon=True,
+            self._warm_gen += 1
+            threading.Thread(target=self._warm_serving,
+                             args=(self._warm_gen,), daemon=True,
                              name="serving-rewarm").start()
         log.info("reloaded engine instance %s", latest.id)
         return latest.id

@@ -241,10 +241,23 @@ class ALSAlgorithm(Algorithm):
             return
         from ..models.als import recommend_batch, recommend_products
 
-        recommend_products(model, 0, 10)
+        # k ladder: batch_predict fetches k = num + blacklist-length,
+        # and each pow2 k bucket is its own compiled shape
+        ks = []
+        k = 8
+        while k <= min(128, model.n_items):
+            ks.append(k)
+            k *= 2
+        ks = ks or [min(8, model.n_items)]
+        for k in ks:
+            recommend_products(model, 0, k)
         b = 1
-        while b <= max(max_batch, 1):
-            recommend_batch(model, np.zeros(b, dtype=np.int64), 10)
+        top = max(max_batch, 1)
+        while True:
+            for k in ks:
+                recommend_batch(model, np.zeros(b, dtype=np.int64), k)
+            if b >= top:  # b is the pow2 ceiling of max_batch: every
+                break     # runtime batch pads to a warmed shape
             b *= 2
 
     def batch_predict(self, model: ALSModel, queries: Sequence[Query]

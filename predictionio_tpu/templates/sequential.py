@@ -263,8 +263,11 @@ class SeqRecAlgorithm(Algorithm):
         if model.n_items <= 0:
             return
         b = 1
-        while b <= max(max_batch, 1):
+        top = max(max_batch, 1)
+        while True:
             recommend_next_batch(model, [[0]] * b, k=10)
+            if b >= top:  # pow2 ceiling: the padded largest batch too
+                break
             b *= 2
 
     def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:

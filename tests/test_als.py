@@ -846,3 +846,60 @@ class TestPadFusedTrainer:
         np.testing.assert_allclose(np.asarray(Vm)[:coo.n_items],
                                    np.asarray(Vb)[:coo.n_items],
                                    rtol=2e-3, atol=2e-4)
+
+
+class TestAutoLayoutWasteBound:
+    def test_skewed_but_under_cap_picks_bucket(self):
+        """auto layout must bound padding WASTE, not just absolute
+        size: a 5%-sample eval fold padded 0.5M entries into 33M slots
+        per side (30x waste) and exhausted device memory (round 4).
+        Skewed counts under the absolute cap now go bucketed."""
+        from predictionio_tpu.models.als import _pack
+        from predictionio_tpu.ops.ragged import (
+            BucketedHistories,
+            PaddedHistories,
+        )
+
+        rng = np.random.default_rng(0)
+        n_rows, nnz = 30_000, 400_000
+        # one mega-row (L_full ~ 4k) over a light tail: slots ~ 123M
+        # (< 200M cap) but waste ~ 300x
+        rows = rng.integers(0, n_rows, nnz).astype(np.int32)
+        rows[:4_000] = 7
+        cols = rng.integers(0, 1000, nnz).astype(np.int32)
+        vals = np.ones(nnz, np.float32)
+        params = ALSParams(rank=4, history_mode="auto")
+        h = _pack(rows, cols, vals, n_rows, params, n_dev=1)
+        assert isinstance(h, BucketedHistories)
+
+        # dense counts (waste <= 4x) still take the simpler pad path
+        rows_d = np.repeat(np.arange(2000, dtype=np.int32), 50)
+        cols_d = rng.integers(0, 100, len(rows_d)).astype(np.int32)
+        h2 = _pack(rows_d, cols_d, np.ones(len(rows_d), np.float32),
+                   2000, params, n_dev=1)
+        assert isinstance(h2, PaddedHistories)
+
+    def test_packs_are_host_resident(self):
+        """Packed layouts live on HOST; only PackedRatings.blocked()
+        ships mesh-shaped copies to the device (keeping both doubled
+        HBM per pack — the round-4 eval OOM)."""
+        from predictionio_tpu.models.als import _pack
+        rng = np.random.default_rng(1)
+        rows = rng.integers(0, 500, 20_000).astype(np.int32)
+        cols = rng.integers(0, 300, 20_000).astype(np.int32)
+        vals = np.ones(20_000, np.float32)
+        for mode in ("pad", "bucket", "split"):
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                h = _pack(rows, cols, vals, 500,
+                          ALSParams(rank=4, history_mode=mode), n_dev=1)
+            arrs = []
+            if hasattr(h, "buckets"):
+                for b in h.buckets:
+                    arrs += [b.indices, b.values]
+            else:
+                arrs += [h.indices, h.values]
+            for a in arrs:
+                assert isinstance(a, np.ndarray), (mode, type(a))
