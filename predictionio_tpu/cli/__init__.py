@@ -681,8 +681,6 @@ def cmd_export(args, storage: Storage) -> int:
 def cmd_import(args, storage: Storage) -> int:
     """``pio import`` (``tools/imprt/FileToEvents.scala``): JSON-lines →
     event store."""
-    from ..data.event import Event
-
     a = storage.apps().get_by_name(args.app) if args.app else \
         storage.apps().get(args.appid)
     if a is None:
@@ -695,42 +693,32 @@ def cmd_import(args, storage: Storage) -> int:
             _err(f"Channel {args.channel} does not exist. Aborting.")
             return 1
         channel_id = ch.id
-    # stream in chunks: a 20M-line import must not materialize every
-    # Event at once. Each chunk keeps insert_batch's all-or-nothing
-    # contract, so a mid-file failure leaves exactly the reported
-    # earlier chunks committed — say so instead of dying with a
-    # traceback and an unknown amount of half-imported data.
+    # import streams in chunks (a 20M-line file must not materialize
+    # every Event at once), each committed all-or-nothing — backends
+    # with a native bulk lane (segmentfs) override import_jsonl with a
+    # one-pass C++ encode. A mid-file failure reports exactly which
+    # durable prefix is committed instead of dying with a traceback
+    # and an unknown amount of half-imported data.
+    from ..data.storage.base import JsonlImportError
+
     chunk = int(os.environ.get("PIO_IMPORT_BATCH", "100000"))
-    events: list = []
-    total = 0
-    lineno = 0
-    committed_through = 0  # last LINE NUMBER fully committed
     try:
-        with open(args.input, "r", encoding="utf-8") as f:
-            for line in f:
-                lineno += 1
-                line = line.strip()
-                if line:
-                    events.append(Event.from_json(json.loads(line)))
-                if len(events) >= chunk:
-                    storage.events().insert_batch(events, a.id,
-                                                  channel_id)
-                    total += len(events)
-                    committed_through = lineno
-                    events = []
-        if events:
-            storage.events().insert_batch(events, a.id, channel_id)
-            total += len(events)
-    except Exception as e:  # noqa: BLE001 — report durable progress
-        _err(f"Import failed near line {lineno}: {e}")
+        total = storage.events().import_jsonl(
+            args.input, a.id, channel_id, chunk=chunk)
+    except JsonlImportError as err:
+        _err(f"Import failed near line {err.lineno}: {err.cause}")
         app_flag = f"--app {args.app}" if args.app \
             else f"--appid {args.appid}"
-        _err(f"{total} event(s) (input lines 1-{committed_through}) "
-             f"are already committed. Re-importing this file would "
-             f"DUPLICATE them — resume with the remainder only, e.g.: "
-             f"tail -n +{committed_through + 1} {args.input} > rest."
+        _err(f"{err.committed_events} event(s) (input lines "
+             f"1-{err.committed_lines}) are already committed. "
+             f"Re-importing this file would DUPLICATE them — resume "
+             f"with the remainder only, e.g.: "
+             f"tail -n +{err.committed_lines + 1} {args.input} > rest."
              f"jsonl && ptpu import {app_flag} --input rest.jsonl "
              f"(or app data-delete to start over).")
+        return 1
+    except OSError as e:
+        _err(f"Import failed: {e}")
         return 1
     _out(f"Imported {total} event(s).")
     return 0

@@ -96,6 +96,22 @@ class StorageError(RuntimeError):
     pass
 
 
+class JsonlImportError(Exception):
+    """A bulk JSONL import failed partway. ``lineno`` is where it
+    failed, ``committed_lines``/``committed_events`` how far the
+    durable prefix reaches (re-importing the whole file would
+    duplicate that prefix under fresh ids)."""
+
+    def __init__(self, lineno: int, committed_lines: int,
+                 committed_events: int, cause: BaseException):
+        super().__init__(
+            f"import failed near line {lineno}: {cause}")
+        self.lineno = lineno
+        self.committed_lines = committed_lines
+        self.committed_events = committed_events
+        self.cause = cause
+
+
 class EventStore(abc.ABC):
     """Append-only event log, partitioned by (app_id, channel_id)."""
 
@@ -149,6 +165,43 @@ class EventStore(abc.ABC):
                     pass
             raise
         return done
+
+    def import_jsonl(self, path: str, app_id: int,
+                     channel_id: Optional[int] = None,
+                     chunk: int = 100_000) -> int:
+        """Bulk-load a file of API-format JSON lines (``pio import``,
+        ``tools/imprt/FileToEvents.scala``), committing every ``chunk``
+        events via :meth:`insert_batch` (all-or-nothing per chunk).
+        Returns the number of events imported; on failure raises
+        :class:`JsonlImportError` carrying how far the durable prefix
+        reaches so the caller can print a resume recipe. Backends with
+        a bulk encode lane (segmentfs + the native codec) override
+        this."""
+        import json as _json
+
+        total = 0
+        lineno = 0
+        committed = 0  # last LINE NUMBER fully committed
+        events: List[Event] = []
+        f = open(path, "r", encoding="utf-8")  # missing file: clean OSError
+        try:
+            with f:
+                for line in f:
+                    lineno += 1
+                    line = line.strip()
+                    if line:
+                        events.append(Event.from_json(_json.loads(line)))
+                    if len(events) >= chunk:
+                        self.insert_batch(events, app_id, channel_id)
+                        total += len(events)
+                        committed = lineno
+                        events = []
+            if events:
+                self.insert_batch(events, app_id, channel_id)
+                total += len(events)
+        except Exception as e:  # noqa: BLE001 — report durable progress
+            raise JsonlImportError(lineno, committed, total, e) from e
+        return total
 
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int,

@@ -520,9 +520,510 @@ PyObject* parse_segment(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---------------------------------------------------------------------
+// Bulk import lane: API-format JSONL -> segment payload, one C++ pass.
+//
+// `ptpu import` was measured at ~12k events/s/core through the Python
+// pipeline (json.loads -> Event.from_json -> to_json -> json.dumps,
+// each about a third of the cost). This converts a whole chunk of
+// API-JSON lines straight into the segmentfs record format
+// ({"op": "put", "event": {...}}), validating the reference's event
+// rules (Event.scala:112-160 parity, same checks as
+// data/event.py:validate_event) and normalizing timestamps to the
+// framework's canonical isoformat-millis wire form. Anything this
+// strict lane can't prove it handles EXACTLY like the Python path
+// (exotic ISO forms, lone surrogates, non-string optional fields,
+// validation failures that must raise the canonical message) makes the
+// whole chunk fall back to the Python lane — the fast path never
+// guesses.
+
+long long days_from_civil(long long y, unsigned m, unsigned d) {
+  // Howard Hinnant's civil-days algorithm (public domain).
+  y -= m <= 2;
+  const long long era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + static_cast<long long>(doe) - 719468;
+}
+
+void civil_from_days(long long z, long long* yy, unsigned* mm,
+                     unsigned* dd) {
+  z += 719468;
+  const long long era = (z >= 0 ? z : z - 146096) / 146097;
+  const unsigned doe = static_cast<unsigned>(z - era * 146097);
+  const unsigned yoe =
+      (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+  const long long y = static_cast<long long>(yoe) + era * 400;
+  const unsigned doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+  const unsigned mp = (5 * doy + 2) / 153;
+  const unsigned d = doy - (153 * mp + 2) / 5 + 1;
+  const unsigned m = mp < 10 ? mp + 3 : mp - 9;
+  *yy = y + (m <= 2);
+  *mm = m;
+  *dd = d;
+}
+
+int days_in_month(int y, int m) {
+  static const int dm[] = {31, 28, 31, 30, 31, 30,
+                           31, 31, 30, 31, 30, 31};
+  if (m == 2 && (y % 4 == 0 && (y % 100 != 0 || y % 400 == 0)))
+    return 29;
+  return dm[m - 1];
+}
+
+bool ndigits(const char*& p, const char* end, int n, int* out) {
+  if (end - p < n) return false;
+  int v = 0;
+  for (int i = 0; i < n; ++i) {
+    if (p[i] < '0' || p[i] > '9') return false;
+    v = v * 10 + (p[i] - '0');
+  }
+  p += n;
+  *out = v;
+  return true;
+}
+
+// Strict ISO-8601 subset -> epoch millis UTC. Covers the framework's
+// own wire form plus the common offset spellings; anything else
+// returns false and the chunk takes the Python lane (whose
+// datetime.fromisoformat accepts more). Fraction truncates to millis,
+// matching isoformat_millis (microsecond // 1000).
+bool parse_iso_millis(const std::string& s, long long* out_ms) {
+  const char* p = s.c_str();
+  const char* end = p + s.size();
+  int y, mo, d;
+  if (!ndigits(p, end, 4, &y)) return false;
+  if (p >= end || *p != '-') return false;
+  ++p;
+  if (!ndigits(p, end, 2, &mo)) return false;
+  if (p >= end || *p != '-') return false;
+  ++p;
+  if (!ndigits(p, end, 2, &d)) return false;
+  if (y < 1 || mo < 1 || mo > 12 || d < 1 || d > days_in_month(y, mo))
+    return false;  // Python's datetime is bounded to years 1..9999
+  int hh = 0, mi = 0, ss = 0, ms = 0;
+  int off_h = 0, off_m = 0, off_s = 0;
+  bool neg_off = false;
+  if (p < end) {
+    if (*p != 'T' && *p != 't' && *p != ' ') return false;
+    ++p;
+    if (!ndigits(p, end, 2, &hh)) return false;
+    if (p < end && *p == ':') {
+      ++p;
+      if (!ndigits(p, end, 2, &mi)) return false;
+      if (p < end && *p == ':') {
+        ++p;
+        if (!ndigits(p, end, 2, &ss)) return false;
+        if (p < end && *p == '.') {
+          ++p;
+          int nd = 0;
+          long frac = 0;
+          while (p < end && *p >= '0' && *p <= '9') {
+            if (nd < 3) {
+              frac = frac * 10 + (*p - '0');
+              ++nd;
+            }
+            ++p;
+          }
+          if (nd == 0) return false;
+          while (nd < 3) {
+            frac *= 10;
+            ++nd;
+          }
+          ms = static_cast<int>(frac);
+        }
+      }
+    }
+    if (hh > 23 || mi > 59 || ss > 59) return false;
+    if (p < end) {
+      char c = *p;
+      if (c == 'Z' || c == 'z') {
+        ++p;
+      } else if (c == '+' || c == '-') {
+        neg_off = (c == '-');
+        ++p;
+        if (!ndigits(p, end, 2, &off_h)) return false;
+        if (p < end && *p == ':') {
+          ++p;
+          if (!ndigits(p, end, 2, &off_m)) return false;
+          if (p < end && *p == ':') {
+            ++p;
+            if (!ndigits(p, end, 2, &off_s)) return false;
+          }
+        } else if (p < end && *p >= '0' && *p <= '9') {
+          if (!ndigits(p, end, 2, &off_m)) return false;
+        }
+      } else {
+        return false;
+      }
+    }
+  }
+  if (p != end) return false;
+  if (off_h > 23 || off_m > 59 || off_s > 59)
+    return false;  // fromisoformat rejects offsets >= 24h
+  long long secs = days_from_civil(y, static_cast<unsigned>(mo),
+                                   static_cast<unsigned>(d)) * 86400LL +
+                   hh * 3600LL + mi * 60LL + ss;
+  long long off = off_h * 3600LL + off_m * 60LL + off_s;
+  secs -= neg_off ? -off : off;
+  *out_ms = secs * 1000 + ms;
+  // the offset shift must not cross Python's year 1..9999 bounds —
+  // the Python lane raises (astimezone OverflowError) and fails the
+  // import cleanly; publishing such a timestamp would poison every
+  // subsequent replay of the log
+  static const long long kMinMs = days_from_civil(1, 1, 1) * 86400000LL;
+  static const long long kMaxMs =
+      (days_from_civil(9999, 12, 31) + 1) * 86400000LL - 1;
+  return *out_ms >= kMinMs && *out_ms <= kMaxMs;
+}
+
+void emit_iso_millis(long long ms, std::string& out) {
+  long long secs = ms / 1000;
+  int milli = static_cast<int>(ms % 1000);
+  if (milli < 0) {
+    milli += 1000;
+    secs -= 1;
+  }
+  long long days = secs / 86400;
+  long long rem = secs % 86400;
+  if (rem < 0) {
+    rem += 86400;
+    days -= 1;
+  }
+  long long y;
+  unsigned mo, d;
+  civil_from_days(days, &y, &mo, &d);
+  char buf[48];
+  snprintf(buf, sizeof buf,
+           "%04lld-%02u-%02uT%02lld:%02lld:%02lld.%03dZ", y, mo, d,
+           rem / 3600, (rem % 3600) / 60, rem % 60, milli);
+  out += buf;
+}
+
+void emit_json_string(std::string& out, const char* s, size_t n) {
+  out.push_back('"');
+  for (size_t i = 0; i < n; ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char b[8];
+          snprintf(b, sizeof b, "\\u%04x", c);
+          out += b;
+        } else {
+          out.push_back(static_cast<char>(c));  // raw UTF-8 is fine
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+bool reserved_name(const std::string& s) {
+  return (!s.empty() && s[0] == '$') || s.rfind("pio_", 0) == 0;
+}
+
+struct ImpRec {
+  std::string event, etype, eid, evid, etime, ctime;
+  std::string ttype, tid;
+  bool has_tt = false, has_ti = false;
+  bool has_evid = false, has_etime = false, has_ctime = false;
+  const char* props_b = nullptr;
+  const char* props_e = nullptr;
+  size_t props_n = 0;
+  const char* tags_b = nullptr;
+  const char* tags_e = nullptr;
+  bool tags_nonempty = false;
+  const char* prid_b = nullptr;
+  const char* prid_e = nullptr;
+  bool has_prid = false;
+};
+
+// string -> 0, null -> 1, anything else -> -1 (Python lane decides)
+int parse_str_or_null(Parser& ps, std::string& out) {
+  ps.skip_ws();
+  if (ps.p < ps.end && *ps.p == 'n')
+    return ps.skip_literal("null", 4) ? 1 : -1;
+  return ps.parse_string(out) ? 0 : -1;
+}
+
+bool parse_import_event(Parser& ps, ImpRec& r) {
+  if (!ps.expect('{')) return false;
+  if (ps.peek('}')) {
+    ++ps.p;
+    return true;  // required-field validation rejects it below
+  }
+  std::string key, pk;
+  while (ps.ok) {
+    if (!ps.parse_string(key)) return false;
+    if (!ps.expect(':')) return false;
+    if (key == "event") {
+      if (!ps.parse_string(r.event)) return false;
+    } else if (key == "entityType") {
+      if (!ps.parse_string(r.etype)) return false;
+    } else if (key == "entityId") {
+      if (!ps.parse_string(r.eid)) return false;
+    } else if (key == "eventId") {
+      int k = parse_str_or_null(ps, r.evid);
+      if (k < 0) return false;
+      // empty/None both mean "assign fresh" (`e.event_id or uuid4`)
+      r.has_evid = (k == 0 && !r.evid.empty());
+    } else if (key == "targetEntityType") {
+      int k = parse_str_or_null(ps, r.ttype);
+      if (k < 0) return false;
+      r.has_tt = (k == 0);
+    } else if (key == "targetEntityId") {
+      int k = parse_str_or_null(ps, r.tid);
+      if (k < 0) return false;
+      r.has_ti = (k == 0);
+    } else if (key == "eventTime") {
+      int k = parse_str_or_null(ps, r.etime);
+      if (k < 0) return false;
+      r.has_etime = (k == 0);  // JSON null -> default now, like Python
+    } else if (key == "creationTime") {
+      int k = parse_str_or_null(ps, r.ctime);
+      if (k < 0) return false;
+      r.has_ctime = (k == 0);
+    } else if (key == "prId") {
+      ps.skip_ws();
+      const char* b = ps.p;
+      if (ps.end - ps.p >= 4 && memcmp(ps.p, "null", 4) == 0) {
+        ps.p += 4;
+        r.has_prid = false;
+      } else {
+        if (!ps.skip_value()) return false;
+        r.prid_b = b;
+        r.prid_e = ps.p;
+        r.has_prid = true;
+      }
+    } else if (key == "properties") {
+      ps.skip_ws();
+      if (ps.p < ps.end && *ps.p == 'n') {
+        if (!ps.skip_literal("null", 4)) return false;
+        r.props_b = r.props_e = nullptr;
+        r.props_n = 0;
+      } else {
+        const char* b = ps.p;
+        if (!ps.expect('{')) return false;  // non-object props: Python
+        r.props_n = 0;
+        if (ps.peek('}')) {
+          ++ps.p;
+        } else {
+          while (ps.ok) {
+            if (!ps.parse_string(pk)) return false;
+            if (reserved_name(pk)) return false;  // canonical error
+            if (!ps.expect(':')) return false;
+            if (!ps.skip_value()) return false;
+            ++r.props_n;
+            ps.skip_ws();
+            if (ps.p < ps.end && *ps.p == ',') {
+              ++ps.p;
+              continue;
+            }
+            if (!ps.expect('}')) return false;
+            break;
+          }
+          if (!ps.ok) return false;
+        }
+        r.props_b = b;
+        r.props_e = ps.p;
+      }
+    } else if (key == "tags") {
+      ps.skip_ws();
+      if (ps.p < ps.end && *ps.p == 'n') {
+        if (!ps.skip_literal("null", 4)) return false;
+        r.tags_b = r.tags_e = nullptr;
+        r.tags_nonempty = false;
+      } else {
+        const char* b = ps.p;
+        if (ps.p >= ps.end || *ps.p != '[') return false;  // Python lane
+        const char* q = ps.p + 1;
+        while (q < ps.end && (*q == ' ' || *q == '\t' || *q == '\r'))
+          ++q;
+        bool empty = (q < ps.end && *q == ']');
+        if (!ps.skip_array()) return false;
+        r.tags_b = b;
+        r.tags_e = ps.p;
+        r.tags_nonempty = !empty;
+      }
+    } else {
+      if (!ps.skip_value()) return false;  // unknown keys are dropped
+    }
+    ps.skip_ws();
+    if (ps.p < ps.end && *ps.p == ',') {
+      ++ps.p;
+      continue;
+    }
+    return ps.expect('}');
+  }
+  return false;
+}
+
+// validate_event parity (data/event.py:179, Event.scala:112-160).
+// false -> Python lane raises the canonical EventValidationError.
+bool validate_imp(const ImpRec& r) {
+  if (r.event.empty() || r.etype.empty() || r.eid.empty()) return false;
+  if (r.has_tt && r.ttype.empty()) return false;
+  if (r.has_ti && r.tid.empty()) return false;
+  if (r.has_tt != r.has_ti) return false;
+  const bool special = r.event == "$set" || r.event == "$unset" ||
+                       r.event == "$delete";
+  if (reserved_name(r.event) && !special) return false;
+  if (r.event == "$unset" && r.props_n == 0) return false;
+  if (special && (r.has_tt || r.has_ti)) return false;
+  if (reserved_name(r.etype) && r.etype != "pio_pr") return false;
+  if (r.has_tt && reserved_name(r.ttype) && r.ttype != "pio_pr")
+    return false;
+  return true;
+}
+
+// import_jsonl(data: bytes, rand: bytes, now_iso: str)
+//   -> (payload: bytes, n_events: int, 0)   whole chunk converted
+//    | (None, 0, bad_line: int)             1-based line that needs the
+//      Python lane; the caller re-runs the ENTIRE chunk there so
+//      ordering and error messages match the pure-Python path exactly.
+// `rand` supplies >=16 bytes per line needing a fresh event id
+// (os.urandom upstream); ids get uuid4 version/variant bits.
+PyObject* import_jsonl(PyObject*, PyObject* args) {
+  const char* buf;
+  Py_ssize_t len;
+  const char* rand;
+  Py_ssize_t rand_len;
+  const char* now;
+  Py_ssize_t now_len;
+  if (!PyArg_ParseTuple(args, "y#y#s#", &buf, &len, &rand, &rand_len,
+                        &now, &now_len))
+    return nullptr;
+  std::string payload;
+  payload.reserve(static_cast<size_t>(len) +
+                  static_cast<size_t>(len) / 2 + 4096);
+  const std::string now_s(now, static_cast<size_t>(now_len));
+  Py_ssize_t rand_off = 0;
+  long long nline = 0, nev = 0;
+  const char* line = buf;
+  const char* bend = buf + len;
+  char idbuf[33];
+  static const char hexd[] = "0123456789abcdef";
+  std::string et, ct;
+  while (line < bend) {
+    ++nline;
+    const char* nl = static_cast<const char*>(
+        memchr(line, '\n', static_cast<size_t>(bend - line)));
+    const char* lend = nl ? nl : bend;
+    const char* lb = line;
+    const char* le = lend;
+    while (lb < le && (*lb == ' ' || *lb == '\t' || *lb == '\r')) ++lb;
+    while (le > lb &&
+           (le[-1] == ' ' || le[-1] == '\t' || le[-1] == '\r'))
+      --le;
+    line = nl ? nl + 1 : bend;
+    if (lb == le) continue;
+    Parser ps(lb, le - lb);
+    ImpRec r;
+    if (!parse_import_event(ps, r)) goto fallback;
+    ps.skip_ws();
+    if (ps.p != ps.end) goto fallback;  // trailing garbage on the line
+    if (!validate_imp(r)) goto fallback;
+    {
+      long long tms;
+      et.clear();
+      ct.clear();
+      if (r.has_etime) {
+        if (!parse_iso_millis(r.etime, &tms)) goto fallback;
+        emit_iso_millis(tms, et);
+      } else {
+        et = now_s;
+      }
+      if (r.has_ctime) {
+        if (!parse_iso_millis(r.ctime, &tms)) goto fallback;
+        emit_iso_millis(tms, ct);
+      } else {
+        ct = now_s;
+      }
+      const char* id = idbuf;
+      size_t idn = 32;
+      if (r.has_evid) {
+        id = r.evid.data();
+        idn = r.evid.size();
+      } else {
+        if (rand_off + 16 > rand_len) {
+          PyErr_SetString(PyExc_ValueError,
+                          "import_jsonl: rand buffer exhausted");
+          return nullptr;
+        }
+        unsigned char b[16];
+        memcpy(b, rand + rand_off, 16);
+        rand_off += 16;
+        b[6] = (b[6] & 0x0f) | 0x40;  // uuid4 version
+        b[8] = (b[8] & 0x3f) | 0x80;  // RFC 4122 variant
+        for (int i = 0; i < 16; ++i) {
+          idbuf[2 * i] = hexd[b[i] >> 4];
+          idbuf[2 * i + 1] = hexd[b[i] & 0xf];
+        }
+      }
+      // key order and ", "/": " separators match the Python lane's
+      // json.dumps(Event.to_json()) byte-for-byte (except raw-spliced
+      // props/tags spans, which keep the input's own spacing)
+      payload += "{\"op\": \"put\", \"event\": {\"event\": ";
+      emit_json_string(payload, r.event.data(), r.event.size());
+      payload += ", \"entityType\": ";
+      emit_json_string(payload, r.etype.data(), r.etype.size());
+      payload += ", \"entityId\": ";
+      emit_json_string(payload, r.eid.data(), r.eid.size());
+      payload += ", \"eventId\": ";
+      emit_json_string(payload, id, idn);
+      if (r.has_tt) {
+        payload += ", \"targetEntityType\": ";
+        emit_json_string(payload, r.ttype.data(), r.ttype.size());
+        payload += ", \"targetEntityId\": ";
+        emit_json_string(payload, r.tid.data(), r.tid.size());
+      }
+      if (r.props_n > 0) {
+        payload += ", \"properties\": ";
+        payload.append(r.props_b,
+                       static_cast<size_t>(r.props_e - r.props_b));
+      }
+      payload += ", \"eventTime\": \"";
+      payload += et;
+      payload += "\"";
+      if (r.tags_nonempty) {
+        payload += ", \"tags\": ";
+        payload.append(r.tags_b,
+                       static_cast<size_t>(r.tags_e - r.tags_b));
+      }
+      if (r.has_prid) {
+        payload += ", \"prId\": ";
+        payload.append(r.prid_b,
+                       static_cast<size_t>(r.prid_e - r.prid_b));
+      }
+      payload += ", \"creationTime\": \"";
+      payload += ct;
+      payload += "\"}}\n";
+      ++nev;
+      continue;
+    }
+  fallback:
+    return Py_BuildValue("(OLL)", Py_None, static_cast<long long>(0),
+                         nline);
+  }
+  PyObject* pb = PyBytes_FromStringAndSize(
+      payload.data(), static_cast<Py_ssize_t>(payload.size()));
+  if (!pb) return nullptr;
+  return Py_BuildValue("(NLL)", pb, nev, static_cast<long long>(0));
+}
+
 PyMethodDef methods[] = {
     {"parse_segment", parse_segment, METH_VARARGS,
      "Parse one jsonl event segment into column lists."},
+    {"import_jsonl", import_jsonl, METH_VARARGS,
+     "Convert API-format JSON lines into a segment payload."},
     {nullptr, nullptr, 0, nullptr},
 };
 

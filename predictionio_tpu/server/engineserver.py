@@ -133,8 +133,12 @@ class QueryServer:
             except Exception as e:  # noqa: BLE001 — warm the rest
                 log.warning("serving warmup failed for %s: %s",
                             type(algo).__name__, e)
-        if gen == self._warm_gen:
-            self.warm_done.set()
+        # check+set under the lock: unsynchronized, a stale thread could
+        # pass the gen check, lose the CPU to reload()'s clear+increment,
+        # then set() — reporting warm while the re-warm still compiles
+        with self._lock:
+            if gen == self._warm_gen:
+                self.warm_done.set()
 
     def _bind(self, engine_params: EngineParams, models: List[Any],
               instance: EngineInstance) -> None:
@@ -304,10 +308,12 @@ class QueryServer:
         # post-reload traffic doesn't pay cold compiles while
         # /status.json still says warm
         if self.config.warm_start:
-            self.warm_done.clear()
-            self._warm_gen += 1
+            with self._lock:  # pairs with _warm_serving's check+set
+                self._warm_gen += 1
+                gen = self._warm_gen
+                self.warm_done.clear()
             threading.Thread(target=self._warm_serving,
-                             args=(self._warm_gen,), daemon=True,
+                             args=(gen,), daemon=True,
                              name="serving-rewarm").start()
         log.info("reloaded engine instance %s", latest.id)
         return latest.id

@@ -1,0 +1,231 @@
+"""Native bulk-import lane (``EventStore.import_jsonl``).
+
+The reference's ``pio import`` (``tools/imprt/FileToEvents.scala``)
+parsed JSON lines into events on the driver; here segmentfs gets a
+one-pass C++ lane (``native/_codec.cpp:import_jsonl``) and every other
+backend a streaming base implementation. These tests pin the contract:
+the fast lane is INVISIBLE — same stored events, same validation
+errors, same durable-prefix reporting as the pure-Python path.
+"""
+
+import json
+import os
+
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.data.storage.base import JsonlImportError
+from predictionio_tpu.data.storage.memory import MemoryEventStore
+from predictionio_tpu.data.storage.segmentfs import (
+    SegmentFSClient,
+    SegmentFSEventStore,
+)
+from predictionio_tpu.native import codec
+
+
+def _seg_store(td):
+    return SegmentFSEventStore(SegmentFSClient(str(td)))
+
+
+def _lines():
+    rows = [
+        {"event": "rate", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 3.5},
+         "eventTime": "2015-03-01T12:34:56.789Z"},
+        # offset timezone -> must normalize to the same UTC instant
+        {"event": "rate", "entityType": "user", "entityId": "u2",
+         "targetEntityType": "item", "targetEntityId": "i2",
+         "properties": {"rating": 1.0},
+         "eventTime": "2015-03-01T18:00:00+05:30"},
+        # $set with nested/unicode properties, tags, prId
+        {"event": "$set", "entityType": "user", "entityId": "ué",
+         "properties": {"città": "naïve", "n": [1, 2.5, {"k": None}]},
+         "eventTime": "2015-06-01T00:00:00Z", "tags": ["a", "b"],
+         "prId": "p-1"},
+        # no eventTime / no properties -> defaults
+        {"event": "buy", "entityType": "user", "entityId": "u3"},
+        # explicit eventId is preserved
+        {"event": "buy", "entityType": "user", "entityId": "u4",
+         "eventId": "feedbeef" * 4,
+         "eventTime": "2015-03-02T00:00:00.000Z"},
+        # date-only eventTime (fromisoformat accepts it; so must we)
+        {"event": "view", "entityType": "user", "entityId": "u5",
+         "eventTime": "2015-07-04"},
+    ]
+    return "\n".join(json.dumps(r) for r in rows) + "\n\n"
+
+
+def _key(e: Event):
+    # event_time excluded: rows without an explicit eventTime default
+    # to "now", which differs between the two import moments
+    return (e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, e.properties.to_dict(), tuple(e.tags),
+            e.pr_id)
+
+
+@pytest.mark.skipif(codec() is None, reason="no native toolchain")
+class TestNativeLane:
+    def test_parity_with_python_path(self, tmp_path):
+        p = tmp_path / "in.jsonl"
+        p.write_text(_lines(), encoding="utf-8")
+        seg = _seg_store(tmp_path / "seg")
+        mem = MemoryEventStore()
+        n1 = seg.import_jsonl(str(p), 1)
+        n2 = mem.import_jsonl(str(p), 1)
+        assert n1 == n2 == 6
+        got = sorted(seg.find(1), key=lambda e: e.entity_id)
+        want = sorted(mem.find(1), key=lambda e: e.entity_id)
+        assert [_key(e) for e in got] == [_key(e) for e in want]
+        # the same instants survive the offset normalization (only
+        # rows that specified an eventTime are comparable)
+        timed = {"u1", "u2", "u4", "u5", "ué"}
+        for g, w in zip(got, want):
+            if g.entity_id in timed:
+                assert g.event_time_millis == w.event_time_millis
+        # explicit eventId preserved; generated ids are 32-hex uuid4s
+        by_ent = {e.entity_id: e for e in got}
+        assert by_ent["u4"].event_id == "feedbeef" * 4
+        assert len(by_ent["u1"].event_id) == 32
+        assert by_ent["u1"].event_id != by_ent["u2"].event_id
+
+    def test_columnar_read_after_native_import(self, tmp_path):
+        p = tmp_path / "in.jsonl"
+        p.write_text(_lines(), encoding="utf-8")
+        seg = _seg_store(tmp_path / "seg")
+        seg.import_jsonl(str(p), 1)
+        batch = seg.find_columnar(1, float_props=("rating",))
+        assert batch.n == 6
+        ratings = sorted(x for x in batch.float_props["rating"].tolist()
+                         if x == x)
+        assert ratings == [1.0, 3.5]
+
+    def test_fallback_block_matches_python_semantics(self, tmp_path):
+        # tags-as-string is legal to the Python lane (tuple("ab")) but
+        # outside the strict native subset -> the block must fall back
+        # and store what the Python path stores
+        p = tmp_path / "in.jsonl"
+        p.write_text(json.dumps(
+            {"event": "buy", "entityType": "u", "entityId": "x",
+             "tags": "ab"}) + "\n", encoding="utf-8")
+        seg = _seg_store(tmp_path / "seg")
+        assert seg.import_jsonl(str(p), 1) == 1
+        (e,) = list(seg.find(1))
+        assert e.tags == ("a", "b")
+
+    def test_validation_error_reports_durable_prefix(self, tmp_path,
+                                                     monkeypatch):
+        # two small blocks; the bad line sits in block 2 -> block 1 is
+        # durable, block 2 commits nothing (all-or-nothing per block)
+        rows = [json.dumps({"event": "buy", "entityType": "u",
+                            "entityId": f"e{i}"}) for i in range(8)]
+        rows.append(json.dumps({"event": "$bogus", "entityType": "u",
+                                "entityId": "bad"}))
+        text = "\n".join(rows) + "\n"
+        # block size that splits after ~4 lines
+        monkeypatch.setenv("PIO_IMPORT_BLOCK",
+                           str(len(rows[0]) * 4 + 4))
+        p = tmp_path / "in.jsonl"
+        p.write_text(text, encoding="utf-8")
+        seg = _seg_store(tmp_path / "seg")
+        with pytest.raises(JsonlImportError) as ei:
+            seg.import_jsonl(str(p), 1)
+        err = ei.value
+        stored = list(seg.find(1))
+        assert len(stored) == err.committed_events
+        assert err.committed_events < 9
+        assert err.lineno > err.committed_lines
+        # resume recipe really resumes: import the remainder only
+        rest = tmp_path / "rest.jsonl"
+        remainder = text.splitlines()[err.committed_lines:-1]  # drop bad
+        rest.write_text("\n".join(remainder) + "\n", encoding="utf-8")
+        seg.import_jsonl(str(rest), 1)
+        assert {e.entity_id for e in seg.find(1)} == \
+            {f"e{i}" for i in range(8)}
+
+    def test_duplicate_explicit_id_last_wins(self, tmp_path):
+        p = tmp_path / "in.jsonl"
+        eid = "ab" * 16
+        p.write_text(
+            json.dumps({"event": "$set", "entityType": "u",
+                        "entityId": "x", "eventId": eid,
+                        "properties": {"v": 1}}) + "\n" +
+            json.dumps({"event": "$set", "entityType": "u",
+                        "entityId": "x", "eventId": eid,
+                        "properties": {"v": 2}}) + "\n",
+            encoding="utf-8")
+        seg = _seg_store(tmp_path / "seg")
+        seg.import_jsonl(str(p), 1)
+        (e,) = list(seg.find(1))
+        assert e.properties.to_dict() == {"v": 2}
+
+    def test_no_trailing_newline(self, tmp_path):
+        p = tmp_path / "in.jsonl"
+        p.write_bytes(json.dumps(
+            {"event": "buy", "entityType": "u",
+             "entityId": "x"}).encode())
+        seg = _seg_store(tmp_path / "seg")
+        assert seg.import_jsonl(str(p), 1) == 1
+
+    def test_segment_bytes_match_python_insert(self, tmp_path):
+        # fully-specified record -> the native segment line is
+        # byte-identical to json.dumps({"op": "put", "event": to_json})
+        src = {"event": "rate", "entityType": "user", "entityId": "u1",
+               "eventId": "cd" * 16, "targetEntityType": "item",
+               "targetEntityId": "i1", "properties": {"rating": 4.0},
+               "eventTime": "2015-03-01T12:34:56.789Z",
+               "creationTime": "2015-03-01T12:34:56.789Z"}
+        p = tmp_path / "in.jsonl"
+        p.write_text(json.dumps(src) + "\n", encoding="utf-8")
+        root = tmp_path / "seg"
+        seg = _seg_store(root)
+        seg.import_jsonl(str(p), 1)
+        d = os.path.join(str(root), "events", "app_1")
+        (name,) = [n for n in os.listdir(d) if n.startswith("seg-")]
+        with open(os.path.join(d, name), "rb") as f:
+            line = f.read().rstrip(b"\n")
+        want = json.dumps(
+            {"op": "put",
+             "event": Event.from_json(src).to_json()}).encode()
+        assert line == want
+
+
+@pytest.mark.skipif(codec() is None, reason="no native toolchain")
+def test_out_of_range_datetimes_rejected_like_python(tmp_path):
+    # year 0 / a 9999 pushed past the boundary by its offset must fail
+    # the import (as the Python lane does), never publish a segment
+    # that poisons later replays
+    for bad in ("0000-01-01T00:00:00Z", "9999-12-31T23:59:59-01:00",
+                "2015-01-01T00:00:00+24:00"):
+        p = tmp_path / "in.jsonl"
+        p.write_text(json.dumps(
+            {"event": "buy", "entityType": "u", "entityId": "x",
+             "eventTime": bad}) + "\n", encoding="utf-8")
+        seg = _seg_store(tmp_path / f"seg-{bad[:4]}-{bad[-5:-3]}")
+        with pytest.raises(JsonlImportError):
+            seg.import_jsonl(str(p), 1)
+        assert list(seg.find(1)) == []
+
+
+def test_missing_file_is_clean_oserror(tmp_path):
+    with pytest.raises(OSError):
+        _seg_store(tmp_path / "seg").import_jsonl(
+            str(tmp_path / "nope.jsonl"), 1)
+    with pytest.raises(OSError):
+        MemoryEventStore().import_jsonl(str(tmp_path / "nope2.jsonl"), 1)
+
+
+def test_base_lane_chunked_commit(tmp_path):
+    mem = MemoryEventStore()
+    rows = [json.dumps({"event": "buy", "entityType": "u",
+                        "entityId": f"e{i}"}) for i in range(7)]
+    rows.insert(5, "this is not json")
+    p = tmp_path / "in.jsonl"
+    p.write_text("\n".join(rows) + "\n", encoding="utf-8")
+    with pytest.raises(JsonlImportError) as ei:
+        mem.import_jsonl(str(p), 1, chunk=2)
+    err = ei.value
+    assert err.lineno == 6
+    assert err.committed_lines == 4
+    assert err.committed_events == 4
+    assert len(list(mem.find(1))) == 4
