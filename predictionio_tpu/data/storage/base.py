@@ -96,6 +96,42 @@ class StorageError(RuntimeError):
     pass
 
 
+def _open_jsonl(source) -> Any:
+    """``import_jsonl`` source normalization: a path opens binary (a
+    missing file raises a clean OSError *before* any try/wrap), bytes
+    become an in-memory stream (the storage server's forwarded
+    blocks)."""
+    import io
+    if isinstance(source, (bytes, bytearray)):
+        return io.BytesIO(bytes(source))
+    return open(source, "rb")
+
+
+def iter_jsonl_blocks(f, block_size: int) -> Iterator[Tuple[bytes, int]]:
+    """Split a binary stream into blocks of WHOLE lines (the bulk
+    import lanes' shared reader): yields ``(buf, nlines)`` where buf
+    ends at a line boundary and nlines counts the lines consumed —
+    including blank ones, so callers' durable-prefix line accounting
+    matches the file. A line longer than ``block_size`` is carried
+    until its newline arrives; a final unterminated line still counts
+    as one."""
+    carry = b""
+    while True:
+        block = f.read(block_size)
+        if not block and not carry:
+            return
+        buf = carry + block
+        if block:
+            cut = buf.rfind(b"\n")
+            if cut < 0:  # a line longer than the block
+                carry = buf
+                continue
+            buf, carry = buf[:cut + 1], buf[cut + 1:]
+        else:
+            carry = b""
+        yield buf, (buf.count(b"\n") or 1)
+
+
 class JsonlImportError(Exception):
     """A bulk JSONL import failed partway. ``lineno`` is where it
     failed, ``committed_lines``/``committed_events`` how far the
@@ -166,13 +202,14 @@ class EventStore(abc.ABC):
             raise
         return done
 
-    def import_jsonl(self, path: str, app_id: int,
+    def import_jsonl(self, source, app_id: int,
                      channel_id: Optional[int] = None,
                      chunk: int = 100_000) -> int:
-        """Bulk-load a file of API-format JSON lines (``pio import``,
-        ``tools/imprt/FileToEvents.scala``), committing every ``chunk``
-        events via :meth:`insert_batch` (all-or-nothing per chunk).
-        Returns the number of events imported; on failure raises
+        """Bulk-load API-format JSON lines (``pio import``,
+        ``tools/imprt/FileToEvents.scala``) from a file path or a
+        bytes block, committing every ``chunk`` events via
+        :meth:`insert_batch` (all-or-nothing per chunk). Returns the
+        number of events imported; on failure raises
         :class:`JsonlImportError` carrying how far the durable prefix
         reaches so the caller can print a resume recipe. Backends with
         a bulk encode lane (segmentfs + the native codec) override
@@ -183,12 +220,12 @@ class EventStore(abc.ABC):
         lineno = 0
         committed = 0  # last LINE NUMBER fully committed
         events: List[Event] = []
-        f = open(path, "r", encoding="utf-8")  # missing file: clean OSError
+        f = _open_jsonl(source)
         try:
             with f:
-                for line in f:
+                for raw in f:
                     lineno += 1
-                    line = line.strip()
+                    line = raw.decode("utf-8").strip()
                     if line:
                         events.append(Event.from_json(_json.loads(line)))
                     if len(events) >= chunk:

@@ -238,7 +238,7 @@ class SegmentFSEventStore(EventStore):
         self._publish(d, records)
         return ids
 
-    def import_jsonl(self, path: str, app_id: int,
+    def import_jsonl(self, source, app_id: int,
                      channel_id: Optional[int] = None,
                      chunk: int = 100_000) -> int:
         """Bulk import through the native codec's one-pass
@@ -253,8 +253,11 @@ class SegmentFSEventStore(EventStore):
 
         mod = _native_codec()
         if mod is None or not hasattr(mod, "import_jsonl"):
-            return super().import_jsonl(path, app_id, channel_id, chunk)
+            return super().import_jsonl(source, app_id, channel_id,
+                                        chunk)
         from ..event import isoformat_millis, utcnow
+        from .base import JsonlImportError, _open_jsonl, \
+            iter_jsonl_blocks
 
         d = self._dir(app_id, channel_id)
         os.makedirs(d, exist_ok=True)
@@ -262,25 +265,10 @@ class SegmentFSEventStore(EventStore):
                                         str(32 << 20)))
         total = 0
         lineno = 0  # lines fully consumed (== committed: block commits)
-        f = open(path, "rb")  # missing/unreadable file: clean OSError
-        from .base import JsonlImportError
+        f = _open_jsonl(source)  # missing file: clean OSError
         try:
             with f:
-                carry = b""
-                while True:
-                    block = f.read(block_size)
-                    if not block and not carry:
-                        break
-                    buf = carry + block
-                    if block:
-                        cut = buf.rfind(b"\n")
-                        if cut < 0:  # a line longer than the block
-                            carry = buf
-                            continue
-                        buf, carry = buf[:cut + 1], buf[cut + 1:]
-                    else:
-                        carry = b""
-                    nlines = buf.count(b"\n") or 1
+                for buf, nlines in iter_jsonl_blocks(f, block_size):
                     payload, n, _bad = mod.import_jsonl(
                         buf, os.urandom(16 * nlines),
                         isoformat_millis(utcnow()))

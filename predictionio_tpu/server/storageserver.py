@@ -171,6 +171,33 @@ def build_app(storage: Storage, secret: Optional[str] = None) -> HTTPApp:
             events, int(req.path_params["app_id"]), chan(req))
         return json_response({"ids": ids})
 
+    @app.route("POST", r"/v1/events/(?P<app_id>\d+)/import_jsonl")
+    def ev_import(req: Request) -> Response:
+        """Bulk import: body is a raw block of API-format JSON lines,
+        loaded through the backing store's ``import_jsonl`` lane (the
+        native C++ encode when the backing is segmentfs). Errors come
+        back as a 200 with an ``error`` doc carrying the block-relative
+        durable prefix — the client re-anchors it to file-global line
+        numbers, which a transport-level error code could not carry."""
+        auth(req)
+        from ..data.storage.base import JsonlImportError
+
+        try:
+            # chunk > any block: the whole POST commits all-or-nothing,
+            # so the client's acknowledged-blocks line accounting is
+            # exact (a mid-block partial commit would make its resume
+            # recipe duplicate events)
+            n = storage.events().import_jsonl(
+                req.body, int(req.path_params["app_id"]), chan(req),
+                chunk=1 << 62)
+        except JsonlImportError as e:
+            return json_response({"error": {
+                "lineno": e.lineno,
+                "committed_lines": e.committed_lines,
+                "committed_events": e.committed_events,
+                "message": str(e.cause)}})
+        return json_response({"imported": n})
+
     @app.route("GET", r"/v1/events/(?P<app_id>\d+)/get")
     def ev_get(req: Request) -> Response:
         auth(req)

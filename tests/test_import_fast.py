@@ -215,6 +215,64 @@ def test_missing_file_is_clean_oserror(tmp_path):
         MemoryEventStore().import_jsonl(str(tmp_path / "nope2.jsonl"), 1)
 
 
+class TestRemoteBulkImport:
+    @pytest.fixture()
+    def remote(self, tmp_path):
+        from conftest import start_sqlite_backed_storage_server
+        from predictionio_tpu.data.storage.remote import (
+            RemoteClient,
+            RemoteEventStore,
+        )
+
+        srv, backing = start_sqlite_backed_storage_server(tmp_path)
+        store = RemoteEventStore(
+            RemoteClient(f"http://127.0.0.1:{srv.port}"))
+        yield store, backing
+        srv.shutdown()
+
+    def test_block_forwarding_parity(self, tmp_path, remote):
+        store, backing = remote
+        p = tmp_path / "in.jsonl"
+        p.write_text(_lines(), encoding="utf-8")
+        assert store.import_jsonl(str(p), 1) == 6
+        got = sorted(store.find(1), key=lambda e: e.entity_id)
+        assert len(got) == 6
+        by_ent = {e.entity_id: e for e in got}
+        # explicit eventId wins over the spliced one (last-wins JSON)
+        assert by_ent["u4"].event_id == "feedbeef" * 4
+        assert by_ent["u1"].properties.to_dict() == {"rating": 3.5}
+
+    def test_replayed_block_is_idempotent(self, tmp_path, remote):
+        # the spliced client-side ids make a retried block an id-keyed
+        # upsert: POSTing the IDENTICAL spliced bytes twice through the
+        # raw /import_jsonl endpoint (exactly what a transport retry
+        # sends after a lost response) must not duplicate
+        store, backing = remote
+        rows = [json.dumps({"eventId": f"{i:032d}", "event": "buy",
+                            "entityType": "u", "entityId": f"x{i}",
+                            "eventTime": "2015-03-01T00:00:00.000Z"})
+                for i in range(5)]
+        block = ("\n".join(rows) + "\n").encode()
+        for _ in range(2):
+            _, _, body = store.c.request(
+                "POST", "/v1/events/1/import_jsonl", block)
+            assert json.loads(body)["imported"] == 5
+        assert len(list(store.find(1))) == 5
+
+    def test_error_reports_global_prefix(self, tmp_path, remote):
+        store, _ = remote
+        rows = [json.dumps({"event": "buy", "entityType": "u",
+                            "entityId": f"e{i}"}) for i in range(3)]
+        rows.append(json.dumps({"event": "$bogus", "entityType": "u",
+                                "entityId": "bad"}))
+        p = tmp_path / "in.jsonl"
+        p.write_text("\n".join(rows) + "\n", encoding="utf-8")
+        with pytest.raises(JsonlImportError) as ei:
+            store.import_jsonl(str(p), 1)
+        assert ei.value.lineno == 4
+        assert ei.value.committed_events == len(list(store.find(1)))
+
+
 def test_base_lane_chunked_commit(tmp_path):
     mem = MemoryEventStore()
     rows = [json.dumps({"event": "buy", "entityType": "u",
