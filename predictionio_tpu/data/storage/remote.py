@@ -107,8 +107,10 @@ class RemoteClient:
                     detail = json.loads(e.read().decode()).get("message", "")
                 except Exception:  # noqa: BLE001
                     pass
-                raise StorageError(
-                    f"storage server {e.code} on {path}: {detail}") from e
+                err = StorageError(
+                    f"storage server {e.code} on {path}: {detail}")
+                err.status = e.code  # callers branch on 404 (version skew)
+                raise err from e
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 last = e
                 if attempt < retries:
@@ -206,7 +208,16 @@ class RemoteEventStore(EventStore):
             with f:
                 for buf, nlines in iter_jsonl_blocks(f, block_size):
                     spliced = bytearray()
-                    for raw in buf.splitlines():
+                    # split on \n ONLY: splitlines() also cuts on
+                    # \x0b/\x0c/\x1c..., which would diverge from the
+                    # local lanes' line accounting (and silently split
+                    # one malformed physical line into two events).
+                    # Interior blank lines stay as newlines so server-
+                    # side error linenos remain block-relative.
+                    pieces = buf.split(b"\n")
+                    if pieces and pieces[-1] == b"":
+                        pieces.pop()  # trailing \n, not a blank line
+                    for raw in pieces:
                         s = raw.strip()
                         if s.startswith(b"{"):
                             rest = s[1:].lstrip()
@@ -218,11 +229,22 @@ class RemoteEventStore(EventStore):
                         else:
                             spliced += s
                         spliced += b"\n"
-                    _, _, body = self.c.request(
-                        "POST", f"{base}/import_jsonl{q}",
-                        bytes(spliced),
-                        headers={"Content-Type":
-                                 "application/x-ndjson"})
+                    try:
+                        _, _, body = self.c.request(
+                            "POST", f"{base}/import_jsonl{q}",
+                            bytes(spliced),
+                            headers={"Content-Type":
+                                     "application/x-ndjson"})
+                    except StorageError as se:
+                        if getattr(se, "status", None) == 404 \
+                                and lineno == 0:
+                            # older storage server without the bulk
+                            # endpoint: nothing committed yet, so the
+                            # inherited per-event lane can run the
+                            # whole file from the top
+                            return super().import_jsonl(
+                                source, app_id, channel_id, chunk)
+                        raise
                     doc = json.loads(body.decode())
                     err = doc.get("error")
                     if err is not None:

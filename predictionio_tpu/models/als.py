@@ -67,6 +67,17 @@ class ALSParams:
     #: with f32 accumulation (the TPU-native mixed-precision idiom);
     #: factors and solves stay f32.
     matmul_dtype: str = "float32"
+    #: "bfloat16" gathers each half-iteration's factor rows from a
+    #: bf16 SHADOW of the (still-f32) factor table; master weights,
+    #: gram accumulation and solves stay f32. Measured round 4 on a
+    #: v5e: the f32 table (138k×64 = 35MB) is too big for XLA to keep
+    #: VMEM-resident alongside the Pallas solve's scratch, so the 20M
+    #: row gathers ran from HBM at ~6× the VMEM-resident cost — the
+    #: whole-iteration bound. The 17.6MB shadow stays VMEM-staged:
+    #: 1.98× per-iteration speedup for an ~0.4% relative perturbation
+    #: of the normal-equation INPUTS (quality-checked by
+    #: tests/test_als.py::test_gather_dtype_quality).
+    gather_dtype: str = "float32"
     #: Weighted-gram realization: "einsum" (baseline batched matmul),
     #: "pair" (two rank-r systems packed per 128x128 MXU tile —
     #: ``ops/gram.py``), or "auto".
@@ -86,6 +97,10 @@ class ALSParams:
             raise ValueError(
                 f"matmul_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.matmul_dtype!r}")
+        if self.gather_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"gather_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.gather_dtype!r}")
         if self.history_mode not in ("auto", "pad", "split", "bucket"):
             raise ValueError(
                 f"history_mode must be 'auto', 'pad', 'split' or "
@@ -147,6 +162,10 @@ def _update_block(fixed: jax.Array, G, indices: jax.Array,
     valid = (jnp.arange(L)[None, None, :]
              < counts[:, :, None]).astype(jnp.float32)
     F = fixed[indices]  # [d, B, L, r] — cross-shard gather under a mesh
+    if F.dtype != jnp.float32:
+        # gather_dtype="bfloat16": ``fixed`` is the bf16 shadow; all
+        # arithmetic (gram accumulation, rhs, solve) stays f32
+        F = F.astype(jnp.float32)
 
     def outer(Fm, w):
         """Σ_l w·f fᵀ on the MXU (optionally bf16 inputs with f32
@@ -191,6 +210,8 @@ def _partials_block(fixed: jax.Array, indices: jax.Array,
     valid = (jnp.arange(L)[None, None, :]
              < counts[:, :, None]).astype(jnp.float32)
     F = fixed[indices]  # [d, B, L, r]
+    if F.dtype != jnp.float32:
+        F = F.astype(jnp.float32)  # bf16 shadow gather; f32 compute
 
     def outer(Fm, w):
         from ..ops.gram import gram_dispatch
@@ -252,6 +273,8 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
     exactly as the pad path does."""
     implicit = params.implicit_prefs
     G = _gramian_jit(fixed) if implicit else None
+    gsrc = fixed.astype(jnp.bfloat16) \
+        if params.gather_dtype == "bfloat16" else fixed
     d, n_vper, L = sh["idx"].shape
     n_pad = sh["real_cnt"].shape[0]
     r = fixed.shape[-1]
@@ -260,7 +283,7 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
     for s in range(0, n_vper, block_rows):
         e = min(s + block_rows, n_vper)
         A_acc, b_acc = _partials_block(
-            fixed, sh["idx"][:, s:e], sh["val"][:, s:e],
+            gsrc, sh["idx"][:, s:e], sh["val"][:, s:e],
             sh["cnt"][:, s:e], sh["rid"][:, s:e], A_acc, b_acc,
             params.alpha, implicit,
             bf16=(params.matmul_dtype == "bfloat16"),
@@ -274,12 +297,15 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
 def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
                       reg, alpha, implicit: bool, scale_reg: bool,
                       bf16: bool, block_rows_opt,
-                      gram: str = "auto") -> jax.Array:
+                      gram: str = "auto",
+                      gather_bf16: bool = False) -> jax.Array:
     """Trace-level body of a bucketed half-iteration (jit-wrapped by
     :func:`_bucket_half_step` and inlined whole-training by
     :func:`_train_bucket_fused`)."""
     r = fixed.shape[-1]
     G = gramian(fixed) if implicit else None
+    # the bf16 shadow (ALSParams.gather_dtype): gram/rhs/solve stay f32
+    gsrc = fixed.astype(jnp.bfloat16) if gather_bf16 else fixed
     out = out0
     for b in buckets:
         d, n_per, L = b["idx"].shape
@@ -288,7 +314,7 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
         for s in range(0, n_per, block):
             e = min(s + block, n_per)
             parts.append(_update_block(
-                fixed, G, b["idx"][:, s:e], b["val"][:, s:e],
+                gsrc, G, b["idx"][:, s:e], b["val"][:, s:e],
                 b["cnt"][:, s:e], reg, alpha, implicit, scale_reg,
                 bf16=bf16, gram=gram))
         new = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
@@ -303,12 +329,14 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
 
 @functools.partial(jax.jit,
                    static_argnames=("implicit", "scale_reg", "bf16",
-                                    "block_rows_opt", "gram"),
+                                    "block_rows_opt", "gram",
+                                    "gather_bf16"),
                    donate_argnums=(1,))
 def _bucket_half_step(fixed: jax.Array, out0: jax.Array, buckets,
                       reg, alpha, *, implicit: bool, scale_reg: bool,
                       bf16: bool, block_rows_opt,
-                      gram: str = "auto") -> jax.Array:
+                      gram: str = "auto",
+                      gather_bf16: bool = False) -> jax.Array:
     """One ENTIRE bucketed half-iteration as a single compiled program —
     Gramian, every bucket's normal-equation blocks, solves, and the
     unique-index scatters all fuse into one dispatch. Separate per-bucket
@@ -319,7 +347,8 @@ def _bucket_half_step(fixed: jax.Array, out0: jax.Array, buckets,
     compilation; the bucket STRUCTURE (shapes) is the cache key.
     """
     return _bucket_half_impl(fixed, out0, buckets, reg, alpha, implicit,
-                             scale_reg, bf16, block_rows_opt, gram)
+                             scale_reg, bf16, block_rows_opt, gram,
+                             gather_bf16)
 
 
 def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
@@ -335,23 +364,25 @@ def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
         implicit=params.implicit_prefs,
         scale_reg=params.scale_reg_by_count,
         bf16=(params.matmul_dtype == "bfloat16"),
-        block_rows_opt=params.block_rows, gram=params.gram_mode)
+        block_rows_opt=params.block_rows, gram=params.gram_mode,
+        gather_bf16=(params.gather_dtype == "bfloat16"))
 
 
 def _pad_half_impl(fixed: jax.Array, lay: dict, block: int, reg, alpha,
                    implicit: bool, scale_reg: bool, bf16: bool,
-                   gram: str) -> jax.Array:
+                   gram: str, gather_bf16: bool = False) -> jax.Array:
     """One pad-layout half-iteration (trace-level body): Gramian, row
     blocks through :func:`_update_block`, flat reshape. SHARED by the
     per-step path (:func:`_update_side`) and the fused whole-run
     trainer — the two must never diverge."""
     G = gramian(fixed) if implicit else None
+    gsrc = fixed.astype(jnp.bfloat16) if gather_bf16 else fixed
     d, n_per, L = lay["idx"].shape
     parts = []
     for st in range(0, n_per, block):
         e = min(st + block, n_per)
         parts.append(_update_block(
-            fixed, G, lay["idx"][:, st:e], lay["val"][:, st:e],
+            gsrc, G, lay["idx"][:, st:e], lay["val"][:, st:e],
             lay["cnt"][:, st:e], reg, alpha, implicit, scale_reg,
             bf16=bf16, gram=gram))
     out = parts[0] if len(parts) == 1 \
@@ -364,12 +395,14 @@ def _pad_half_impl(fixed: jax.Array, lay: dict, block: int, reg, alpha,
                                     "gram", "kind_u", "kind_i",
                                     "block_u", "block_i",
                                     "block_rows_opt", "nu", "ni",
-                                    "shard_u", "shard_i"))
+                                    "shard_u", "shard_i",
+                                    "gather_bf16"))
 def _train_fused(U: jax.Array, V: jax.Array, lay_u, lay_i, reg, alpha,
                  iters, *, implicit: bool, scale_reg: bool, bf16: bool,
                  gram: str, kind_u: str, kind_i: str, block_u: int,
                  block_i: int, block_rows_opt, nu: int, ni: int,
-                 shard_u, shard_i) -> Tuple[jax.Array, jax.Array]:
+                 shard_u, shard_i,
+                 gather_bf16: bool = False) -> Tuple[jax.Array, jax.Array]:
     """The WHOLE training run as ONE compiled program (no
     checkpointing): through a remote-device tunnel, per-dispatch latency
     rivals a full half-iteration of compute, so 2·iters·blocks
@@ -388,9 +421,9 @@ def _train_fused(U: jax.Array, V: jax.Array, lay_u, lay_i, reg, alpha,
                 out0 = jax.lax.with_sharding_constraint(out0, shard)
             return _bucket_half_impl(fixed, out0, lay, reg, alpha,
                                      implicit, scale_reg, bf16,
-                                     block_rows_opt, gram)
+                                     block_rows_opt, gram, gather_bf16)
         out = _pad_half_impl(fixed, lay, block, reg, alpha, implicit,
-                             scale_reg, bf16, gram)
+                             scale_reg, bf16, gram, gather_bf16)
         if shard is not None:
             out = jax.lax.with_sharding_constraint(out, shard)
         return out
@@ -418,7 +451,8 @@ def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
         block_rows, params.reg, params.alpha, params.implicit_prefs,
         params.scale_reg_by_count,
         bf16=(params.matmul_dtype == "bfloat16"),
-        gram=params.gram_mode)
+        gram=params.gram_mode,
+        gather_bf16=(params.gather_dtype == "bfloat16"))
 
 
 @functools.partial(jax.jit, static_argnames=("n", "n_padded", "rank"))
@@ -1099,6 +1133,10 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             ratings.n_users, ratings.n_items, len(ratings.users),
         ]
         base = legacy_base + [params.history_mode]
+        if params.gather_dtype != "float32":
+            # default-f32 fingerprints stay byte-identical to round-3
+            # checkpoints; a bf16-shadow run has a different trajectory
+            base = base + [params.gather_dtype]
         fingerprint = hashlib.sha256(_json.dumps(
             base + [content.hexdigest()]).encode()).hexdigest()[:16]
         # pre-content-digest dirs (round-1 scheme, no history_mode field)
@@ -1162,7 +1200,8 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             block_u=block_u, block_i=block_i,
             block_rows_opt=params.block_rows,
             nu=u_rows_pad, ni=i_rows_pad,
-            shard_u=shard, shard_i=shard)
+            shard_u=shard, shard_i=shard,
+            gather_bf16=(params.gather_dtype == "bfloat16"))
 
     def _stepper(h, layout):
         if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):

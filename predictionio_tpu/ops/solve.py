@@ -112,7 +112,15 @@ def _chol_body(A, b_ref, x_ref, acc, lref=None):
         return carry
 
     jax.lax.fori_loop(0, r, bwd_step, 0, unroll=False)
-    x_ref[:] = acc[:]
+    # write batch-major [B, r]: emitting the transpose HERE (one small
+    # VMEM shuffle per block) instead of returning [r, B] and lazily
+    # transposing outside makes the pallas output physically row-major.
+    # The lazy transpose was implemented by XLA as a layout flip
+    # ({0,1}) that propagated through reshape into the training loop's
+    # factor carry — and gathering 20M rows from a {0,1}-laid factor
+    # table ran at ~40 GB/s vs ~260 GB/s row-major (the round-4 trace's
+    # dominant cost, fusion.534).
+    x_ref[:] = acc[:].T
 
 
 def _chol_solve_kernel(a_ref, b_ref, x_ref, A, acc):
@@ -167,14 +175,18 @@ def _solve_spd_pallas(A: jax.Array, b: jax.Array,
                             memory_space=pltpu.VMEM)
     vec_spec = pl.BlockSpec((rp, lanes), lambda i: (0, i),
                             memory_space=pltpu.VMEM)
+    # solutions come out batch-major [np_, rp] (see _chol_body's final
+    # write) so no downstream transpose/layout-flip reaches the caller
+    xvec_spec = pl.BlockSpec((lanes, rp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
     if rp <= _RP_SCRATCH:
         # scratch variant: input block + same-size scratch fit VMEM
-        xt = pl.pallas_call(
+        xrows = pl.pallas_call(
             _chol_solve_kernel,
             grid=(np_ // lanes,),
             in_specs=[mat_spec, vec_spec],
-            out_specs=vec_spec,
-            out_shape=jax.ShapeDtypeStruct((rp, np_), A.dtype),
+            out_specs=xvec_spec,
+            out_shape=jax.ShapeDtypeStruct((np_, rp), A.dtype),
             scratch_shapes=[
                 pltpu.VMEM((rp, rp, lanes), jnp.float32),
                 pltpu.VMEM((rp, lanes), jnp.float32),
@@ -203,7 +215,7 @@ def _solve_spd_pallas(A: jax.Array, b: jax.Array,
                 out_specs=[whole, whole],
                 out_shape=[
                     jax.ShapeDtypeStruct((rp, rp, lanes), A.dtype),
-                    jax.ShapeDtypeStruct((rp, lanes), A.dtype),
+                    jax.ShapeDtypeStruct((lanes, rp), A.dtype),
                 ],
                 input_output_aliases={0: 0},
                 scratch_shapes=[
@@ -214,9 +226,9 @@ def _solve_spd_pallas(A: jax.Array, b: jax.Array,
             )(a, b2)
             return x
 
-        xs = jax.lax.map(one, (Ab, bb))          # [nb, rp, lanes]
-        xt = jnp.moveaxis(xs, 0, 1).reshape(rp, np_)
-    return jnp.transpose(xt, (1, 0))[:n, :r]
+        xs = jax.lax.map(one, (Ab, bb))          # [nb, lanes, rp]
+        xrows = xs.reshape(np_, rp)
+    return xrows[:n, :r]
 
 
 def _solver_mode() -> str:
