@@ -268,23 +268,31 @@ def main():
         cands = [(gm, gd) for gm in gram_cands for gd in gather_cands]
         best_dt, best_gm, best_params = float("inf"), gram_cands[0], None
         best_f32_dt, best_f32_gm = float("inf"), gram_cands[0]
+        cand_errors = []
         for gm, gd in cands:
             p_run = ALSParams(rank=rank_r, num_iterations=iterations,
                               implicit_prefs=True, alpha=alpha, reg=reg,
                               seed=3, gram_mode=gm, gather_dtype=gd)
-            U, V = train_als(ratings, p_run, packed=packed)  # warm
-            hard_sync(V)
-            # best-of-N — the shared-tunnel TPU shows run-to-run noise
-            for _ in range(repeats):
-                t0 = time.monotonic()
-                U, V = train_als(ratings, p_run, packed=packed)
+            try:
+                U, V = train_als(ratings, p_run, packed=packed)  # warm
                 hard_sync(V)
-                d = time.monotonic() - t0
-                if d < best_dt:
-                    best_dt, best_gm, best_params = d, gm, p_run
-                if gd == "float32" and d < best_f32_dt:
-                    best_f32_dt, best_f32_gm = d, gm
-        assert best_params is not None
+                # best-of-N — shared-tunnel TPUs show run-to-run noise
+                for _ in range(repeats):
+                    t0 = time.monotonic()
+                    U, V = train_als(ratings, p_run, packed=packed)
+                    hard_sync(V)
+                    d = time.monotonic() - t0
+                    if d < best_dt:
+                        best_dt, best_gm, best_params = d, gm, p_run
+                    if gd == "float32" and d < best_f32_dt:
+                        best_f32_dt, best_f32_gm = d, gm
+            except Exception as ce:  # noqa: BLE001 — one candidate's
+                # compile failure (e.g. rank-128 f32 through the tunnel
+                # helper) must not kill candidates that work
+                cand_errors.append(f"{gm}/{gd}: {str(ce)[:120]}")
+        if best_params is None:
+            raise RuntimeError("every race candidate failed: "
+                               + " | ".join(cand_errors))
         if gram_mode == "auto" and len(gram_cands) > 1 \
                 and best_f32_dt < float("inf"):
             # persist the gram winner measured AT THE DEFAULT gather
@@ -325,7 +333,53 @@ def main():
             rank128, _, _ = race(128, repeats=2)
             rank128.pop("_achieved_flops_raw", None)
         except Exception as e:  # noqa: BLE001 — report, don't die
-            rank128 = {"error": str(e)[:300]}
+            # the tunnel's remote-compile helper dies on the FULL-size
+            # rank-128 program (measured round 4: 12M+ entries fail,
+            # 8M with the bf16 shadow passes — the f32 variant fails
+            # even at 8M) — retry on a subsample so the rank-128
+            # datapoint exists, honestly labeled with its scale
+            try:
+                sub_n = min(int(os.environ.get("BENCH_RANK128_NNZ",
+                                               "8000000")), nnz)
+                rng_s = np.random.default_rng(5)
+                sel = rng_s.permutation(nnz)[:sub_n]
+                r_sub = RatingsCOO(users[sel], items[sel], vals[sel],
+                                   n_users, n_items)
+                # honor the bench's configured modes: only "auto"
+                # resolves to the measured-working combination (bf16
+                # shadow compiles at 8M where f32 does not); a forced
+                # f32 sweep gets an f32 attempt — and an honest error
+                # if the tunnel can't compile it
+                sub_gather = "bfloat16" \
+                    if gather_env in ("auto", "bfloat16") else gather_env
+                sub_gram = "einsum" if gram_mode == "auto" else gram_mode
+                p_sub = ALSParams(rank=128, num_iterations=iterations,
+                                  implicit_prefs=True, alpha=alpha,
+                                  reg=reg, seed=3, gram_mode=sub_gram,
+                                  gather_dtype=sub_gather)
+                packed_sub = pack_ratings(r_sub, p_sub)
+                U, V = train_als(r_sub, p_sub, packed=packed_sub)
+                hard_sync(V)
+                best_s = float("inf")
+                for _ in range(2):
+                    t0 = time.monotonic()
+                    U, V = train_als(r_sub, p_sub, packed=packed_sub)
+                    hard_sync(V)
+                    best_s = min(best_s, time.monotonic() - t0)
+                fl = als_flops_per_iter(packed_sub[0], packed_sub[1],
+                                        p_sub)
+                ach = fl * iterations / best_s
+                rank128 = {
+                    "value": round(sub_n * iterations / best_s, 1),
+                    "achieved_tflops": round(ach / 1e12, 2),
+                    "mfu": round(ach / peak, 4) if peak else None,
+                    "gram_mode": sub_gram,
+                    "gather_dtype": sub_gather,
+                    "nnz": sub_n, "scaled": True,
+                    "full_scale_error": str(e)[:160],
+                }
+            except Exception as e2:  # noqa: BLE001
+                rank128 = {"error": str(e2)[:300]}
 
     cpu_rps = cpu_als_baseline(
         n_users=max(int(n_users * cpu_scale), 64),

@@ -10,7 +10,7 @@ technique. The difference between adjacent stages is that stage's true
 full-scale cost, tunnel dispatch excluded.
 
 Stages (cumulative): gather → gram → +rhs → +solve → full (+scatter).
-Plus isolated: solve_only, scatter_only.
+Plus isolated: a standalone solve on a random SPD batch.
 
 Usage: python benchmarks/iter_ablation.py
 Env:   ABL_NNZ=20000000 ABL_RANK=64 ABL_REPS=2 ABL_INNER=3
@@ -193,9 +193,17 @@ def main() -> None:
                           "s_per_iter": round(dt, 4)}), flush=True)
         return dt
 
-    stages = os.environ.get(
-        "ABL_STAGES", "gather,gram,gramrhs,solve,full").split(",")
+    known = ("gather", "gram", "gramrhs", "solve", "full")
+    stages = os.environ.get("ABL_STAGES", ",".join(known)).split(",")
     for stage in stages:
+        # an unknown name would trace the full body but fold NOTHING
+        # into the carry — XLA then eliminates all the work and the
+        # "measurement" is the dispatch baseline wearing a stage label
+        if stage not in known:
+            print(json.dumps({"stage": stage,
+                              "error": f"unknown stage (known: {known})"
+                              }), flush=True)
+            continue
         timed_stage(stage)
 
     # isolated: solve on a random SPD batch the size of both sides

@@ -76,7 +76,7 @@ class ALSParams:
     #: whole-iteration bound. The 17.6MB shadow stays VMEM-staged:
     #: 1.98× per-iteration speedup for an ~0.4% relative perturbation
     #: of the normal-equation INPUTS (quality-checked by
-    #: tests/test_als.py::test_gather_dtype_quality).
+    #: tests/test_als.py::TestGatherDtype).
     gather_dtype: str = "float32"
     #: Weighted-gram realization: "einsum" (baseline batched matmul),
     #: "pair" (two rank-r systems packed per 128x128 MXU tile —
