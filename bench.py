@@ -252,7 +252,8 @@ def main():
 
     gather_env = os.environ.get("BENCH_GATHER", "auto")
 
-    def race(rank_r: int, repeats: int = 3):
+    def race(rank_r: int, repeats: int = 3, *, ratings_in=None,
+             packed_in=None, nnz_in=None, cands_override=None):
         """Time the training run at ``rank_r`` across the gram-mode ×
         gather-dtype candidates; return the winner's numbers. The
         gather axis (round 4): gathering factor rows from a bf16
@@ -260,26 +261,36 @@ def main():
         solve — measured 1.48× whole-training at 20M/rank 64 — but the
         winner must be MEASURED, not assumed, and its quality flows
         into the ndcg10 the bench reports (the holdout retrain uses
-        the winning params)."""
+        the winning params). A failed candidate is skipped, surfaced
+        in the result's ``race_errors``, and BLOCKS the persistent
+        gram_autotune record if it was an f32 candidate (a partial f32
+        race must not write a winner the unmeasured mode might beat).
+        ``ratings_in/packed_in/nnz_in`` let the rank-128 subsample
+        fallback reuse this exact timing/accounting path."""
+        r_in = ratings if ratings_in is None else ratings_in
+        p_in = packed if packed_in is None else packed_in
+        n_in = nnz if nnz_in is None else nnz_in
         gram_cands = ["einsum", "pair"] if gram_mode == "auto" \
             else [gram_mode]
         gather_cands = ["float32", "bfloat16"] if gather_env == "auto" \
             else [gather_env]
-        cands = [(gm, gd) for gm in gram_cands for gd in gather_cands]
-        best_dt, best_gm, best_params = float("inf"), gram_cands[0], None
-        best_f32_dt, best_f32_gm = float("inf"), gram_cands[0]
+        cands = cands_override or [(gm, gd) for gm in gram_cands
+                                   for gd in gather_cands]
+        best_dt, best_gm, best_params = float("inf"), cands[0][0], None
+        best_f32_dt, best_f32_gm = float("inf"), cands[0][0]
         cand_errors = []
+        f32_failed = False
         for gm, gd in cands:
             p_run = ALSParams(rank=rank_r, num_iterations=iterations,
                               implicit_prefs=True, alpha=alpha, reg=reg,
                               seed=3, gram_mode=gm, gather_dtype=gd)
             try:
-                U, V = train_als(ratings, p_run, packed=packed)  # warm
+                U, V = train_als(r_in, p_run, packed=p_in)  # warm
                 hard_sync(V)
                 # best-of-N — shared-tunnel TPUs show run-to-run noise
                 for _ in range(repeats):
                     t0 = time.monotonic()
-                    U, V = train_als(ratings, p_run, packed=packed)
+                    U, V = train_als(r_in, p_run, packed=p_in)
                     hard_sync(V)
                     d = time.monotonic() - t0
                     if d < best_dt:
@@ -290,15 +301,19 @@ def main():
                 # compile failure (e.g. rank-128 f32 through the tunnel
                 # helper) must not kill candidates that work
                 cand_errors.append(f"{gm}/{gd}: {str(ce)[:120]}")
+                f32_failed = f32_failed or gd == "float32"
         if best_params is None:
             raise RuntimeError("every race candidate failed: "
                                + " | ".join(cand_errors))
         if gram_mode == "auto" and len(gram_cands) > 1 \
-                and best_f32_dt < float("inf"):
+                and best_f32_dt < float("inf") and not f32_failed \
+                and cands_override is None:
             # persist the gram winner measured AT THE DEFAULT gather
             # dtype — gram_autotune consumers run gather_dtype=float32
             # unless told otherwise, so storing the global (possibly
-            # bf16-combined) winner could hand them the slower mode
+            # bf16-combined) winner could hand them the slower mode.
+            # Skipped when any f32 candidate FAILED: a partial race
+            # must not cache a winner the unmeasured mode might beat.
             try:
                 from predictionio_tpu.ops.gram_autotune import record
                 record(rank_r, best_f32_gm,
@@ -307,16 +322,19 @@ def main():
                                  "best_s": round(best_f32_dt, 3)})
             except Exception:  # noqa: BLE001 — advisory only
                 pass
-        fl = als_flops_per_iter(packed[0], packed[1], best_params)
+        fl = als_flops_per_iter(p_in[0], p_in[1], best_params)
         ach = fl * iterations / best_dt  # raw; display-rounded once
-        return {
-            "value": round(nnz * iterations / best_dt, 1),
+        out = {
+            "value": round(n_in * iterations / best_dt, 1),
             "achieved_tflops": round(ach / 1e12, 2),
             "mfu": round(ach / peak, 4) if peak else None,
             "gram_mode": best_gm,
             "gather_dtype": best_params.gather_dtype,
             "_achieved_flops_raw": ach,
-        }, best_dt, best_params
+        }
+        if cand_errors:
+            out["race_errors"] = cand_errors
+        return out, best_dt, best_params
 
     r64, dt, params_run = race(rank)
     ratings_per_sec = nnz * iterations / dt
@@ -353,31 +371,16 @@ def main():
                 sub_gather = "bfloat16" \
                     if gather_env in ("auto", "bfloat16") else gather_env
                 sub_gram = "einsum" if gram_mode == "auto" else gram_mode
-                p_sub = ALSParams(rank=128, num_iterations=iterations,
-                                  implicit_prefs=True, alpha=alpha,
-                                  reg=reg, seed=3, gram_mode=sub_gram,
-                                  gather_dtype=sub_gather)
-                packed_sub = pack_ratings(r_sub, p_sub)
-                U, V = train_als(r_sub, p_sub, packed=packed_sub)
-                hard_sync(V)
-                best_s = float("inf")
-                for _ in range(2):
-                    t0 = time.monotonic()
-                    U, V = train_als(r_sub, p_sub, packed=packed_sub)
-                    hard_sync(V)
-                    best_s = min(best_s, time.monotonic() - t0)
-                fl = als_flops_per_iter(packed_sub[0], packed_sub[1],
-                                        p_sub)
-                ach = fl * iterations / best_s
-                rank128 = {
-                    "value": round(sub_n * iterations / best_s, 1),
-                    "achieved_tflops": round(ach / 1e12, 2),
-                    "mfu": round(ach / peak, 4) if peak else None,
-                    "gram_mode": sub_gram,
-                    "gather_dtype": sub_gather,
-                    "nnz": sub_n, "scaled": True,
-                    "full_scale_error": str(e)[:160],
-                }
+                packed_sub = pack_ratings(r_sub, ALSParams(
+                    rank=128, num_iterations=iterations,
+                    implicit_prefs=True, alpha=alpha, reg=reg, seed=3))
+                rank128, _, _ = race(
+                    128, repeats=2, ratings_in=r_sub,
+                    packed_in=packed_sub, nnz_in=sub_n,
+                    cands_override=[(sub_gram, sub_gather)])
+                rank128.pop("_achieved_flops_raw", None)
+                rank128.update(nnz=sub_n, scaled=True,
+                               full_scale_error=str(e)[:160])
             except Exception as e2:  # noqa: BLE001
                 rank128 = {"error": str(e2)[:300]}
 

@@ -1,0 +1,111 @@
+"""Sequential-recommendation training throughput on the attached device.
+
+The sequential template (`templates/sequential.py`, beyond the
+reference — it has no sequence model at all) trains a causal-attention
+next-item model (`models/seqrec.py`); this probe gives it an on-chip
+performance artifact like ALS's bench: steps/s, sequences/s, and an
+attention+matmul FLOP estimate, plus a long-sequence datapoint that
+exercises the attention path where the MXU actually works per token.
+
+Prints one JSON line per configuration.
+
+Usage: python benchmarks/seqrec_bench.py
+Env:   SEQ_CONFIGS="N,L,dim,blocks;..." (default below)
+       SEQ_STEPS=30 (timed steps per config)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def flops_per_step(B, L, d, blocks, n_items, n_neg):
+    """Forward+backward matmul/attention FLOP estimate (3x forward)."""
+    attn = 2 * 2 * B * L * L * d            # QK^T + AV
+    proj = 4 * 2 * B * L * d * d            # q,k,v,o projections
+    ffn = 2 * 2 * B * L * d * (4 * d)       # 2 matmuls, 4x hidden
+    head = 2 * B * L * (n_neg + 1) * d      # sampled-softmax logits
+    fwd = blocks * (attn + proj + ffn) + head
+    return 3 * fwd
+
+
+def main() -> None:
+    cfgs = os.environ.get(
+        "SEQ_CONFIGS",
+        "8192,50,48,1;8192,200,64,2;2048,1024,64,2").split(";")
+    steps = int(os.environ.get("SEQ_STEPS", "30"))
+
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.seqrec import (
+        SeqRecParams,
+        _init_weights,
+        _train_step,
+    )
+
+    dev = jax.devices()[0].device_kind
+    for cfg in cfgs:
+        try:  # parsing inside: one malformed entry must not kill the rest
+            N, L, dim, blocks = (int(x) for x in cfg.split(","))
+            n_items = 27_000
+            rng = np.random.default_rng(0)
+            lens = rng.integers(L // 2, L + 1, N)
+            seqs = np.full((N, L), -1, np.int32)
+            for i, ln in enumerate(lens):  # host-side synthetic seqs
+                seqs[i, :ln] = rng.integers(0, n_items, ln)
+            p = SeqRecParams(dim=dim, heads=max(dim // 32, 1),
+                             num_blocks=blocks, max_len=L, num_epochs=1,
+                             batch_size=min(N, 256 if L <= 200 else 32),
+                             seed=7)
+            key = jax.random.key(7)
+            w = _init_weights(key, n_items, p)
+            opt_m = {k: jnp.zeros_like(v) for k, v in w.items()}
+            opt_v = {k: jnp.zeros_like(v) for k, v in w.items()}
+            step = jnp.zeros((), jnp.int32)
+            B = p.batch_size
+            xb = jnp.asarray(seqs[:B])
+            key, sub = jax.random.split(key)
+            w, opt_m, opt_v, step, loss = _train_step(
+                w, opt_m, opt_v, step, xb, sub, p, n_items)  # compile
+            float(loss)
+            t0 = time.monotonic()
+            for s in range(steps):
+                rows = (np.arange(B) + s * B) % N
+                xb = jnp.asarray(seqs[rows])
+                key, sub = jax.random.split(key)
+                w, opt_m, opt_v, step, loss = _train_step(
+                    w, opt_m, opt_v, step, xb, sub, p, n_items)
+            float(loss)  # hard sync
+            dt = time.monotonic() - t0
+            fl = flops_per_step(B, L, dim, blocks, n_items,
+                                p.n_negatives)
+            print(json.dumps({
+                "metric": "seqrec_train",
+                "batch": B, "seq_len": L, "dim": dim,
+                "blocks": blocks,
+                "steps_per_s": round(steps / dt, 2),
+                "sequences_per_s": round(steps * B / dt, 1),
+                "tokens_per_s": round(steps * B * L / dt, 1),
+                "model_tflops": round(fl * steps / dt / 1e12, 3),
+                "loss": round(float(loss), 4),
+                "device": dev,
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — next config
+            print(json.dumps({"config": cfg,
+                              "error": str(e)[:200]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
