@@ -395,3 +395,128 @@ def test_localfs_torn_tail_recovers_and_next_append_is_clean(tmp_path):
     got = sorted(e.entity_id for e in s3.events().find(1))
     assert got == sorted([f"b0e{j}" for j in range(5)]
                          + [f"b1e{j}" for j in range(5)])
+
+
+# -- native bulk-import lane under SIGKILL (round 4) -------------------
+
+IMPORT_WRITER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    root = sys.argv[1]
+    files_dir = sys.argv[2]
+    ack_path = sys.argv[3]
+    start_file = int(sys.argv[4])
+
+    from predictionio_tpu.data.storage import Storage
+
+    es = Storage(env={
+        "PIO_STORAGE_SOURCES_SEG_TYPE": "segmentfs",
+        "PIO_STORAGE_SOURCES_SEG_PATH": root,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SEG",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SEG",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SEG",
+    }).events()
+    es.init(1)
+    ack = open(ack_path, "a")
+    k = start_file
+    print("READY", flush=True)
+    while True:
+        path = os.path.join(files_dir, f"f{k}.jsonl")
+        if not os.path.exists(path):
+            break
+        es.import_jsonl(path, 1)
+        ack.write(f"{k}\\n")
+        ack.flush()
+        os.fsync(ack.fileno())
+        k += 1
+""")
+
+
+def test_kill_native_import_midblock(tmp_path):
+    """SIGKILL a process running the native segmentfs bulk-import lane
+    mid-file. Contract: acked files fully present; the in-flight file's
+    events form a clean BLOCK PREFIX (blocks are the atomic publish
+    unit — count divisible by the per-block line count, never a torn
+    segment); the log stays readable and writable afterwards."""
+    from predictionio_tpu.native import codec
+
+    if codec() is None:  # Python lane ignores PIO_IMPORT_BLOCK — the
+        pytest.skip("no native toolchain")  # contract under test is gone
+
+    rng = np.random.default_rng(0xC0DEC)
+    root = str(tmp_path / "store")
+    files_dir = tmp_path / "files"
+    files_dir.mkdir()
+    # small blocks: each import commits in several atomic steps, so a
+    # kill lands inside a file with near-certainty
+    line = ('{"event": "rate", "entityType": "user", '
+            '"entityId": "F%DE%", "targetEntityType": "item", '
+            '"targetEntityId": "i", "properties": {"rating": 1.0}, '
+            '"eventTime": "2015-03-01T00:00:00.000Z"}')
+    per_line = len(line) + 2
+    lines_per_block = 8
+    n_files, lines_per_file = 40, 64
+    for k in range(n_files):
+        with open(files_dir / f"f{k}.jsonl", "w") as f:
+            for j in range(lines_per_file):
+                f.write(line.replace("%DE%", f"{k}_{j}") + "\n")
+
+    ack_path = tmp_path / "acks.log"
+    ack_path.touch()
+    env = {k2: v for k2, v in os.environ.items()
+           if k2 not in ("XLA_FLAGS",)}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PIO_IMPORT_BLOCK"] = str(per_line * lines_per_block)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    writer_py = tmp_path / "import_writer.py"
+    writer_py.write_text(IMPORT_WRITER)
+
+    from predictionio_tpu.data.storage import Storage
+
+    def store():
+        return Storage(env={
+            "PIO_STORAGE_SOURCES_SEG_TYPE": "segmentfs",
+            "PIO_STORAGE_SOURCES_SEG_PATH": root,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SEG",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SEG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SEG",
+        })
+
+    next_file = 0
+    for rnd in range(5):
+        p = subprocess.Popen(
+            [sys.executable, str(writer_py), root, str(files_dir),
+             str(ack_path), str(next_file)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        assert p.stdout.readline().strip() == "READY"
+        time.sleep(float(rng.uniform(0.01, 0.25)))
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+
+        acked = {int(x) for x in
+                 ack_path.read_text().split() if x.strip()}
+        s = store()
+        events = list(s.events().find(1))  # readable: no torn segment
+        by_file: dict = {}
+        for e in events:
+            fk = int(e.entity_id[1:].split("_")[0])
+            by_file.setdefault(fk, set()).add(e.entity_id)
+        for fk in acked:
+            assert len(by_file.get(fk, ())) == lines_per_file, \
+                f"acked file {fk} incomplete"
+        for fk, ids in by_file.items():
+            if fk in acked:
+                continue
+            # in-flight file: a clean block prefix, and exactly the
+            # FIRST lines (publish order = file order)
+            assert len(ids) % lines_per_block == 0, \
+                (fk, len(ids), "torn block")
+            assert ids == {f"F{fk}_{j}" for j in range(len(ids))}
+        s.events().close()
+        next_file = max(acked, default=-1) + 1
+        if next_file >= n_files:
+            break

@@ -259,6 +259,28 @@ class TestRemoteBulkImport:
             assert json.loads(body)["imported"] == 5
         assert len(list(store.find(1))) == 5
 
+    def test_404_falls_back_to_batch_lane(self, tmp_path, remote):
+        # a NEWER client against an OLDER storage server (no
+        # /import_jsonl route) must degrade to the inherited per-event
+        # lane instead of failing the import
+        from predictionio_tpu.data.storage.base import StorageError
+
+        store, _ = remote
+        real = store.c.request
+
+        def no_bulk(method, path, *a, **kw):
+            if "/import_jsonl" in path:
+                err = StorageError("storage server 404 on " + path)
+                err.status = 404
+                raise err
+            return real(method, path, *a, **kw)
+
+        store.c.request = no_bulk
+        p = tmp_path / "in.jsonl"
+        p.write_text(_lines(), encoding="utf-8")
+        assert store.import_jsonl(str(p), 1) == 6
+        assert len(list(store.find(1))) == 6
+
     def test_error_reports_global_prefix(self, tmp_path, remote):
         store, _ = remote
         rows = [json.dumps({"event": "buy", "entityType": "u",
