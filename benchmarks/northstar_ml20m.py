@@ -172,13 +172,32 @@ def main():
     }
     ej = workdir / "engine.json"
     ej.write_text(json.dumps(variant))
-    if "train_s" in result and os.environ.get("NORTHSTAR_RETRAIN") != "1":
-        pass  # a completed train stage survives the retry
+    def parse_stages(stdout: str):
+        for line in stdout.splitlines():
+            if line.startswith("Train stages: "):
+                try:
+                    return json.loads(line[len("Train stages: "):])
+                except json.JSONDecodeError:
+                    return None
+        return None
+
+    if ("train_s" in result and "train2_s" in result
+            and os.environ.get("NORTHSTAR_RETRAIN") != "1"):
+        pass  # both completed train runs survive the retry
     else:
-        _, dt = run_cli(env, "train", "--engine-json", str(ej))
+        # TWO consecutive trains: the flagship number plus its
+        # run-to-run stability (VERDICT r4 weak #1: 2x variance with
+        # no evidence of where the host seconds went — the per-stage
+        # breakdown the CLI now prints lands in this artifact)
+        proc, dt = run_cli(env, "train", "--engine-json", str(ej))
         result["train_s"] = round(dt, 1)
+        result["train_stages"] = parse_stages(proc.stdout)
         result["train_ratings_per_s_per_iter"] = round(
             len(users) * args.iters / dt, 1)
+        checkpoint_result()
+        proc, dt = run_cli(env, "train", "--engine-json", str(ej))
+        result["train2_s"] = round(dt, 1)
+        result["train2_stages"] = parse_stages(proc.stdout)
     checkpoint_result()
 
     # --- eval: shipped Precision@K grid + NDCG@10, k-fold, through

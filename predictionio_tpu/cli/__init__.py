@@ -262,6 +262,8 @@ def cmd_train(args, storage: Storage) -> int:
         _out(f"Workflow stopped after {stage} (instance {instance_id} "
              f"left in INIT).")
     else:
+        if ctx.stage_timings:
+            _out(f"Train stages: {json.dumps(ctx.stage_timings)}")
         _out(f"Training completed. Engine instance ID: {instance_id}")
     return 0
 
@@ -1014,6 +1016,13 @@ def main(argv: Optional[List[str]] = None,
     if args.command == "version":
         _out(__version__)
         return 0
+    if args.command in ("train", "eval", "deploy", "batchpredict",
+                        "run", "shell", "status"):
+        # device-using commands share one persistent XLA program cache
+        # (the JVM-warmup analogue); storage-only commands skip it so
+        # they never pay the jax import
+        from ..utils.platform import enable_compilation_cache
+        enable_compilation_cache()
     if os.environ.get("PIO_COORDINATOR") \
             or os.environ.get("PIO_NUM_PROCESSES"):
         # join the multi-controller system before any device use (the

@@ -101,26 +101,35 @@ class Engine:
 
     # -- train (controller/Engine.scala:623-710) ---------------------------
     def train(self, ctx: Context, engine_params: EngineParams) -> TrainResult:
+        import time as _time
+
+        stages = ctx.stage_timings
+        t0 = _time.monotonic()
         datasource = self.make_datasource(engine_params)
         td = datasource.read_training(ctx)
+        stages["read_s"] = round(_time.monotonic() - t0, 2)
         _sanity(td, "training data", ctx.skip_sanity_check)
         if ctx.stop_after_read:
             log.info("stopping after read")
             return TrainResult(models=[], engine_params=engine_params)
 
+        t0 = _time.monotonic()
         preparator = self.make_preparator(engine_params)
         pd = preparator.prepare(ctx, td)
+        stages["prepare_s"] = round(_time.monotonic() - t0, 2)
         _sanity(pd, "prepared data", ctx.skip_sanity_check)
         if ctx.stop_after_prepare:
             log.info("stopping after prepare")
             return TrainResult(models=[], engine_params=engine_params)
 
         models = []
+        t0 = _time.monotonic()
         for i, algo in enumerate(self.make_algorithms(engine_params)):
             log.info("training algorithm %d: %s", i, type(algo).__name__)
             model = algo.train(ctx, pd)
             _sanity(model, f"model[{i}]", ctx.skip_sanity_check)
             models.append(model)
+        stages["algo_train_s"] = round(_time.monotonic() - t0, 2)
         return TrainResult(models=models, engine_params=engine_params)
 
     # -- eval (controller/Engine.scala:728-817) ----------------------------

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ragged import BucketedHistories, PaddedHistories, SplitHistories
 from ..ops.solve import gramian, solve_spd_batch
+from ..utils.platform import enable_compilation_cache
 
 #: PartitionSpec sharding rows over every mesh axis (ALS flattens the
 #: (data, model) mesh — factor rows spread across all devices).
@@ -724,6 +725,7 @@ def pack_ratings(ratings: RatingsCOO, params: ALSParams,
     ``train_als`` call so retrains skip the transfer + sort. Under a
     multi-controller runtime this routes to
     :func:`pack_ratings_multihost` (per-process device feeding)."""
+    enable_compilation_cache()
     if mesh is not None and jax.process_count() > 1:
         return pack_ratings_multihost(ratings, params, mesh)
     if hasattr(ratings, "to_coo"):  # a sharded source on one host
@@ -1058,6 +1060,7 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
     the latest saved iteration (step-level resume, SURVEY §5 — the
     reference restarts training from scratch after any failure).
     """
+    enable_compilation_cache()
     if ratings is None:
         # multi-host partial reads: the host never holds a global COO;
         # the packed layout carries the problem dims instead

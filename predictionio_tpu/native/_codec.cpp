@@ -912,6 +912,12 @@ PyObject* import_jsonl(PyObject*, PyObject* args) {
   char idbuf[33];
   static const char hexd[] = "0123456789abcdef";
   std::string et, ct;
+  // the parse/encode loop touches only borrowed immutable buffers
+  // (kept alive by the args tuple) and C++ state, so the GIL is
+  // released for the duration — a 32MB server-side block otherwise
+  // stalls every other storage-server thread (ADVICE r4)
+  bool fellback = false, rand_exhausted = false;
+  Py_BEGIN_ALLOW_THREADS;
   while (line < bend) {
     ++nline;
     const char* nl = static_cast<const char*>(
@@ -954,9 +960,8 @@ PyObject* import_jsonl(PyObject*, PyObject* args) {
         idn = r.evid.size();
       } else {
         if (rand_off + 16 > rand_len) {
-          PyErr_SetString(PyExc_ValueError,
-                          "import_jsonl: rand buffer exhausted");
-          return nullptr;
+          rand_exhausted = true;
+          goto loop_done;
         }
         unsigned char b[16];
         memcpy(b, rand + rand_off, 16);
@@ -1010,13 +1015,103 @@ PyObject* import_jsonl(PyObject*, PyObject* args) {
       continue;
     }
   fallback:
+    fellback = true;
+    goto loop_done;
+  }
+loop_done:;
+  Py_END_ALLOW_THREADS;
+  if (rand_exhausted) {
+    PyErr_SetString(PyExc_ValueError,
+                    "import_jsonl: rand buffer exhausted");
+    return nullptr;
+  }
+  if (fellback)
     return Py_BuildValue("(OLL)", Py_None, static_cast<long long>(0),
                          nline);
-  }
   PyObject* pb = PyBytes_FromStringAndSize(
       payload.data(), static_cast<Py_ssize_t>(payload.size()));
   if (!pb) return nullptr;
   return Py_BuildValue("(NLL)", pb, nev, static_cast<long long>(0));
+}
+
+// pack_flat(rows, cols, vals, row_base, row_cap, n_rows, S)
+//   rows/cols: int32 little-endian buffers (nnz entries each),
+//   vals: float32 buffer (nnz), row_base/row_cap: int32 (n_rows)
+//   -> (idx: bytes of S int32, val: bytes of S float32)
+// Host counting-sort scatter with the exact semantics of
+// ops/ragged._pack_flat_on_device (stable input order within a row,
+// entries beyond row_cap drop, padding slots stay zero) — one linear
+// pass instead of a device round-trip: at MovieLens-20M scale the
+// jitted pack cost ~35s/side through a remote-compile tunnel
+// (program build + ~240MB H2D + ~320MB D2H); this does it in ~1s on
+// one core and the flat buffers are already where the bucket carving
+// wants them (host).
+PyObject* pack_flat(PyObject*, PyObject* args) {
+  Py_buffer rows, cols, vals, base, cap;
+  long long n_rows, S;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*y*LL", &rows, &cols, &vals, &base,
+                        &cap, &n_rows, &S))
+    return nullptr;
+  PyObject* out = nullptr;
+  PyObject* idx_b = nullptr;
+  PyObject* val_b = nullptr;
+  const Py_ssize_t nnz = rows.len / 4;
+  if (cols.len != rows.len || vals.len != rows.len ||
+      base.len < n_rows * 4 || cap.len < n_rows * 4 || S < 0 ||
+      n_rows < 0) {
+    PyErr_SetString(PyExc_ValueError, "pack_flat: buffer size mismatch");
+    goto done;
+  }
+  idx_b = PyBytes_FromStringAndSize(nullptr, S * 4);
+  val_b = PyBytes_FromStringAndSize(nullptr, S * 4);
+  if (!idx_b || !val_b) goto done;
+  {
+    int32_t* idx = reinterpret_cast<int32_t*>(PyBytes_AS_STRING(idx_b));
+    float* val = reinterpret_cast<float*>(PyBytes_AS_STRING(val_b));
+    const int32_t* r = static_cast<const int32_t*>(rows.buf);
+    const int32_t* c = static_cast<const int32_t*>(cols.buf);
+    const float* v = static_cast<const float*>(vals.buf);
+    const int32_t* rb = static_cast<const int32_t*>(base.buf);
+    const int32_t* rc = static_cast<const int32_t*>(cap.buf);
+    bool oob = false;
+    Py_BEGIN_ALLOW_THREADS;
+    memset(idx, 0, static_cast<size_t>(S) * 4);
+    memset(val, 0, static_cast<size_t>(S) * 4);
+    std::vector<int32_t> used(static_cast<size_t>(n_rows), 0);
+    for (Py_ssize_t k = 0; k < nnz; ++k) {
+      const int32_t row = r[k];
+      if (row < 0 || row >= n_rows) {
+        oob = true;
+        break;
+      }
+      const int32_t u = used[row];
+      if (u >= rc[row]) continue;  // capped entry drops (input order)
+      const int64_t dest = static_cast<int64_t>(rb[row]) + u;
+      if (dest < 0 || dest >= S) {
+        oob = true;
+        break;
+      }
+      used[row] = u + 1;
+      idx[dest] = c[k];
+      val[dest] = v[k];
+    }
+    Py_END_ALLOW_THREADS;
+    if (oob) {
+      PyErr_SetString(PyExc_ValueError,
+                      "pack_flat: row id or destination out of range");
+      goto done;
+    }
+  }
+  out = Py_BuildValue("(OO)", idx_b, val_b);
+done:
+  Py_XDECREF(idx_b);
+  Py_XDECREF(val_b);
+  PyBuffer_Release(&rows);
+  PyBuffer_Release(&cols);
+  PyBuffer_Release(&vals);
+  PyBuffer_Release(&base);
+  PyBuffer_Release(&cap);
+  return out;
 }
 
 PyMethodDef methods[] = {
@@ -1024,6 +1119,8 @@ PyMethodDef methods[] = {
      "Parse one jsonl event segment into column lists."},
     {"import_jsonl", import_jsonl, METH_VARARGS,
      "Convert API-format JSON lines into a segment payload."},
+    {"pack_flat", pack_flat, METH_VARARGS,
+     "Counting-sort COO triples into a flat ragged-history buffer."},
     {nullptr, nullptr, 0, nullptr},
 };
 

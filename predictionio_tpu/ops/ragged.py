@@ -355,20 +355,23 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
     if S >= 2 ** 31:  # pragma: no cover — would need >1B ratings
         raise ValueError(f"bucketed layout needs {S} slots (> int32); "
                          "shard the dataset across hosts first")
-    flat_idx, flat_val = _pack_flat_on_device(
-        jnp.asarray(rows, dtype=jnp.int32),
-        jnp.asarray(cols, dtype=jnp.int32),
-        jnp.asarray(vals, dtype=jnp.float32),
-        jnp.asarray(row_base, dtype=jnp.int32),
-        jnp.asarray(counts, dtype=jnp.int32),  # post-cap per-row budget
-        n_rows=n_rows, S=S)
+    flat = _pack_flat_native(rows, cols, vals, row_base, counts,
+                             n_rows, S)
+    if flat is None:
+        flat = _pack_flat_on_device(
+            jnp.asarray(rows, dtype=jnp.int32),
+            jnp.asarray(cols, dtype=jnp.int32),
+            jnp.asarray(vals, dtype=jnp.float32),
+            jnp.asarray(row_base, dtype=jnp.int32),
+            jnp.asarray(counts, dtype=jnp.int32),  # post-cap budget
+            n_rows=n_rows, S=S)
     # land the packed layout on HOST: the only device-resident form
     # should be the BLOCKED (mesh-shaped) copies that training actually
     # reads (``PackedRatings.blocked``). Keeping these slices on device
     # made every pack live twice in HBM — measured as the eval sweep's
     # RESOURCE_EXHAUSTED with fold packs held by the fast-eval cache.
-    flat_idx = np.asarray(flat_idx)
-    flat_val = np.asarray(flat_val)
+    flat_idx = np.asarray(flat[0])
+    flat_val = np.asarray(flat[1])
     buckets = []
     for L, rows_k, n_bk_pad, off in plan:
         n_bk = len(rows_k)
@@ -389,6 +392,29 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
             counts=cnt, row_ids=row_ids))
     return BucketedHistories(buckets=tuple(buckets), n_rows=n_rows,
                              n_rows_padded=n_rows_pad)
+
+
+def _pack_flat_native(rows, cols, vals, row_base, row_cap, n_rows: int,
+                      S: int):
+    """Host C++ counting-sort pack (``native/_codec.cpp pack_flat``), or
+    None when the extension is unavailable. Same contract as
+    :func:`_pack_flat_on_device` but the flat buffers are born on the
+    host — which is where the bucket carving wants them anyway, so the
+    device round-trip (~240MB H2D + ~320MB D2H at ML-20M scale through
+    a remote tunnel, plus two program compiles) disappears."""
+    from ..native import codec
+
+    mod = codec()
+    if mod is None or not hasattr(mod, "pack_flat"):
+        return None
+    r32 = np.ascontiguousarray(rows, dtype=np.int32)
+    c32 = np.ascontiguousarray(cols, dtype=np.int32)
+    v32 = np.ascontiguousarray(vals, dtype=np.float32)
+    b32 = np.ascontiguousarray(row_base, dtype=np.int32)
+    k32 = np.ascontiguousarray(row_cap, dtype=np.int32)
+    ib, vb = mod.pack_flat(r32, c32, v32, b32, k32, int(n_rows), int(S))
+    return (np.frombuffer(ib, dtype=np.int32),
+            np.frombuffer(vb, dtype=np.float32))
 
 
 def _pack_flat_on_device(r, c, v, row_base, row_cap, *, n_rows: int,
@@ -470,6 +496,21 @@ def pack_histories_device(rows: np.ndarray, cols: np.ndarray,
 
     L = max(int(max_len), 1)
     n_pad = ((n_rows + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    # native host pack first (no device round-trip, no pack compile)
+    base = np.arange(n_rows, dtype=np.int64) * L
+    if n_pad * L < 2 ** 31:
+        flat = _pack_flat_native(
+            rows, cols, vals, base,
+            np.full(n_rows, L, dtype=np.int32), n_rows, n_pad * L)
+    else:  # pragma: no cover — >2^31 slots needs the device path
+        flat = None
+    if flat is not None:
+        counts = np.bincount(np.asarray(rows), minlength=n_rows)
+        cnt = np.zeros(n_pad, np.int32)
+        cnt[:n_rows] = np.minimum(counts, L)
+        return PaddedHistories(indices=flat[0].reshape(n_pad, L),
+                               values=flat[1].reshape(n_pad, L),
+                               counts=cnt)
     idx, val, cnt = _pack_on_device(
         jnp.asarray(rows, dtype=jnp.int32),
         jnp.asarray(cols, dtype=jnp.int32),

@@ -83,12 +83,36 @@ def run_train(ctx: Context, engine: Engine, engine_params: EngineParams,
     instance_id = broadcast_str(instance_id)
     log.info("engine instance %s: training started", instance_id)
 
+    # warm the device runtime (backend init + one tiny D2H) in the
+    # background while the datasource reads from storage: the FIRST
+    # device→host fetch of a process pays a ~10-15s tunnel/runtime
+    # warmup (measured at ML-20M: the model fetch took 15.7s cold,
+    # 1.4s after any prior fetch), and overlapping it with the
+    # storage read makes it free
+    import threading as _threading
+    import time as _time
+
+    def _warm_device():
+        try:
+            import numpy as _np
+
+            import jax.numpy as _jnp
+
+            _np.asarray(_jnp.ones((8, 128), _jnp.float32) * 2)
+        except Exception:  # noqa: BLE001 — warmup must never kill a train
+            pass
+
+    warm = _threading.Thread(target=_warm_device, daemon=True,
+                             name="device-warmup")
+    warm.start()
+
     result = engine.train(ctx, engine_params)
     if ctx.stop_after_read or ctx.stop_after_prepare:
         log.info("workflow stopped early (stop-after flag); instance %s "
                  "left in INIT", instance_id)
         return instance_id
 
+    t0 = _time.monotonic()
     algos = engine.make_algorithms(engine_params)
     stored: List[Any] = []
     for i, (algo, model) in enumerate(zip(algos, result.models)):
@@ -101,7 +125,11 @@ def run_train(ctx: Context, engine: Engine, engine_params: EngineParams,
         assert done is not None
         instances.update(done.copy(status=STATUS_COMPLETED,
                                    end_time=_now()))
-    log.info("engine instance %s: training completed", instance_id)
+    ctx.stage_timings["persist_s"] = round(_time.monotonic() - t0, 2)
+    # one parseable line: the northstar harness lifts this into its
+    # artifact (VERDICT r4 next-round item 1's stage breakdown)
+    log.info("engine instance %s: training completed; stages=%s",
+             instance_id, _json.dumps(ctx.stage_timings))
     return instance_id
 
 
