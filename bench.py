@@ -279,29 +279,43 @@ def main():
         best_dt, best_gm, best_params = float("inf"), cands[0][0], None
         best_f32_dt, best_f32_gm = float("inf"), cands[0][0]
         cand_errors = []
+        retried = 0
         f32_failed = False
         for gm, gd in cands:
             p_run = ALSParams(rank=rank_r, num_iterations=iterations,
                               implicit_prefs=True, alpha=alpha, reg=reg,
                               seed=3, gram_mode=gm, gather_dtype=gd)
-            try:
-                U, V = train_als(r_in, p_run, packed=p_in)  # warm
-                hard_sync(V)
-                # best-of-N — shared-tunnel TPUs show run-to-run noise
-                for _ in range(repeats):
-                    t0 = time.monotonic()
-                    U, V = train_als(r_in, p_run, packed=p_in)
+            # retry-once on transient compile-service failures (round 4:
+            # three candidates died on `remote_compile: HTTP 500` and a
+            # 1-of-4 walkover "won" the race — a transient helper crash
+            # must not void a candidate's measurement)
+            for attempt in (0, 1):
+                try:
+                    U, V = train_als(r_in, p_run, packed=p_in)  # warm
                     hard_sync(V)
-                    d = time.monotonic() - t0
-                    if d < best_dt:
-                        best_dt, best_gm, best_params = d, gm, p_run
-                    if gd == "float32" and d < best_f32_dt:
-                        best_f32_dt, best_f32_gm = d, gm
-            except Exception as ce:  # noqa: BLE001 — one candidate's
-                # compile failure (e.g. rank-128 f32 through the tunnel
-                # helper) must not kill candidates that work
-                cand_errors.append(f"{gm}/{gd}: {str(ce)[:120]}")
-                f32_failed = f32_failed or gd == "float32"
+                    # best-of-N — shared tunnels show run-to-run noise
+                    for _ in range(repeats):
+                        t0 = time.monotonic()
+                        U, V = train_als(r_in, p_run, packed=p_in)
+                        hard_sync(V)
+                        d = time.monotonic() - t0
+                        if d < best_dt:
+                            best_dt, best_gm, best_params = d, gm, p_run
+                        if gd == "float32" and d < best_f32_dt:
+                            best_f32_dt, best_f32_gm = d, gm
+                    break
+                except Exception as ce:  # noqa: BLE001 — one candidate's
+                    # compile failure (e.g. rank-128 f32 through the
+                    # tunnel helper) must not kill candidates that work
+                    transient = ("HTTP 500" in str(ce)
+                                 or "remote_compile" in str(ce))
+                    if attempt == 0 and transient:
+                        retried += 1
+                        time.sleep(10.0)
+                        continue
+                    cand_errors.append(f"{gm}/{gd}: {str(ce)[:120]}")
+                    f32_failed = f32_failed or gd == "float32"
+                    break
         if best_params is None:
             raise RuntimeError("every race candidate failed: "
                                + " | ".join(cand_errors))
@@ -334,6 +348,8 @@ def main():
         }
         if cand_errors:
             out["race_errors"] = cand_errors
+        if retried:
+            out["race_retries"] = retried
         return out, best_dt, best_params
 
     r64, dt, params_run = race(rank)
@@ -415,18 +431,16 @@ def main():
                 "benchmarks"))
             import serving_bench as sb
 
-            from predictionio_tpu.server.engineserver import ServerConfig
             n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "200"))
             n_cat = int(os.environ.get("BENCH_SERVE_ITEMS", "1200000"))
-            dev_model = sb.synth_model(50_000, n_cat, 64, device=True)
-            per_query = sb.bench_config(
-                dev_model, ServerConfig(), n_req, 8, "device_per_query")
-            microbatch = sb.bench_config(
-                dev_model, ServerConfig(batching=True, max_batch=64,
-                                        batch_window_ms=2.0),
-                n_req, 8, "device_microbatch")
-            serving = {"per_query": per_query,
-                       "microbatch": microbatch}
+            hi_threads = int(os.environ.get("BENCH_SERVE_THREADS_HI",
+                                            "256"))
+            # host fast path + per-query trickle + the apples-to-apples
+            # burst pair (per-query vs micro-batcher at the same
+            # offered concurrency) — one battery definition, shared
+            # with serving_bench.main
+            serving = sb.standard_battery(n_cat, 64, n_req, 8,
+                                          hi_threads)
         except Exception as e:  # noqa: BLE001 — report, don't die
             serving = {"error": str(e)[:300]}
 

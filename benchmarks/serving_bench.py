@@ -119,30 +119,39 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     idx_lock = threading.Lock()
 
     def worker():
-        while True:
-            with idx_lock:
-                k = next(idx, None)
-            if k is None:
-                return
-            body = json.dumps({"user": f"u{users[k]}",
-                               "num": 10}).encode()
-            t0 = time.monotonic()
-            try:
-                with urllib.request.urlopen(urllib.request.Request(
-                        f"http://127.0.0.1:{port}/queries.json",
-                        data=body,
-                        headers={"Content-Type": "application/json"}),
-                        timeout=120) as resp:
-                    out = json.loads(resp.read())
-                if out.get("itemScores") is None:
-                    raise RuntimeError(f"bad response: {out}")
-            except Exception as e:  # noqa: BLE001 — surface, not die
+        # one persistent HTTP/1.1 connection per worker: on a shared
+        # 1-core host, per-request TCP setup/teardown dominates before
+        # the device does — keep-alive measures the serving stack, not
+        # the client's socket churn
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        try:
+            while True:
+                with idx_lock:
+                    k = next(idx, None)
+                if k is None:
+                    return
+                body = json.dumps({"user": f"u{users[k]}",
+                                   "num": 10}).encode()
+                t0 = time.monotonic()
+                try:
+                    conn.request("POST", "/queries.json", body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    out = json.loads(conn.getresponse().read())
+                    if out.get("itemScores") is None:
+                        raise RuntimeError(f"bad response: {out}")
+                except Exception as e:  # noqa: BLE001 — surface, not die
+                    with lat_lock:
+                        errors.append(str(e))
+                    conn.close()  # reconnect lazily on next request
+                    continue
+                dt = time.monotonic() - t0
                 with lat_lock:
-                    errors.append(str(e))
-                continue
-            dt = time.monotonic() - t0
-            with lat_lock:
-                lat.append(dt)
+                    lat.append(dt)
+        finally:
+            conn.close()
 
     t_start = time.monotonic()
     threads = [threading.Thread(target=worker) for _ in range(n_threads)]
@@ -168,6 +177,35 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     }
 
 
+def standard_battery(n_items_dev: int, rank: int, n_req: int,
+                     n_threads: int, hi_threads: int) -> dict:
+    """The four-config serving battery — ONE definition shared by this
+    script's ``main()`` and ``bench.py``'s serving block (they drifted
+    when each kept its own copy): host fast path, per-query at trickle
+    load, per-query and micro-batcher at burst load (``hi_threads``
+    offered concurrency — the apples-to-apples pair)."""
+    from predictionio_tpu.server.engineserver import ServerConfig
+
+    host_model = synth_model(2000, 2000, rank, device=False)
+    dev_model = synth_model(50_000, n_items_dev, rank, device=True)
+    hi_req = max(n_req, 8 * hi_threads)
+    return {
+        "host_fast_path": bench_config(
+            host_model, ServerConfig(), max(n_req, 300), n_threads,
+            "host_fast_path"),
+        "per_query": bench_config(
+            dev_model, ServerConfig(), n_req, n_threads,
+            "device_per_query"),
+        "per_query_loaded": bench_config(
+            dev_model, ServerConfig(), hi_req, hi_threads,
+            "device_per_query_loaded"),
+        "microbatch": bench_config(
+            dev_model, ServerConfig(batching=True, max_batch=128,
+                                    batch_window_ms=2.0),
+            hi_req, hi_threads, "device_microbatch"),
+    }
+
+
 def main() -> None:
     n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -184,17 +222,9 @@ def main() -> None:
     force_cpu_if_requested()
     device_kind = jax.devices()[0].device_kind
 
-    results = []
-    host_model = synth_model(2000, 2000, rank, device=False)
-    results.append(bench_config(host_model, ServerConfig(), n_requests,
-                                n_threads, "host_small_catalog"))
-    dev_model = synth_model(n_users, n_items_dev, rank, device=True)
-    results.append(bench_config(dev_model, ServerConfig(), n_requests,
-                                n_threads, "device_per_query"))
-    results.append(bench_config(
-        dev_model, ServerConfig(batching=True, max_batch=64,
-                                batch_window_ms=2.0),
-        n_requests, n_threads, "device_microbatch"))
+    hi = int(os.environ.get("SERVE_THREADS_HI", "256"))
+    results = list(standard_battery(n_items_dev, rank, n_requests,
+                                    n_threads, hi).values())
     print(json.dumps({
         "bench": "serving_queries_json",
         "device": device_kind,
