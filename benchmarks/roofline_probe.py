@@ -58,12 +58,21 @@ def main() -> None:
         captured["a"], captured["k"] = a, k
         return orig(*a, **k)
 
+    packed = als.pack_ratings(ratings, params)
     als._train_fused = shim
     try:
-        t0 = time.monotonic()
-        U, V = als.train_als(ratings, params)
+        # warm run: compiles + ships the blocked layout
+        U, V = als.train_als(ratings, params, packed=packed)
         np.asarray(jax.device_get(V[0, :1]))  # hard sync
-        run_s = time.monotonic() - t0
+        # steady state: best-of-N repeat runs on the SAME packed
+        # problem — the pure compiled-loop time the bench headline
+        # measures, no compile or transfer in the denominator
+        best = float("inf")
+        for _ in range(int(os.environ.get("PROBE_REPEATS", "3"))):
+            t0 = time.monotonic()
+            U, V = als.train_als(ratings, params, packed=packed)
+            np.asarray(jax.device_get(V[0, :1]))
+            best = min(best, time.monotonic() - t0)
     finally:
         als._train_fused = orig
     if "a" not in captured:
@@ -78,22 +87,36 @@ def main() -> None:
         ca = ca[0] if ca else {}
     flops = float(ca.get("flops", 0.0))
     byts = float(ca.get("bytes accessed", 0.0))
+    device = jax.devices()[0].device_kind
+    #: public spec-sheet HBM bandwidth (GB/s) per generation
+    peak_bw = {"TPU v5 lite": 819, "TPU v5e": 819, "TPU v4": 1228,
+               "TPU v5": 2765, "TPU v5p": 2765, "TPU v6e": 1640,
+               "TPU v6 lite": 1640}
+    bw = next((v for k, v in peak_bw.items() if device.startswith(k)),
+              None)
+    per_iter_s = best / max(iters, 1)
     out = {
         "metric": "als_fused_roofline",
-        "device": jax.devices()[0].device_kind,
+        "device": device,
         "rank": rank, "nnz": nnz, "iters_in_program": iters,
         "xla_flops": flops,
         "xla_bytes_accessed": byts,
         "xla_optimal_seconds": ca.get("optimal_seconds"),
-        "run_s_including_dispatch": round(run_s, 3),
+        "steady_state_s_per_iter": round(per_iter_s, 4),
         "model_flops_per_iter": als.als_flops_per_iter(
-            *als.pack_ratings(ratings, params)[:2], params),
+            packed[0], packed[1], params),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime()),
     }
-    if byts and run_s:
-        out["implied_GBps_if_run_s_is_compute"] = round(
-            byts / run_s / 1e9, 1)
+    if byts and best:
+        # bytes accessed is XLA's POST-fusION traffic model for the
+        # compiled program (iters iterations): achieved bandwidth =
+        # bytes / steady-state run time
+        gbps = byts / best / 1e9
+        out["hbm_gbps"] = round(gbps, 1)
+        if bw:
+            out["hbm_peak_gbps"] = bw
+            out["hbm_utilization"] = round(gbps / bw, 3)
     print(json.dumps(out))
 
 

@@ -211,7 +211,6 @@ def main() -> None:
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
     n_threads = int(os.environ.get("SERVE_THREADS", "8"))
     n_requests = int(os.environ.get("SERVE_REQUESTS", "400"))
-    n_users = 50_000
 
     assert n_items_dev * rank > HOST_SERVE_WORK, \
         "device catalog must exceed HOST_SERVE_WORK to force the MXU path"

@@ -169,8 +169,16 @@ class RecommendationDataSource(DataSource):
             # global COO (collective under multihost — eval is not the
             # memory-bound path training is)
             ratings = ratings.to_coo()
-        inv_u = user_ids.inverse
-        inv_i = item_ids.inverse
+        # dense inverse-lookup arrays: at ML-20M scale a fold holds ~10M
+        # test entries, and per-entry dict lookups + numpy-scalar
+        # unboxing in a Python loop cost minutes on one core — the
+        # grouping below is numpy lexsort + slicing instead
+        inv_u_arr = np.empty(ratings.n_users, dtype=object)
+        for s, j in user_ids.items():
+            inv_u_arr[j] = s
+        inv_i_arr = np.empty(ratings.n_items, dtype=object)
+        for s, j in item_ids.items():
+            inv_i_arr[j] = s
         folds = []
         for f, (train_mask, test_mask) in enumerate(
                 kfold_split(len(ratings.users), p.eval_k, p.seed)):
@@ -180,14 +188,23 @@ class RecommendationDataSource(DataSource):
                            ratings.ratings[train_mask],
                            ratings.n_users, ratings.n_items),
                 user_ids, item_ids)
-            held: dict = {}
-            for u, i, r in zip(ratings.users[test_mask],
-                               ratings.items[test_mask],
-                               ratings.ratings[test_mask]):
-                held.setdefault(int(u), []).append((inv_i[int(i)], float(r)))
-            qa = [(Query(user=inv_u[u], num=p.eval_query_num),
-                   ActualResult(tuple(pairs)))
-                  for u, pairs in sorted(held.items())]
+            te_u = ratings.users[test_mask]
+            order = np.lexsort((np.arange(len(te_u)), te_u))
+            u_s = te_u[order]
+            i_names = inv_i_arr[ratings.items[test_mask][order]]
+            r_s = ratings.ratings[test_mask][order].astype(float)
+            starts = np.flatnonzero(
+                np.r_[True, u_s[1:] != u_s[:-1]]) if len(u_s) else \
+                np.empty(0, np.int64)
+            bounds = np.r_[starts, len(u_s)]
+            qa = []
+            for b in range(len(starts)):
+                lo, hi = bounds[b], bounds[b + 1]
+                qa.append((
+                    Query(user=inv_u_arr[u_s[lo]],
+                          num=p.eval_query_num),
+                    ActualResult(tuple(zip(i_names[lo:hi].tolist(),
+                                           r_s[lo:hi].tolist())))))
             folds.append((td, EvalInfo(fold=f,
                                        rating_threshold=p.eval_rating_threshold),
                           qa))
