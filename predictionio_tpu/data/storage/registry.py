@@ -129,6 +129,29 @@ def _register_builtins() -> None:
         },
         close=lambda c: c.close()))
 
+    from . import objectstore
+
+    # "s3" and "gcs" are one backend: both stores speak the same REST
+    # subset (the GCS XML API is S3-compatible); reference roles:
+    # storage/s3/.../S3Models.scala, storage/hdfs/.../HDFSModels.scala
+    for _name in ("S3", "GCS", "OBJECTSTORE"):
+        register_backend(_name, Backend(
+            make_client=lambda cfg:
+                objectstore.ObjectStoreClient.from_config(cfg),
+            daos={
+                "events": lambda c: objectstore.ObjectStoreEventStore(c),
+                "apps": lambda c: objectstore.ObjectStoreApps(c),
+                "access_keys":
+                    lambda c: objectstore.ObjectStoreAccessKeys(c),
+                "channels": lambda c: objectstore.ObjectStoreChannels(c),
+                "engine_instances":
+                    lambda c: objectstore.ObjectStoreEngineInstances(c),
+                "evaluation_instances":
+                    lambda c: objectstore.ObjectStoreEvaluationInstances(c),
+                "models": lambda c: objectstore.ObjectStoreModels(c),
+            },
+            close=lambda c: c.close()))
+
 
 _register_builtins()
 

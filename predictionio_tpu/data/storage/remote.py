@@ -220,6 +220,26 @@ class RemoteEventStore(EventStore):
                     for raw in pieces:
                         s = raw.strip()
                         if s.startswith(b"{"):
+                            if b'"eventId"' in s:
+                                # an explicit "eventId": null would
+                                # override the spliced id (duplicate-
+                                # key last-wins) and make the server
+                                # mint fresh random ids on every
+                                # transport replay — drop the null key
+                                # so the splice governs (ADVICE r4);
+                                # only lines carrying the substring pay
+                                # the parse
+                                try:
+                                    obj = json.loads(s)
+                                    if isinstance(obj, dict) and \
+                                            obj.get("eventId",
+                                                    "") is None:
+                                        del obj["eventId"]
+                                        s = json.dumps(
+                                            obj, ensure_ascii=False
+                                        ).encode("utf-8")
+                                except ValueError:
+                                    pass  # malformed: server reports
                             rest = s[1:].lstrip()
                             eid = new_event_id().encode()
                             sep = b'"' if rest.startswith(b"}") \

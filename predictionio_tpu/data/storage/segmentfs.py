@@ -248,7 +248,12 @@ class SegmentFSEventStore(EventStore):
         lane declines (exotic ISO forms, non-string optional fields,
         validation failures that must raise the canonical message)
         re-runs through the Python path, preserving event order and
-        error behavior exactly."""
+        error behavior exactly — with ONE documented divergence: the
+        native lane stamps a single ``utcnow()`` per block as the
+        default eventTime/creationTime for events missing them (the
+        Python lanes stamp per event), so default timestamps are
+        block-identical here and a block that falls back mid-import
+        gets per-event times instead."""
         from ...native import codec as _native_codec
 
         mod = _native_codec()
@@ -304,8 +309,15 @@ class SegmentFSEventStore(EventStore):
         rel = 0            # lines consumed within this block
         committed_rel = 0  # lines fully committed within this block
         total_rel = 0
+        # split on \n ONLY (remote.py's rule): splitlines() also cuts
+        # on lone \r / \x0b / \x1c..., which would import one physical
+        # line as two events and shift resume linenos vs the \n-only
+        # accounting of iter_jsonl_blocks (ADVICE r4)
+        pieces = buf.split(b"\n")
+        if pieces and pieces[-1] == b"":
+            pieces.pop()  # trailing newline, not a blank line
         try:
-            for raw in buf.splitlines():
+            for raw in pieces:
                 rel += 1
                 s = raw.decode("utf-8").strip()
                 if s:

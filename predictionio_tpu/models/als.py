@@ -1304,6 +1304,72 @@ def _serve_topk(user_factors: jax.Array, item_factors: jax.Array,
     return _topk_scores(vecs, item_factors, k=k, n_items=n_items)
 
 
+def recommend_batch_sharded(user_factors, item_factors,
+                            user_indices: np.ndarray, k: int,
+                            mesh: Mesh, n_items: int
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Serving top-k over a device mesh — the multi-chip form of the
+    reference's serving moment (``CreateServer.scala:508-510``): item
+    factors ROW-SHARDED over every mesh device (a pod-scale catalog
+    never lives on one chip), the query batch replicated. Each device
+    ranks its item shard locally ([B, n_local] matmul + local top_k),
+    then the per-shard candidates are all-gathered and reduced to the
+    global top-k — O(k·n_dev) gathered instead of O(n_items).
+
+    Exact vs the single-device path for distinct scores (ties resolve
+    by shard order rather than global index; float scores make exact
+    ties measure-zero). Returns host (ids, scores) of shape [B, k].
+    """
+    from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.devices.size
+    n_pad = item_factors.shape[0]
+    if n_pad % n_dev:
+        raise ValueError(f"item rows {n_pad} not divisible by mesh size "
+                         f"{n_dev}; pad factors to a device multiple")
+    k_local = min(k, n_pad // n_dev)
+    ranked = _sharded_rank_fn(mesh, k, k_local, n_items)
+    idx = jnp.asarray(np.asarray(user_indices, dtype=np.int64))
+    ids, scores = ranked(jnp.asarray(user_factors),
+                         jnp.asarray(item_factors), idx)
+    kk = min(k, n_items)
+    ids, scores = jax.device_get((ids, scores))
+    return ids[:, :kk], scores[:, :kk]
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_rank_fn(mesh: Mesh, k: int, k_local: int, n_items: int):
+    """Compile-once cache for the sharded serving program (a fresh
+    closure per call would defeat the jit cache and recompile the mesh
+    program on every serving batch). Keyed on (mesh, k, k_local,
+    n_items); shapes key the inner jit cache as usual."""
+    from jax.experimental.shard_map import shard_map
+
+    def local_rank(uf, itf_local, idx):
+        vecs = uf[idx]                       # [B, r] (replicated)
+        scores = vecs @ itf_local.T          # [B, n_local]
+        shard = jax.lax.axis_index(("data", "model"))
+        base = shard * itf_local.shape[0]
+        local_ids = base + jnp.arange(itf_local.shape[0])
+        scores = jnp.where((local_ids < n_items)[None, :], scores,
+                           -jnp.inf)
+        s, i = jax.lax.top_k(scores, k_local)
+        gid = jnp.take(local_ids, i)
+        # gather the candidate sets along the candidate axis
+        s_all = jax.lax.all_gather(s, ("data", "model"), axis=1,
+                                   tiled=True)  # [B, k_local*n_dev]
+        g_all = jax.lax.all_gather(gid, ("data", "model"), axis=1,
+                                   tiled=True)
+        s2, pos = jax.lax.top_k(s_all, s_all.shape[1])
+        return jnp.take_along_axis(g_all, pos, axis=1)[:, :k], \
+            s2[:, :k]
+
+    return jax.jit(shard_map(
+        local_rank, mesh=mesh,
+        in_specs=(P(), ROWS, P()),
+        out_specs=(P(), P()), check_rep=False))
+
+
 def _compiled_k(k: int, n_items: int) -> int:
     """Bound jit-cache growth on the serving path: the device kernel always
     runs with k rounded up to a power of two (clamped to the catalog), so

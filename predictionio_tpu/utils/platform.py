@@ -28,6 +28,23 @@ def enable_compilation_cache() -> None:
     loc = os.environ.get("PIO_COMPILE_CACHE", "")
     if loc.lower() in ("off", "0", "none", "disabled"):
         return
+    # CPU compiles are fast and XLA:CPU AOT executables embed host
+    # machine features (observed: a cached +prefer-no-gather binary
+    # warns/risks SIGILL on a host without it) — the cache only pays
+    # on accelerator backends, where a program costs 20-40s through a
+    # remote-compile tunnel. Check the RESOLVED backend, not just the
+    # env var: a host with no accelerator auto-selects CPU with the
+    # env unset. (Callers reach here right before device use, so the
+    # backend init this forces is work they were about to do anyway.)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return
+    except Exception:  # noqa: BLE001 — backend probe failed: no cache
+        return
     if not loc:
         home = os.environ.get("PIO_HOME", "")
         loc = (os.path.join(home, "compile_cache") if home else

@@ -71,6 +71,12 @@ WRITER = textwrap.dedent("""
                     "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
                     "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
                     "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET"}
+        if backend == "s3":
+            return {"PIO_STORAGE_SOURCES_OBJ_TYPE": "s3",
+                    "PIO_STORAGE_SOURCES_OBJ_ENDPOINT": root,  # url
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "OBJ",
+                    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "OBJ",
+                    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "OBJ"}
         raise SystemExit(f"unknown backend {backend}")
 
     es = Storage(env=env_for(backend, root)).events()
@@ -129,6 +135,11 @@ def _storage_for(backend, root):
                       "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SEG",
                       "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SEG",
                       "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SEG"},
+        "s3": {"PIO_STORAGE_SOURCES_OBJ_TYPE": "s3",
+               "PIO_STORAGE_SOURCES_OBJ_ENDPOINT": root,
+               "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "OBJ",
+               "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "OBJ",
+               "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "OBJ"},
     }[backend]
     return Storage(env=env)
 
@@ -195,6 +206,28 @@ def test_kill_writer_midbatch(backend, tmp_path):
     # failing kill-timing window must be reproducible from the seed
     _run_killer_rounds(backend, str(tmp_path / "store"), tmp_path,
                        seed=zlib.crc32(backend.encode()))
+
+
+def test_kill_writer_midbatch_objectstore(tmp_path):
+    """The S3-contract backend joins the kill fuzzer: the fake object
+    store runs in THIS process (it survives; the killed party is the
+    writer/client, as when a pod host dies mid-upload), and one batch =
+    one immutable object PUT = per-object atomicity carries the
+    all-or-nothing contract."""
+    import zlib
+
+    from predictionio_tpu.data.storage.objectstore import (
+        FakeObjectStoreServer,
+    )
+
+    srv = FakeObjectStoreServer(str(tmp_path / "bucket"))
+    srv.start_background()
+    try:
+        _run_killer_rounds(
+            "s3", f"http://127.0.0.1:{srv.port}/bucket", tmp_path,
+            seed=zlib.crc32(b"s3"))
+    finally:
+        srv.shutdown()
 
 
 def test_kill_storage_server_between_insert_and_response(tmp_path):

@@ -1,0 +1,132 @@
+"""S3-contract object-store backend: client, DAOs, registry wiring.
+
+The event-store contract is covered by the cross-backend fuzzer
+(test_storage_fuzz) and the kill fuzzer (test_crash_fuzz); this file
+covers the rest of the backend: the S3 REST subset itself (list
+pagination, etags), metadata DAOs, the Models role
+(``storage/s3/.../S3Models.scala``), and a full Storage environment
+over the bucket.
+"""
+
+import pytest
+
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    Model,
+)
+from predictionio_tpu.data.storage.objectstore import (
+    FakeObjectStoreServer,
+    ObjectStoreClient,
+)
+
+
+@pytest.fixture
+def bucket(tmp_path):
+    srv = FakeObjectStoreServer(str(tmp_path / "bucket"))
+    srv.start_background()
+    yield ObjectStoreClient(f"http://127.0.0.1:{srv.port}/bucket")
+    srv.shutdown()
+
+
+class TestClient:
+    def test_put_get_delete_roundtrip(self, bucket):
+        assert bucket.get("a/b") is None
+        etag = bucket.put("a/b", b"hello")
+        assert etag
+        assert bucket.get("a/b") == b"hello"
+        bucket.delete("a/b")
+        assert bucket.get("a/b") is None
+
+    def test_list_prefix_order_and_pagination(self, bucket):
+        for i in range(7):
+            bucket.put(f"p/{i:03d}", bytes([i]))
+        bucket.put("q/x", b"z")
+        keys = list(bucket.list("p/"))
+        assert keys == [f"p/{i:03d}" for i in range(7)]
+        # marker pagination: force tiny pages through the raw API
+        status, body, _ = bucket._request(
+            "GET", f"{bucket.bucket_path}?prefix=p/&max-keys=3")
+        assert status == 200 and b"true" in body.lower()
+
+    def test_binary_and_unicode_keys(self, bucket):
+        data = bytes(range(256))
+        bucket.put("models/étag id", data)
+        assert bucket.get("models/étag id") == data
+
+
+class TestStorageEnvironment:
+    def test_full_backend_verifies_and_roundtrips(self, bucket):
+        s = Storage(env={
+            "PIO_STORAGE_SOURCES_OBJ_TYPE": "s3",
+            "PIO_STORAGE_SOURCES_OBJ_ENDPOINT": bucket.endpoint,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "OBJ",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "OBJ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "OBJ",
+        })
+        s.verify_all_data_objects()
+        aid = s.apps().insert(App(0, "bucketapp"))
+        assert aid and s.apps().get_by_name("bucketapp").id == aid
+        key = s.access_keys().insert(AccessKey("", aid, ["rate"]))
+        assert s.access_keys().get(key).app_id == aid
+        cid = s.channels().insert(Channel(0, "live", aid))
+        assert cid in [c.id for c in s.channels().get_by_app_id(aid)]
+        s.models().insert(Model(id="m1", models=b"\x00\x01blob"))
+        assert s.models().get("m1").models == b"\x00\x01blob"
+        s.models().delete("m1")
+        assert s.models().get("m1") is None
+
+    def test_engine_instances_latest_completed(self, bucket):
+        from datetime import datetime, timedelta, timezone
+
+        from predictionio_tpu.data.storage.base import (
+            STATUS_COMPLETED,
+            EngineInstance,
+        )
+
+        s = Storage(env={
+            "PIO_STORAGE_SOURCES_OBJ_TYPE": "s3",
+            "PIO_STORAGE_SOURCES_OBJ_ENDPOINT": bucket.endpoint,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "OBJ",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "OBJ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "OBJ",
+        })
+        t = datetime(2026, 5, 1, tzinfo=timezone.utc)
+        dao = s.engine_instances()
+        ids = []
+        for j in range(3):
+            ids.append(dao.insert(EngineInstance(
+                id="", status=STATUS_COMPLETED,
+                start_time=t + timedelta(hours=j),
+                end_time=t + timedelta(hours=j, minutes=5),
+                engine_id="e", engine_version="1",
+                engine_variant="v.json", engine_factory="f")))
+        latest = dao.get_latest_completed("e", "1", "v.json")
+        assert latest.id == ids[-1]
+        got = dao.get(ids[0])
+        dao.update(got.copy(status="INIT"))
+        assert len(dao.get_completed("e", "1", "v.json")) == 2
+
+
+class TestDurability:
+    def test_reopen_fresh_client_sees_state(self, tmp_path):
+        root = str(tmp_path / "bucket")
+        srv = FakeObjectStoreServer(root)
+        srv.start_background()
+        url = f"http://127.0.0.1:{srv.port}/bucket"
+        c1 = ObjectStoreClient(url)
+        c1.put("models/m", b"abc")
+        c1.write_doc("apps", [{"id": 1, "name": "a",
+                               "description": None}])
+        c1.close()
+        srv.shutdown()
+        # a NEW server over the same directory (host restart)
+        srv2 = FakeObjectStoreServer(root)
+        srv2.start_background()
+        c2 = ObjectStoreClient(f"http://127.0.0.1:{srv2.port}/bucket")
+        assert c2.get("models/m") == b"abc"
+        assert c2.read_doc("apps", [])[0]["name"] == "a"
+        c2.close()
+        srv2.shutdown()

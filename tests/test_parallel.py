@@ -86,3 +86,48 @@ class TestMultihost:
         x = np.arange(10)
         # single process: the shard is the whole array
         np.testing.assert_array_equal(host_shard(x), x)
+
+
+class TestShardedServing:
+    """recommend_batch_sharded vs the single-device serving dispatch
+    (the multi-chip serving moment, ``CreateServer.scala:508-510``)."""
+
+    def test_matches_single_device(self):
+        import numpy as np
+
+        from predictionio_tpu.models.als import (
+            _serve_topk,
+            recommend_batch_sharded,
+        )
+
+        mesh = make_mesh(data=4, model=2)
+        rng = np.random.default_rng(0)
+        n_items, n_pad, r = 101, 104, 16
+        V = rng.standard_normal((n_pad, r)).astype(np.float32)
+        U = rng.standard_normal((40, r)).astype(np.float32)
+        idx = rng.integers(0, 40, 7)
+        ids, scores = recommend_batch_sharded(U, V, idx, 10, mesh,
+                                              n_items)
+        s1, i1 = _serve_topk(jnp.asarray(U), jnp.asarray(V),
+                             jnp.asarray(idx), k=10, n_items=n_items)
+        np.testing.assert_array_equal(ids, np.asarray(i1))
+        np.testing.assert_allclose(scores, np.asarray(s1), rtol=1e-5)
+
+    def test_k_exceeding_local_shard(self):
+        import numpy as np
+
+        from predictionio_tpu.models.als import (
+            _serve_topk,
+            recommend_batch_sharded,
+        )
+
+        mesh = make_mesh(data=8, model=1)
+        rng = np.random.default_rng(1)
+        n_pad, r = 16, 8  # 2 items per shard, k=6 > local 2
+        V = rng.standard_normal((n_pad, r)).astype(np.float32)
+        U = rng.standard_normal((5, r)).astype(np.float32)
+        idx = np.arange(5)
+        ids, scores = recommend_batch_sharded(U, V, idx, 6, mesh, 13)
+        s1, i1 = _serve_topk(jnp.asarray(U), jnp.asarray(V),
+                             jnp.asarray(idx), k=6, n_items=13)
+        np.testing.assert_array_equal(ids, np.asarray(i1))

@@ -23,8 +23,20 @@ def proj(e):
             tuple(sorted(e.properties.to_dict().items())))
 
 
-@pytest.fixture(params=["sqlite", "localfs", "segmentfs", "remote"])
+@pytest.fixture(params=["sqlite", "localfs", "segmentfs", "remote", "s3"])
 def dut(request, tmp_path):
+    if request.param == "s3":
+        from predictionio_tpu.data.storage.objectstore import (
+            FakeObjectStoreServer,
+            ObjectStoreClient,
+            ObjectStoreEventStore,
+        )
+        srv = FakeObjectStoreServer(str(tmp_path / "bucket"))
+        srv.start_background()
+        yield ObjectStoreEventStore(ObjectStoreClient(
+            f"http://127.0.0.1:{srv.port}/bucket"))
+        srv.shutdown()
+        return
     if request.param == "remote":
         from conftest import start_sqlite_backed_storage_server
         from predictionio_tpu.data.storage.remote import (
