@@ -150,10 +150,19 @@ def main():
         run_cli(env, "app", "new", "ml20m", tolerate_failure=True)
         run_cli(env, "app", "data-delete", "ml20m", "-f",
                 tolerate_failure=True)
-        _, dt = run_cli(env, "import", "--app", "ml20m",
-                        "--input", str(jsonl))
+        proc, dt = run_cli(env, "import", "--app", "ml20m",
+                           "--input", str(jsonl))
         result["import_s"] = round(dt, 1)
-        result["import_ev_per_s"] = round(len(users) / dt, 1)
+        # `ptpu import` now also builds the columnar sidecar (the
+        # one-time encode the first train used to pay); report the
+        # split so the ingest rate stays comparable across rounds
+        warm_s = 0.0
+        for line in proc.stdout.splitlines():
+            if line.startswith("Columnar sidecar ready ("):
+                warm_s = float(line.split("(")[1].split("s")[0])
+        result["import_columnar_warm_s"] = round(warm_s, 1)
+        result["import_ev_per_s"] = round(
+            len(users) / max(dt - warm_s, 1e-9), 1)
         marker.write_text("ok")
         checkpoint_result()
 
@@ -198,6 +207,15 @@ def main():
         proc, dt = run_cli(env, "train", "--engine-json", str(ej))
         result["train2_s"] = round(dt, 1)
         result["train2_stages"] = parse_stages(proc.stdout)
+        # the device tunnel's dispatch/load time varies run to run
+        # (host stages are stable — see the per-stage breakdowns); a
+        # >20% spread gets a third sample so the artifact shows the
+        # distribution, not two draws
+        t1, t2 = result["train_s"], result["train2_s"]
+        if abs(t1 - t2) / max(min(t1, t2), 1e-9) > 0.2:
+            proc, dt = run_cli(env, "train", "--engine-json", str(ej))
+            result["train3_s"] = round(dt, 1)
+            result["train3_stages"] = parse_stages(proc.stdout)
     checkpoint_result()
 
     # --- eval: shipped Precision@K grid + NDCG@10, k-fold, through

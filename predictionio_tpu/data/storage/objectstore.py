@@ -94,8 +94,6 @@ class ObjectStoreClient:
         self.timeout = timeout
         self._local = threading.local()
         self.lock = threading.RLock()
-        #: immutable-object content cache (event segments only)
-        self.blob_cache: Dict[str, bytes] = {}
 
     @staticmethod
     def from_config(cfg: dict) -> "ObjectStoreClient":
@@ -239,7 +237,6 @@ class ObjectStoreEventStore(EventStore):
             found = False
             for key in list(self.c.list(prefix)):
                 self.c.delete(key)
-                self.c.blob_cache.pop(key, None)
                 found = True
         return found
 
@@ -276,8 +273,21 @@ class ObjectStoreEventStore(EventStore):
         with self.c.lock:
             # ONE PUT per batch: the object store's per-object atomicity
             # IS the all-or-nothing insert_batch crash contract
-            self.c.put(self._seg_key(prefix), payload)
-            self._state_cache.pop(prefix, None)
+            key = self._seg_key(prefix)
+            self.c.put(key, payload)
+            # extend the cached state in place (our time-ordered key
+            # sorts after everything we had applied) instead of
+            # popping it — a pop made every read after a write replay
+            # the WHOLE log (O(N²) for interleaved write/read). If a
+            # concurrent writer interleaved a key we haven't seen,
+            # _replay's listing-prefix check catches it and does the
+            # full replay anyway.
+            cached = self._state_cache.get(prefix)
+            if cached is not None:
+                live = cached[1]
+                for s in stored:
+                    live[s.event_id] = s
+                self._state_cache[prefix] = (cached[0] + (key,), live)
         return [s.event_id for s in stored]
 
     def _replay(self, app_id: int, channel_id: Optional[int],
@@ -299,12 +309,13 @@ class ObjectStoreEventStore(EventStore):
                         and time.monotonic() > deadline:
                     raise TimeoutError(
                         "event replay exceeded its deadline")
-                blob = self.c.blob_cache.get(key)
-                if blob is None:
-                    blob = self.c.get(key)
-                    if blob is None:  # deleted under us (remove race)
-                        continue
-                    self.c.blob_cache[key] = blob
+                # no raw-blob cache: each object is fetched once,
+                # folded into the live dict, and dropped — the full
+                # log must not live in RAM twice (a re-replay after a
+                # non-append change refetches, which is rare)
+                blob = self.c.get(key)
+                if blob is None:  # deleted under us (remove race)
+                    continue
                 for line in blob.splitlines():
                     if not line.strip():
                         continue
@@ -333,14 +344,20 @@ class ObjectStoreEventStore(EventStore):
                 return False
             payload = (json.dumps({"op": "del", "eventId": event_id})
                        + "\n").encode("utf-8")
-            self.c.put(self._seg_key(prefix), payload)
-            self._state_cache.pop(prefix, None)
+            key = self._seg_key(prefix)
+            self.c.put(key, payload)
+            cached = self._state_cache.get(prefix)
+            if cached is not None:  # in-place, like insert_batch
+                cached[1].pop(event_id, None)
+                self._state_cache[prefix] = (cached[0] + (key,),
+                                             cached[1])
             return True
 
     def find(self, app_id: int, channel_id: Optional[int] = None,
              filter: EventFilter = EventFilter()) -> Iterator[Event]:
-        events = list(self._replay(app_id, channel_id,
-                                   filter.deadline).values())
+        with self.c.lock:  # snapshot: inserts mutate the live dict
+            events = list(self._replay(app_id, channel_id,
+                                       filter.deadline).values())
         events = list(filter.apply(events))
         events.sort(key=lambda e: e.event_time_millis,
                     reverse=filter.reversed)

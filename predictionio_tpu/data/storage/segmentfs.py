@@ -469,6 +469,14 @@ class SegmentFSEventStore(EventStore):
     def _columnar_dir(self, d: str) -> str:
         return os.path.join(d, "columnar")
 
+    def warm_columnar(self, app_id: int,
+                      channel_id: Optional[int] = None) -> bool:
+        # encode persists ALL columns; want_props=False just skips
+        # loading the property bytes into this process
+        self._sync_columnar(app_id, channel_id, ("rating",),
+                            want_props=False)
+        return True
+
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       filter: EventFilter = EventFilter(),
                       float_props: Sequence[str] = ("rating",),

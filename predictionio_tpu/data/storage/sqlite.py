@@ -300,6 +300,15 @@ class SQLiteEventStore(EventStore):
                 raise
         return row[0] if row else None
 
+    def warm_columnar(self, app_id: int,
+                      channel_id: Optional[int] = None) -> bool:
+        d = self._columnar_dir(app_id, channel_id)
+        if d is None:  # :memory: database — nothing persistent to warm
+            return False
+        self._sync_columnar(d, app_id, channel_id, ("rating",),
+                            want_props=False)
+        return True
+
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       filter: EventFilter = EventFilter(),
                       float_props=("rating",),

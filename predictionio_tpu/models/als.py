@@ -1320,8 +1320,6 @@ def recommend_batch_sharded(user_factors, item_factors,
     by shard order rather than global index; float scores make exact
     ties measure-zero). Returns host (ids, scores) of shape [B, k].
     """
-    from jax.experimental.shard_map import shard_map
-
     n_dev = mesh.devices.size
     n_pad = item_factors.shape[0]
     if n_pad % n_dev:
