@@ -307,7 +307,11 @@ def cmd_deploy(args, storage: Storage) -> int:
     config = ServerConfig(
         feedback=args.feedback,
         feedback_app_name=args.feedback_app_name or None,
-        accesskey=args.accesskey or None)
+        accesskey=args.accesskey or None,
+        batching=args.batching,
+        max_batch=args.max_batch,
+        batch_window_ms=args.batch_window_ms,
+        batch_pipeline=args.batch_pipeline)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -903,6 +907,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--accesskey", default="")
     s.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
     s.add_argument("--key", default="", help="PEM private key")
+    s.add_argument("--batching", action="store_true",
+                   help="coalesce concurrent queries into batched "
+                        "device dispatches (the serving micro-batcher)")
+    s.add_argument("--max-batch", type=int, default=128,
+                   help="max queries per coalesced dispatch")
+    s.add_argument("--batch-window-ms", type=float, default=2.0,
+                   help="wait for a lone query before serving it solo")
+    s.add_argument("--batch-pipeline", type=int, default=4,
+                   help="concurrent batch dispatches in flight")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")

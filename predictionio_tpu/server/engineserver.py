@@ -65,7 +65,10 @@ class ServerConfig:
     #: per-request, ``CreateServer.scala:507-510`` "TODO: Parallelize").
     batching: bool = False
     batch_window_ms: float = 2.0   # max wait for a batch to fill
-    max_batch: int = 64
+    #: measured sweet spot at 256-way burst on a tunneled v5e (the
+    #: bench battery's winning config; `ptpu deploy --max-batch`
+    #: shares this default)
+    max_batch: int = 128
     #: Concurrent batch dispatches in flight. Through a remote-device
     #: tunnel the dispatch round trip (~80-170ms) dwarfs device compute;
     #: one drainer leaves the link idle while a batch is in flight
