@@ -278,9 +278,11 @@ def main():
         cands = cands_override or [(gm, gd) for gm in gram_cands
                                    for gd in gather_cands]
         # normalize to (gram, gather, block_rows); rank 128 adds the
-        # small-blocks candidate — block_rows=1024 both survives the
-        # remote-compile helper AND measured FASTER than the auto
-        # tiling (31.7M vs 27.4M ratings/s/iter full-size)
+        # small-blocks candidate: block_rows=1024 is the one config
+        # that reliably COMPILES the full-size program through the
+        # remote helper (auto tiling usually 500s), and it wins the
+        # race when auto-tiled candidates do survive (32.3M vs 27.4M
+        # ratings/s/iter in BENCH_LASTGOOD)
         cands = [c if len(c) == 3 else (*c, block_rows) for c in cands]
         if rank_r == 128 and cands_override is None \
                 and gram_mode == "auto" \
@@ -387,10 +389,10 @@ def main():
             # the tunnel's remote-compile helper dies on the FULL-size
             # rank-128 program at the auto-tiled block size — but
             # block_rows=1024 shrinks the per-block tensors enough to
-            # compile AND runs FASTER than the 8M subsample (measured:
-            # 31.7M ratings/s/iter, 3.17 TF/s einsum/bf16 full-size vs
-            # 27.3M on the subsample). Try that first; subsample only
-            # if even the small blocks fail.
+            # compile AND runs FASTER than the 8M subsample (measured
+            # 32.3M ratings/s/iter full-size vs 27.3M subsampled).
+            # Try that first; subsample only if even the small blocks
+            # fail.
             fb_gather = "bfloat16" \
                 if gather_env in ("auto", "bfloat16") else gather_env
             fb_gram = "einsum" if gram_mode == "auto" else gram_mode

@@ -907,6 +907,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--accesskey", default="")
     s.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
     s.add_argument("--key", default="", help="PEM private key")
+    # literals, NOT `ServerConfig.<field>`: importing the server stack
+    # here would pull jax into every storage-only CLI command. The
+    # values are asserted equal to ServerConfig's defaults by
+    # tests/test_cli.py::test_deploy_batching_defaults_match_config.
     s.add_argument("--batching", action="store_true",
                    help="coalesce concurrent queries into batched "
                         "device dispatches (the serving micro-batcher)")

@@ -455,7 +455,7 @@ class MicroBatcher:
     """
 
     def __init__(self, server: QueryServer, window_ms: float = 2.0,
-                 max_batch: int = 64, pipeline: int = 4):
+                 max_batch: int = 128, pipeline: int = 4):
         import queue
 
         self.server = server

@@ -432,3 +432,22 @@ class TestStartStopAll:
             except ProcessLookupError:
                 alive = False
             assert not alive, f"{n} pid {pid} survived stop-all"
+
+
+def test_deploy_batching_defaults_match_config():
+    """`ptpu deploy`'s batching flag defaults must equal ServerConfig's
+    field defaults (the CLI uses literals so storage-only commands
+    never import the server stack / jax — this test is the sync)."""
+    from predictionio_tpu.cli import build_parser
+    from predictionio_tpu.server.engineserver import MicroBatcher, ServerConfig
+
+    args = build_parser().parse_args(
+        ["deploy", "--engine-json", "engine.json"])
+    cfg = ServerConfig()
+    assert args.max_batch == cfg.max_batch
+    assert args.batch_window_ms == cfg.batch_window_ms
+    assert args.batch_pipeline == cfg.batch_pipeline
+    import inspect
+
+    sig = inspect.signature(MicroBatcher.__init__)
+    assert sig.parameters["max_batch"].default == cfg.max_batch
