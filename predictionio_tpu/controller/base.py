@@ -132,6 +132,15 @@ class Algorithm(abc.ABC, Generic[PD, M, Q, P]):
         template grabs ``ctx.event_store`` so its realtime filter reads hit
         the deployed storage, not the process-global default. No-op here."""
 
+    def prepare_serving_model(self, model: M, max_batch: int = 1) -> M:
+        """Called once per model when it binds to a serving surface
+        (engine server bind/reload, batch predict) with the largest
+        batch that surface coalesces. Override to fix the model's
+        device placement — e.g. the recommendation template moves
+        re-materialized factor matrices into HBM so the serving jits
+        don't re-transfer host arrays on every query. Identity here."""
+        return model
+
     def load_persistent_model(self, ctx: Context, stored: Any) -> M:
         """Invert :meth:`make_persistent_model` at deploy time."""
         from ..workflow.persistence import to_device

@@ -1417,6 +1417,31 @@ def _serve_on_host(model: ALSModel, batch: int) -> bool:
             and model.item_factors.size * max(batch, 1) <= HOST_SERVE_WORK)
 
 
+def ensure_device_resident(model: ALSModel,
+                           max_batch: int = 1) -> ALSModel:
+    """Deploy-time factor placement: models past the host-serving
+    budget move into HBM ONCE. A deployed model re-materialized from
+    the blob store holds numpy factors, and the serving jits would
+    otherwise re-transfer them on EVERY query (~42MB per query at
+    ML-20M scale — fatal through a tunneled device). Small catalogs
+    stay host-resident for the host fast path. ``max_batch`` is the
+    largest serving batch this surface coalesces (the micro-batcher's
+    cap, batch-predict's flush size): a mid-size catalog under the
+    batch-1 budget but over the batched one serves on the DEVICE for
+    big batches, so it must be device-resident too."""
+    import dataclasses
+
+    if _serve_on_host(model, batch=max(max_batch, 1)):
+        return model
+    if isinstance(model.user_factors, np.ndarray) \
+            or isinstance(model.item_factors, np.ndarray):
+        return dataclasses.replace(
+            model,
+            user_factors=jax.device_put(model.user_factors),
+            item_factors=jax.device_put(model.item_factors))
+    return model
+
+
 def recommend_products(model: ALSModel, user_index: int, k: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k (item_index, score) for one user — the

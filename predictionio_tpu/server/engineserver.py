@@ -154,11 +154,16 @@ class QueryServer:
               instance: EngineInstance) -> None:
         with self._lock:
             self.engine_params = engine_params
-            self.models = models
             self.instance = instance
             self.algorithms = self.engine.make_algorithms(engine_params)
             for algo in self.algorithms:
                 algo.bind_serving(self.ctx)
+            # fix device placement ONCE at bind (deploy/reload), not
+            # per query — a re-materialized model holds numpy factors
+            bind_batch = self.config.max_batch if self.config.batching \
+                else 1
+            self.models = [a.prepare_serving_model(m, bind_batch)
+                           for a, m in zip(self.algorithms, models)]
             self.serving = self.engine.make_serving(engine_params)
 
     # -- batched hot path ---------------------------------------------------

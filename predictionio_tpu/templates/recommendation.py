@@ -249,6 +249,12 @@ class ALSAlgorithm(Algorithm):
         return PredictedResult(tuple(
             ItemScore(item=inv[i], score=s) for i, s in out))
 
+    def prepare_serving_model(self, model: ALSModel,
+                              max_batch: int = 1) -> ALSModel:
+        from ..models.als import ensure_device_resident
+
+        return ensure_device_resident(model, max_batch)
+
     def warm_serving(self, model: ALSModel, max_batch: int = 1) -> None:
         """Pre-compile the serving device kernels for the single-query
         path and every pow2 batch size the micro-batcher can produce

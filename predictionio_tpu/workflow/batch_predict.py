@@ -66,6 +66,10 @@ def batch_predict_lines(engine: Engine,
     if ctx is not None:
         for algo in algorithms:
             algo.bind_serving(ctx)
+    # same placement fix as the engine server's bind: device-resident
+    # factors once, not a host re-transfer per flushed batch
+    models = [a.prepare_serving_model(m, batch_size)
+              for a, m in zip(algorithms, models)]
     serving = engine.make_serving(engine_params)
     query_cls = algorithms[0].query_class
 

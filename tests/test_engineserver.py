@@ -403,3 +403,67 @@ class TestServingWarmup:
         qs2 = QueryServer(ctx, engine, ep, models, inst,
                           ServerConfig(warm_start=False))
         assert qs2.warm_done.is_set()
+
+
+def _make_server(models, cfg):
+    """Minimal real QueryServer over a synthetic COMPLETED instance."""
+    from predictionio_tpu.data.storage.base import (
+        STATUS_COMPLETED,
+        EngineInstance,
+    )
+    from predictionio_tpu.server.engineserver import QueryServer
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "resid"))
+    ctx = Context(app_name="resid", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="r", status=STATUS_COMPLETED, start_time=now, end_time=now,
+        engine_id="r", engine_version="1", engine_variant="e.json",
+        engine_factory="f")
+    return QueryServer(ctx, recommendation_engine(),
+                       default_engine_params("resid", rank=8), models,
+                       inst, cfg)
+
+
+def test_bind_makes_large_model_device_resident(monkeypatch):
+    """A re-materialized (numpy) model past HOST_SERVE_WORK must move
+    to the device ONCE at bind — through the REAL QueryServer._bind ->
+    prepare_serving_model wiring, not just the helper. Budget is
+    monkeypatched tiny so the test model stays a few KB."""
+    import numpy as np
+
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models import als as als_mod
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+
+    monkeypatch.setattr(als_mod, "HOST_SERVE_WORK", 1024)
+
+    rank = 8
+    def mk(n_items):
+        return ALSModel(
+            user_factors=np.zeros((4, rank), np.float32),
+            item_factors=np.zeros((n_items, rank), np.float32),
+            n_users=4, n_items=n_items,
+            user_ids=BiMap({f"u{i}": i for i in range(4)}),
+            item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+            params=ALSParams(rank=rank))
+
+    big = mk(1024 // rank + 8)     # past the (patched) batch-1 budget
+    qs = _make_server([big], ServerConfig(warm_start=False))
+    assert not isinstance(qs.models[0].item_factors, np.ndarray)
+
+    small = mk(8)                  # host fast path stays host-resident
+    qs2 = _make_server([small], ServerConfig(warm_start=False))
+    assert isinstance(qs2.models[0].item_factors, np.ndarray)
+
+    # batched binds use the BATCHED budget: the same small model past
+    # max_batch * size must go to the device
+    qs3 = _make_server([small], ServerConfig(warm_start=False,
+                                             batching=True,
+                                             max_batch=64))
+    assert not isinstance(qs3.models[0].item_factors, np.ndarray)
