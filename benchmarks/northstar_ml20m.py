@@ -234,6 +234,76 @@ def main():
             result["train3_stages"] = parse_stages(proc.stdout)
     checkpoint_result()
 
+    # --- deploy + query: the serving moment through the real CLI
+    # (CreateServer.scala:484-633 role) — load the trained model from
+    # the blob store, bind (device placement happens here), serve real
+    # HTTP queries with the micro-batcher on ---
+    if os.environ.get("NORTHSTAR_DEPLOY", "1") == "1" \
+            and "deploy_query_p50_ms" not in result:
+        import http.client
+        import urllib.request
+
+        port = 8123
+        dp = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli", "deploy",
+             "--engine-json", str(ej), "--ip", "127.0.0.1",
+             "--port", str(port), "--batching"],
+            env=env, cwd=str(REPO), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            t0 = time.monotonic()
+            warm = False
+            while time.monotonic() - t0 < 600:
+                try:
+                    st = json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status.json",
+                        timeout=5).read())
+                    if st.get("servingWarm"):
+                        warm = True
+                        break
+                except Exception:  # noqa: BLE001 — still starting
+                    pass
+                time.sleep(1.0)
+            result["deploy_warm_s"] = round(time.monotonic() - t0, 1)
+            if warm:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                lats = []
+                rng_q = np.random.default_rng(3)
+                for q in rng_q.integers(1, n_users, 60):
+                    body = json.dumps({"user": str(int(q)),
+                                       "num": 10}).encode()
+                    t1 = time.monotonic()
+                    conn.request("POST", "/queries.json", body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    out = json.loads(conn.getresponse().read())
+                    lats.append(time.monotonic() - t1)
+                    if "itemScores" not in out:
+                        result["deploy_query_error"] = str(out)[:200]
+                        break
+                conn.close()
+                if lats:
+                    arr = np.sort(np.asarray(lats[10:] or lats)) * 1e3
+                    result["deploy_query_p50_ms"] = round(
+                        float(np.percentile(arr, 50)), 2)
+                    result["deploy_query_p99_ms"] = round(
+                        float(np.percentile(arr, 99)), 2)
+            else:
+                result["deploy_query_error"] = "warmup timeout"
+        finally:
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/stop", method="POST"),
+                    timeout=10).read()
+            except Exception:  # noqa: BLE001 — kill below regardless
+                pass
+            try:
+                dp.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                dp.kill()
+        checkpoint_result()
+
     # --- eval: shipped Precision@K grid + NDCG@10, k-fold, through
     # ptpu eval on a seeded subsample app (documented --eval-scale) ---
     if args.eval_scale > 0:
