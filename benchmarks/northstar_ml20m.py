@@ -241,19 +241,41 @@ def main():
     if os.environ.get("NORTHSTAR_DEPLOY", "1") == "1" \
             and "deploy_query_p50_ms" not in result:
         import http.client
+        import socket
         import urllib.request
 
-        port = 8123
+        # a resumed run must not carry a stale failure next to fresh
+        # numbers (same rule as the train3 purge above)
+        result.pop("deploy_query_error", None)
+        with socket.socket() as probe:  # a free port, not a guess
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
         dp = subprocess.Popen(
             [sys.executable, "-m", "predictionio_tpu.cli", "deploy",
              "--engine-json", str(ej), "--ip", "127.0.0.1",
              "--port", str(port), "--batching"],
             env=env, cwd=str(REPO), stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL)
+            stderr=subprocess.PIPE, text=True)
+        # drain stderr continuously (an unread PIPE blocks the server
+        # once the buffer fills) but keep the tail for diagnostics
+        import threading
+
+        err_tail: list = [""]
+
+        def _drain():
+            for line in dp.stderr:
+                err_tail[0] = (err_tail[0] + line)[-300:]
+
+        threading.Thread(target=_drain, daemon=True).start()
         try:
             t0 = time.monotonic()
             warm = False
             while time.monotonic() - t0 < 600:
+                if dp.poll() is not None:  # died at startup: fail fast
+                    result["deploy_query_error"] = \
+                        f"deploy exited rc={dp.returncode}: " \
+                        f"{err_tail[0]}"
+                    break
                 try:
                     st = json.loads(urllib.request.urlopen(
                         f"http://127.0.0.1:{port}/status.json",
@@ -266,30 +288,40 @@ def main():
                 time.sleep(1.0)
             result["deploy_warm_s"] = round(time.monotonic() - t0, 1)
             if warm:
-                conn = http.client.HTTPConnection("127.0.0.1", port,
-                                                  timeout=60)
                 lats = []
-                rng_q = np.random.default_rng(3)
-                for q in rng_q.integers(1, n_users, 60):
-                    body = json.dumps({"user": str(int(q)),
-                                       "num": 10}).encode()
-                    t1 = time.monotonic()
-                    conn.request("POST", "/queries.json", body=body,
-                                 headers={"Content-Type":
-                                          "application/json"})
-                    out = json.loads(conn.getresponse().read())
-                    lats.append(time.monotonic() - t1)
-                    if "itemScores" not in out:
-                        result["deploy_query_error"] = str(out)[:200]
-                        break
-                conn.close()
-                if lats:
-                    arr = np.sort(np.asarray(lats[10:] or lats)) * 1e3
+                bad = None
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=60)
+                    rng_q = np.random.default_rng(3)
+                    for q in rng_q.integers(1, n_users, 60):
+                        body = json.dumps({"user": str(int(q)),
+                                           "num": 10}).encode()
+                        t1 = time.monotonic()
+                        conn.request("POST", "/queries.json",
+                                     body=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+                        out = json.loads(conn.getresponse().read())
+                        if "itemScores" not in out:
+                            bad = f"bad response: {str(out)[:200]}"
+                            break
+                        lats.append(time.monotonic() - t1)
+                    conn.close()
+                except Exception as qe:  # noqa: BLE001 — the deploy
+                    # probe must not abort the remaining stages (eval
+                    # still has to run; every other stage tolerates
+                    # failure)
+                    bad = f"{type(qe).__name__}: {str(qe)[:200]}"
+                if bad is not None:
+                    result["deploy_query_error"] = bad
+                elif lats:
+                    arr = np.asarray(lats[10:] or lats) * 1e3
                     result["deploy_query_p50_ms"] = round(
                         float(np.percentile(arr, 50)), 2)
                     result["deploy_query_p99_ms"] = round(
                         float(np.percentile(arr, 99)), 2)
-            else:
+            elif "deploy_query_error" not in result:
                 result["deploy_query_error"] = "warmup timeout"
         finally:
             try:
