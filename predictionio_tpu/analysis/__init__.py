@@ -1,0 +1,33 @@
+"""``ptpu check`` — JAX-aware static analysis for serving code.
+
+Public surface:
+
+- :func:`run_check` / :func:`check_source` — run the rule suite over
+  paths or a source blob, returning :class:`Finding`\\ s.
+- :data:`RULES` — the rule registry (name → :class:`Rule`).
+- ``# ptpu: allow[rule] — why`` pragmas suppress a finding on that line
+  or the line below the comment.
+
+See ``docs/static-analysis.md`` for the operator-facing rule catalogue.
+"""
+
+from .core import (
+    CheckContext,
+    Finding,
+    check_source,
+    default_context,
+    iter_py_files,
+    run_check,
+)
+from .rules import RULES, Rule
+
+__all__ = [
+    "CheckContext",
+    "Finding",
+    "RULES",
+    "Rule",
+    "check_source",
+    "default_context",
+    "iter_py_files",
+    "run_check",
+]

@@ -1,0 +1,271 @@
+"""Core machinery of ``ptpu check`` — the JAX-aware static-analysis pass.
+
+Pure-AST: this package never imports jax/numpy, so ``ptpu check`` runs in
+milliseconds on a storage-only host and in CI without an accelerator.
+
+Pieces:
+
+- :class:`Finding` — one lint hit (rule, path, line, col, message).
+- :class:`ModuleInfo` — a parsed file plus its import-alias table, so
+  rules match *resolved* dotted names (``np.asarray`` and
+  ``numpy.asarray`` are the same callee; ``from jax import jit`` is
+  ``jax.jit``).
+- pragma suppression — ``# ptpu: allow[rule]`` on the finding line or
+  the line directly above silences that rule there (``allow[*]``
+  silences every rule). Justify the pragma in prose after the bracket.
+- :func:`run_check` — walk paths, parse once per file, run every rule,
+  drop pragma'd findings, return the rest sorted.
+
+The rule catalogue lives in :mod:`predictionio_tpu.analysis.rules`;
+``docs/static-analysis.md`` is the operator-facing reference.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+#: ``# ptpu: allow[rule-a,rule-b] — justification``; the marker may sit
+#: anywhere inside a comment (pragmas usually end a justification
+#: sentence), and the justification is free-form prose
+PRAGMA_RE = re.compile(r"#.*?ptpu:\s*allow\[([^\]]*)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit, formatted ``path:line:col: rule: message``."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+@dataclass
+class CheckContext:
+    """Cross-file facts rules need: the mesh axis names declared by
+    ``parallel/mesh.py`` (for sharding-mismatch)."""
+
+    declared_axes: Set[str] = field(default_factory=set)
+
+
+class ModuleInfo:
+    """A parsed module plus resolution helpers shared by every rule."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.aliases = _collect_aliases(tree)
+        self.pragmas = _collect_pragmas(self.lines)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases
+        expanded (``np.asarray`` → ``numpy.asarray``), else None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.aliases.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+    def suppressed(self, finding: Finding) -> bool:
+        """A pragma suppresses a finding on its own line, or anywhere in
+        the contiguous comment block directly above the finding line (so
+        a multi-line justification can carry the marker on any line)."""
+        candidates = [finding.line]
+        line = finding.line - 1
+        while 1 <= line <= len(self.lines) \
+                and self.lines[line - 1].strip().startswith("#"):
+            candidates.append(line)
+            line -= 1
+        for ln in candidates:
+            allowed = self.pragmas.get(ln)
+            if allowed and ("*" in allowed or finding.rule in allowed):
+                return True
+        return False
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name → dotted origin, from every import in the module
+    (function-local imports included — the hot packages import jnp
+    inside functions to keep storage-only commands jax-free)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _collect_pragmas(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    pragmas: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            pragmas[i] = {r.strip() for r in m.group(1).split(",")
+                          if r.strip()}
+    return pragmas
+
+
+# ---------------------------------------------------------------------------
+# mesh axis extraction (sharding-mismatch's ground truth)
+# ---------------------------------------------------------------------------
+
+def extract_mesh_axes(source: str) -> Set[str]:
+    """Axis names a ``parallel/mesh.py`` declares: module constants
+    ending in ``_AXIS`` bound to string literals, plus any literal axis
+    names in ``Mesh(devices, (<axes>))`` calls (Names resolve through
+    the constants)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return set()
+    consts: Dict[str, str] = {}
+    axes: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+            if node.targets[0].id.endswith("_AXIS"):
+                axes.add(node.value.value)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        name = callee.attr if isinstance(callee, ast.Attribute) else \
+            callee.id if isinstance(callee, ast.Name) else ""
+        if name != "Mesh":
+            continue
+        args = list(node.args[1:2]) + \
+            [kw.value for kw in node.keywords if kw.arg == "axis_names"]
+        for arg in args:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for elt in arg.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        axes.add(elt.value)
+                    elif isinstance(elt, ast.Name) and elt.id in consts:
+                        axes.add(consts[elt.id])
+    return axes
+
+
+def _find_mesh_source(files: Sequence[str]) -> Optional[str]:
+    """The scanned tree's ``parallel/mesh.py`` if present, else this
+    package's own (so ``ptpu check some/engine/dir`` still validates
+    axis names against the framework mesh)."""
+    for f in files:
+        norm = f.replace(os.sep, "/")
+        if norm.endswith("parallel/mesh.py"):
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    return fh.read()
+            except OSError:
+                continue
+    fallback = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "parallel", "mesh.py")
+    try:
+        with open(fallback, "r", encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+def default_context() -> CheckContext:
+    """Context anchored to this package's own mesh declarations (used
+    when checking loose files/snippets with no mesh.py in scope)."""
+    mesh_src = _find_mesh_source([])
+    return CheckContext(declared_axes=extract_mesh_axes(mesh_src)
+                        if mesh_src else set())
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if not d.startswith(".")
+                             and d != "__pycache__")
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    out.append(os.path.join(root, n))
+    return out
+
+
+def check_source(source: str, path: str = "<string>",
+                 rule_names: Optional[Sequence[str]] = None,
+                 ctx: Optional[CheckContext] = None) -> List[Finding]:
+    """Run the (selected) rules over one source blob — the test and
+    single-file entry point. Pragma suppression applies."""
+    from .rules import RULES
+
+    ctx = ctx or default_context()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 1, 0,
+                        f"cannot parse: {e.msg}")]
+    mod = ModuleInfo(path, source, tree)
+    findings: List[Finding] = []
+    for name, rule in RULES.items():
+        if rule_names and name not in rule_names:
+            continue
+        findings.extend(rule.fn(mod, ctx))
+    return sorted((f for f in findings if not mod.suppressed(f)),
+                  key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def run_check(paths: Sequence[str],
+              rule_names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Walk ``paths``, check every ``.py`` file, return surviving
+    findings sorted by location."""
+    from .rules import RULES
+
+    unknown = set(rule_names or ()) - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)} "
+                         f"(have: {sorted(RULES)})")
+    files = iter_py_files(paths)
+    mesh_src = _find_mesh_source(files)
+    ctx = CheckContext(declared_axes=extract_mesh_axes(mesh_src)
+                       if mesh_src else set())
+    findings: List[Finding] = []
+    for f in files:
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("parse-error", f, 1, 0, str(e)))
+            continue
+        findings.extend(check_source(src, path=f, rule_names=rule_names,
+                                     ctx=ctx))
+    return findings

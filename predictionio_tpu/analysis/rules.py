@@ -1,0 +1,548 @@
+"""The JAX-specific rule catalogue behind ``ptpu check``.
+
+Five rules, each an AST pass over one :class:`~.core.ModuleInfo`:
+
+- ``host-sync-in-hot-path`` — device→host landings (``np.asarray``,
+  ``.item()``, ``.tolist()``, ``jax.device_get``,
+  ``.block_until_ready()``, ``float(jnp...)``) inside functions of the
+  hot packages (``server/``, ``ops/``). Each is a synchronous transfer
+  that stalls the dispatch pipeline; on the query path one stray sync
+  caps throughput at the PCIe/tunnel round-trip rate.
+- ``recompile-hazard`` — jit call sites that re-trace or re-compile
+  silently: unhashable values passed for static args, jitted closures
+  capturing ``jnp`` arrays built in an enclosing scope (the captured
+  array is baked into the trace — a new array means a new program),
+  and Python ``if``/``while`` on traced arguments (data-dependent
+  control flow re-traces per branch or just fails late).
+- ``missing-donation`` — ``x = step(x, …)`` update patterns calling a
+  jitted function that does not donate the re-bound buffer: the old
+  ``x`` stays alive across the step, doubling peak HBM for large
+  factor/accumulator arrays.
+- ``sharding-mismatch`` — ``PartitionSpec`` axis-name literals that no
+  mesh builder in ``parallel/mesh.py`` declares; XLA only reports these
+  at trace time on a real mesh, usually mid-deploy.
+- ``config-drift`` — ``jax.config.update`` outside
+  ``utils/platform.py``: scattered config flips make process behavior
+  depend on import order (exactly the class of bug
+  ``force_cpu_if_requested`` exists to fix).
+
+Every rule obeys the ``# ptpu: allow[rule] — justification`` pragma
+(see :mod:`.core`). Rules are heuristics tuned for this codebase's
+idioms; they prefer a pragma-able false positive on genuinely hot files
+over silence.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import CheckContext, Finding, ModuleInfo
+
+RuleFn = Callable[[ModuleInfo, CheckContext], List[Finding]]
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    description: str
+    fn: RuleFn
+
+
+# ---------------------------------------------------------------------------
+# rule 1: host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+#: directories whose function bodies are considered hot (serving/query
+#: and device-op code; module level runs once at import and is exempt)
+HOT_DIR_PARTS = {"server", "ops"}
+
+HOST_SYNC_CALLS = {
+    "numpy.asarray": "np.asarray on a device value copies device→host "
+                     "synchronously",
+    "numpy.ascontiguousarray": "np.ascontiguousarray forces a host "
+                               "copy (and a second one if the first "
+                               "landing was non-contiguous)",
+    "jax.device_get": "jax.device_get blocks until the transfer "
+                      "completes",
+}
+
+HOST_SYNC_METHODS = {
+    "item": ".item() synchronously pulls a scalar off the device",
+    "tolist": ".tolist() copies the whole array to host Python objects",
+    "block_until_ready": ".block_until_ready() stalls the caller on "
+                         "device completion",
+}
+
+
+def _in_hot_path(path: str) -> bool:
+    parts = path.split("/")
+    return bool(set(parts[:-1]) & HOT_DIR_PARTS)
+
+
+def rule_host_sync(mod: ModuleInfo, ctx: CheckContext) -> List[Finding]:
+    if not _in_hot_path(mod.path):
+        return []
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    funcs = [n for n in ast.walk(mod.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            name = mod.resolve(node.func)
+            if name in HOST_SYNC_CALLS:
+                findings.append(Finding(
+                    "host-sync-in-hot-path", mod.path, node.lineno,
+                    node.col_offset,
+                    f"{HOST_SYNC_CALLS[name]} (in hot function "
+                    f"`{fn.name}`); keep the hot path device-resident "
+                    f"or pragma with justification"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in HOST_SYNC_METHODS \
+                    and not node.args and not node.keywords:
+                findings.append(Finding(
+                    "host-sync-in-hot-path", mod.path, node.lineno,
+                    node.col_offset,
+                    f"{HOST_SYNC_METHODS[node.func.attr]} (in hot "
+                    f"function `{fn.name}`)"))
+            elif name in ("float", "int") and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Call):
+                inner = mod.resolve(node.args[0].func)
+                if inner and inner.startswith("jax.numpy."):
+                    findings.append(Finding(
+                        "host-sync-in-hot-path", mod.path, node.lineno,
+                        node.col_offset,
+                        f"{name}() on a jnp result forces a blocking "
+                        f"device→host scalar read (in hot function "
+                        f"`{fn.name}`)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# shared jit-site discovery (rules 2 and 3)
+# ---------------------------------------------------------------------------
+
+#: constructors whose results are device arrays — a jitted closure
+#: capturing one re-traces whenever the captured array changes identity
+ARRAY_BUILDERS_PREFIX = "jax.numpy."
+ARRAY_BUILDERS_EXACT = {"jax.device_put"}
+
+
+@dataclass
+class JitSite:
+    """One jit wrapping: decorator or ``jax.jit(fn, …)`` call."""
+
+    fn: Optional[ast.AST]           # FunctionDef/Lambda being wrapped
+    call: Optional[ast.Call]        # the jax.jit(...) call node, if any
+    lineno: int
+    col: int
+    bound_name: Optional[str]       # name the jitted callable binds to
+    static_names: Set[str]
+    donate_nums: Set[int]
+    donate_names: Set[str]
+    scope_stack: Tuple[ast.AST, ...]  # enclosing function defs, outer→inner
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+        return []
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    """String literals in a str/tuple/list constant expression."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _jit_kwargs(call: ast.Call) -> Dict[str, ast.AST]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _statics_and_donations(kwargs: Dict[str, ast.AST],
+                           params: Sequence[str]
+                           ) -> Tuple[Set[str], Set[int], Set[str]]:
+    static_names: Set[str] = set()
+    if "static_argnames" in kwargs:
+        static_names |= set(_const_strs(kwargs["static_argnames"]))
+    if "static_argnums" in kwargs:
+        for i in _const_ints(kwargs["static_argnums"]):
+            if 0 <= i < len(params):
+                static_names.add(params[i])
+    donate_nums = set(_const_ints(kwargs["donate_argnums"])) \
+        if "donate_argnums" in kwargs else set()
+    donate_names = set(_const_strs(kwargs["donate_argnames"])) \
+        if "donate_argnames" in kwargs else set()
+    return static_names, donate_nums, donate_names
+
+
+class _JitCollector(ast.NodeVisitor):
+    """Find every jit wrapping in a module, with its enclosing function
+    scopes and the per-scope simple ``name = <expr>`` assignments (for
+    the closure-capture check)."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.sites: List[JitSite] = []
+        self.scope: List[ast.AST] = []
+        #: id(scope fn) or None → {name: value expr}
+        self.assigns: Dict[Optional[int], Dict[str, ast.AST]] = {None: {}}
+        #: function defs by name, outermost first (jax.jit(Name) lookup)
+        self.defs_by_name: Dict[str, ast.AST] = {}
+
+    def _scope_key(self) -> Optional[int]:
+        return id(self.scope[-1]) if self.scope else None
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — ast API
+        self._handle_def(node)
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802 — ast API
+        self._handle_def(node)
+
+    def _handle_def(self, node) -> None:
+        self.defs_by_name.setdefault(node.name, node)
+        params = _param_names(node)
+        for dec in node.decorator_list:
+            site = self._site_from_decorator(dec, node, params)
+            if site is not None:
+                self.sites.append(site)
+        self.scope.append(node)
+        self.assigns.setdefault(id(node), {})
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def _site_from_decorator(self, dec: ast.AST, node, params
+                             ) -> Optional[JitSite]:
+        name = self.mod.resolve(dec)
+        if name == "jax.jit":
+            return JitSite(node, None, node.lineno, node.col_offset,
+                           node.name, set(), set(), set(),
+                           tuple(self.scope))
+        if isinstance(dec, ast.Call):
+            callee = self.mod.resolve(dec.func)
+            if callee == "jax.jit":
+                s, dn, dnm = _statics_and_donations(_jit_kwargs(dec),
+                                                    params)
+                return JitSite(node, dec, node.lineno, node.col_offset,
+                               node.name, s, dn, dnm, tuple(self.scope))
+            if callee == "functools.partial" and dec.args \
+                    and self.mod.resolve(dec.args[0]) == "jax.jit":
+                s, dn, dnm = _statics_and_donations(_jit_kwargs(dec),
+                                                    params)
+                return JitSite(node, dec, node.lineno, node.col_offset,
+                               node.name, s, dn, dnm, tuple(self.scope))
+        return None
+
+    def visit_Assign(self, node):  # noqa: N802 — ast API
+        if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                 ast.Name):
+            self.assigns[self._scope_key()][node.targets[0].id] = \
+                node.value
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802 — ast API
+        if self.mod.resolve(node.func) == "jax.jit" and node.args:
+            wrapped = node.args[0]
+            target: Optional[ast.AST] = None
+            if isinstance(wrapped, ast.Lambda):
+                target = wrapped
+            elif isinstance(wrapped, ast.Name):
+                target = self.defs_by_name.get(wrapped.id)
+            elif isinstance(wrapped, ast.Attribute) \
+                    and wrapped.attr == "__wrapped__" \
+                    and isinstance(wrapped.value, ast.Name):
+                # jax.jit(f.__wrapped__, ...) re-wraps a decorated def
+                target = self.defs_by_name.get(wrapped.value.id)
+            params = _param_names(target) if target is not None else []
+            s, dn, dnm = _statics_and_donations(_jit_kwargs(node), params)
+            bound = None
+            site = JitSite(target, node, node.lineno, node.col_offset,
+                           bound, s, dn, dnm, tuple(self.scope))
+            self.sites.append(site)
+        self.generic_visit(node)
+
+
+def _collect_jit(mod: ModuleInfo) -> _JitCollector:
+    collector = _JitCollector(mod)
+    collector.visit(mod.tree)
+    # bind `X = jax.jit(f, …)` sites to their assigned name so call
+    # sites of X resolve to the wrapped function's params/donations
+    for scope_assigns in collector.assigns.values():
+        for name, value in scope_assigns.items():
+            for site in collector.sites:
+                if site.call is value:
+                    site.bound_name = name
+    return collector
+
+
+def _free_loads(fn: ast.AST) -> Set[str]:
+    """Names a function/lambda loads but neither binds as a param nor
+    assigns locally — its closure candidates."""
+    params = set(_param_names(fn))
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    local: Set[str] = set()
+    loads: Set[str] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    local.add(node.id)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.add(node.id)
+    return loads - params - local
+
+
+def rule_recompile_hazard(mod: ModuleInfo,
+                          ctx: CheckContext) -> List[Finding]:
+    collector = _collect_jit(mod)
+    findings: List[Finding] = []
+
+    # (a) unhashable values passed for declared static args
+    statics_by_name: Dict[str, Set[str]] = {}
+    for site in collector.sites:
+        if site.bound_name and site.static_names:
+            statics_by_name[site.bound_name] = site.static_names
+    unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                  ast.DictComp, ast.SetComp)
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)):
+            continue
+        statics = statics_by_name.get(node.func.id)
+        if not statics:
+            continue
+        for kw in node.keywords:
+            if kw.arg in statics and isinstance(kw.value, unhashable):
+                findings.append(Finding(
+                    "recompile-hazard", mod.path, node.lineno,
+                    node.col_offset,
+                    f"static arg `{kw.arg}` of `{node.func.id}` gets an "
+                    f"unhashable {type(kw.value).__name__.lower()}; "
+                    f"jit static args must hash — pass a tuple or "
+                    f"hashable config object"))
+
+    # (b) jitted closures over enclosing-scope jnp arrays
+    for site in collector.sites:
+        if site.fn is None or not site.scope_stack:
+            continue
+        free = _free_loads(site.fn)
+        for scope in reversed(site.scope_stack):
+            scope_assigns = collector.assigns.get(id(scope), {})
+            for name in sorted(free & set(scope_assigns)):
+                value = scope_assigns[name]
+                built = mod.resolve(value.func) \
+                    if isinstance(value, ast.Call) else None
+                if built and (built.startswith(ARRAY_BUILDERS_PREFIX)
+                              or built in ARRAY_BUILDERS_EXACT):
+                    findings.append(Finding(
+                        "recompile-hazard", mod.path, site.lineno,
+                        site.col,
+                        f"jitted function closes over device array "
+                        f"`{name}` (built by `{built}` in an enclosing "
+                        f"scope); captured arrays are baked into the "
+                        f"trace — a fresh array means a fresh compile. "
+                        f"Pass it as an argument instead"))
+
+    # (c) Python control flow on traced arguments inside jitted bodies
+    flagged: Set[int] = set()
+    for site in collector.sites:
+        fn = site.fn
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        traced = set(_param_names(fn)) - site.static_names
+        if not traced:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            if id(node) in flagged:
+                continue
+            test_loads = {n.id for n in ast.walk(node.test)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Load)}
+            bad = sorted(test_loads & traced)
+            if bad:
+                flagged.add(id(node))
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression"}[type(node)]
+                findings.append(Finding(
+                    "recompile-hazard", mod.path, node.lineno,
+                    node.col_offset,
+                    f"Python `{kind}` on traced argument(s) "
+                    f"{', '.join(bad)} inside jitted `{fn.name}`; "
+                    f"mark them static, or branch with "
+                    f"jnp.where/lax.cond"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 3: missing-donation
+# ---------------------------------------------------------------------------
+
+def rule_missing_donation(mod: ModuleInfo,
+                          ctx: CheckContext) -> List[Finding]:
+    collector = _collect_jit(mod)
+    jitted: Dict[str, JitSite] = {}
+    for site in collector.sites:
+        if site.bound_name:
+            jitted.setdefault(site.bound_name, site)
+
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id in jitted):
+            continue
+        site = jitted[call.func.id]
+        params = _param_names(site.fn) if site.fn is not None else []
+        targets: Set[str] = set()
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                targets |= {e.id for e in t.elts
+                            if isinstance(e, ast.Name)}
+        if not targets:
+            continue
+        rebound: List[Tuple[int, str]] = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Name) and arg.id in targets:
+                pname = params[i] if i < len(params) else ""
+                if i not in site.donate_nums \
+                        and pname not in site.donate_names:
+                    rebound.append((i, arg.id))
+        for kw in call.keywords:
+            if isinstance(kw.value, ast.Name) and kw.arg \
+                    and kw.value.id in targets \
+                    and kw.arg not in site.donate_names \
+                    and (kw.arg not in params
+                         or params.index(kw.arg)
+                         not in site.donate_nums):
+                rebound.append((-1, kw.value.id))
+        for _, name in rebound:
+            findings.append(Finding(
+                "missing-donation", mod.path, node.lineno,
+                node.col_offset,
+                f"`{name}` is re-bound to an output of jitted "
+                f"`{call.func.id}` but not donated; the old buffer "
+                f"stays live across the step (2x peak HBM for large "
+                f"arrays) — add it to donate_argnums"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 4: sharding-mismatch
+# ---------------------------------------------------------------------------
+
+def _axis_literals(node: ast.AST) -> List[str]:
+    """Axis-name string literals in one PartitionSpec argument: a bare
+    string, or a tuple/list of strings (multi-axis sharding)."""
+    out: List[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+    return out
+
+
+def rule_sharding_mismatch(mod: ModuleInfo,
+                           ctx: CheckContext) -> List[Finding]:
+    axes = ctx.declared_axes
+    if not axes:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if mod.resolve(node.func) != "jax.sharding.PartitionSpec":
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for name in _axis_literals(arg):
+                if name not in axes:
+                    findings.append(Finding(
+                        "sharding-mismatch", mod.path, node.lineno,
+                        node.col_offset,
+                        f"PartitionSpec axis {name!r} is not declared "
+                        f"by parallel/mesh.py (declared: "
+                        f"{sorted(axes)}); XLA will reject this spec "
+                        f"at trace time on a real mesh"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 5: config-drift
+# ---------------------------------------------------------------------------
+
+#: the one module allowed to flip global jax config (platform policy)
+CONFIG_HOME_SUFFIX = "utils/platform.py"
+
+
+def rule_config_drift(mod: ModuleInfo, ctx: CheckContext) -> List[Finding]:
+    if mod.path.endswith(CONFIG_HOME_SUFFIX):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and mod.resolve(node.func) == "jax.config.update":
+            key = ""
+            if node.args and isinstance(node.args[0], ast.Constant):
+                key = f" ({node.args[0].value!r})"
+            findings.append(Finding(
+                "config-drift", mod.path, node.lineno, node.col_offset,
+                f"jax.config.update{key} outside utils/platform.py; "
+                f"global config flips scattered across modules make "
+                f"behavior depend on import order — route it through "
+                f"the platform module"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, Rule] = {r.name: r for r in (
+    Rule("host-sync-in-hot-path",
+         "device→host sync (np.asarray/.item()/.tolist()/device_get/"
+         "block_until_ready) inside server/ or ops/ functions",
+         rule_host_sync),
+    Rule("recompile-hazard",
+         "jit sites that silently re-trace: unhashable statics, "
+         "closures over jnp arrays, Python control flow on traced args",
+         rule_recompile_hazard),
+    Rule("missing-donation",
+         "x = jitted(x, …) update steps without donate_argnums on the "
+         "re-bound buffer",
+         rule_missing_donation),
+    Rule("sharding-mismatch",
+         "PartitionSpec axis names not declared by parallel/mesh.py",
+         rule_sharding_mismatch),
+    Rule("config-drift",
+         "jax.config.update outside utils/platform.py",
+         rule_config_drift),
+)}

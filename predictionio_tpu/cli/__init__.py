@@ -800,6 +800,32 @@ def cmd_run(args, storage: Storage) -> int:
         set_storage(prior)
 
 
+def cmd_check(args) -> int:
+    """``ptpu check`` — the JAX-aware static-analysis pass (pure AST, no
+    jax/storage import: safe on any host, fast enough for a pre-commit
+    hook). Non-zero exit on findings; see docs/static-analysis.md."""
+    from ..analysis import RULES, run_check
+
+    if args.list_rules:
+        for name, rule in sorted(RULES.items()):
+            _out(f"{name}: {rule.description}")
+        return 0
+    try:
+        findings = run_check(args.paths or ["predictionio_tpu"],
+                             rule_names=args.rule or None)
+    except ValueError as e:
+        _err(str(e))
+        return 2
+    for f in findings:
+        _out(f.format())
+    if findings:
+        _err(f"ptpu check: {len(findings)} finding(s). Fix them or "
+             f"suppress with '# ptpu: allow[rule] — justification'.")
+        return 1
+    _out("ptpu check: clean.")
+    return 0
+
+
 def cmd_template(args, storage: Storage) -> int:
     _out("Bundled engine templates (predictionio_tpu.templates):")
     _out("  recommendation  — ALS top-N (module: "
@@ -1005,6 +1031,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--channel", default="")
     s.add_argument("--input", required=True)
 
+    s = sub.add_parser("check", help="JAX-aware static analysis "
+                       "(host-sync, recompile, donation, sharding, "
+                       "config lints)")
+    s.add_argument("paths", nargs="*",
+                   help="files/dirs to check (default: predictionio_tpu)")
+    s.add_argument("--rule", action="append", default=[],
+                   help="run only the named rule (repeatable)")
+    s.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+
     sub.add_parser("template", help="list bundled engine templates")
     sub.add_parser("shell", help="interactive shell with storage preloaded")
     s = sub.add_parser("run", help="run module.path:callable with storage "
@@ -1045,6 +1081,9 @@ def main(argv: Optional[List[str]] = None,
     if args.command == "version":
         _out(__version__)
         return 0
+    if args.command == "check":
+        # pure-AST lint: needs neither storage nor jax
+        return cmd_check(args)
     if args.command in ("train", "eval", "deploy", "batchpredict",
                         "run", "shell", "status"):
         # device-using commands share one persistent XLA program cache

@@ -10,7 +10,7 @@ embedding/feature tables keyed by the same dense ids.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Generic, Iterable, Optional, TypeVar
+from typing import Callable, Dict, Generic, Iterable, Optional, TypeVar
 
 from .bimap import BiMap
 from .datamap import PropertyMap

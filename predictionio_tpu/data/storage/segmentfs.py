@@ -39,7 +39,6 @@ import os
 import threading
 import time
 import uuid
-from datetime import datetime
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..event import Event

@@ -58,6 +58,8 @@ class MarkovChainModel:
             prb = jnp.asarray(self.probs, dtype=jnp.float32)
 
             @jax.jit
+            # ptpu: allow[recompile-hazard] — jit built once per model
+            # and cached on self; idx/prb are fixed for its lifetime
             def predictor(cur):  # [S] → [S]
                 contrib = prb * cur[:, None]          # [S, top_n]
                 return jnp.zeros_like(cur).at[idx.reshape(-1)].add(

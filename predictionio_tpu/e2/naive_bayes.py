@@ -136,6 +136,8 @@ class CategoricalNaiveBayesModel:
             pri = jnp.asarray(self.priors)
 
             @jax.jit
+            # ptpu: allow[recompile-hazard] — jit built once per model
+            # and cached on self; lik/pri are fixed for its lifetime
             def scorer(idx):  # [B, F] → [B] best-label index
                 # gather [L, F, B] then reduce slots
                 g = jnp.take_along_axis(

@@ -21,7 +21,7 @@ TPU-first design:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Sequence
 
 import numpy as np
 
@@ -54,6 +54,8 @@ class NaiveBayesModel:
         if not hasattr(self, "_scorer"):
             lp = jnp.asarray(self.log_priors, dtype=jnp.float32)
             ll = jnp.asarray(self.log_likelihoods, dtype=jnp.float32)
+            # ptpu: allow[recompile-hazard] — jit built once per model
+            # and cached on self; the captured arrays never change
             self._scorer = jax.jit(
                 lambda x: jnp.argmax(x @ ll.T + lp, axis=1))
         idx = np.asarray(self._scorer(
@@ -143,6 +145,8 @@ class RandomForestModel:
             depth = self.max_depth + 1
 
             @jax.jit
+            # ptpu: allow[recompile-hazard] — jit built once per model
+            # and cached on self; the captured tree arrays never change
             def traverse(x):  # [B, F] → [B] class index
                 B = x.shape[0]
                 T = feat.shape[0]

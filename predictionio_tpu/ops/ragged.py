@@ -25,6 +25,28 @@ log = logging.getLogger(__name__)
 AUTO_CAP_ENTRIES = 200_000_000
 
 
+def _host(*arrays) -> tuple:
+    """Explicit host landing for pack results.
+
+    The only device-resident form of a pack should be the BLOCKED
+    (mesh-shaped) copies training actually reads
+    (``PackedRatings.blocked``); keeping the raw pack on device too made
+    every pack live twice in HBM — measured as the eval sweep's
+    RESOURCE_EXHAUSTED with fold packs held by the fast-eval cache. All
+    intentional D2H transfers of this module funnel through here, so
+    the hot-path lint has exactly one blessed sync site.
+    """
+    # ptpu: allow[host-sync-in-hot-path] — the pack's one intended D2H
+    return tuple(np.asarray(a) for a in arrays)
+
+
+def _c_contig(arr: np.ndarray, dtype) -> np.ndarray:
+    """Contiguous host buffer for the native codec (host→host: inputs
+    are already numpy when the native lane is reachable)."""
+    # ptpu: allow[host-sync-in-hot-path] — C++ codec needs C buffers
+    return np.ascontiguousarray(arr, dtype=dtype)
+
+
 @dataclass(frozen=True)
 class PaddedHistories:
     """Per-row padded histories: ``indices[i, k]`` is the k-th counterpart
@@ -183,7 +205,11 @@ def pack_histories_split_device(rows: np.ndarray, cols: np.ndarray,
     import jax.numpy as jnp
 
     L = max(int(max_len), 1)
-    counts_h = np.bincount(np.asarray(rows), minlength=n_rows)
+    # COO triples may arrive as device arrays: land rows ONCE here for
+    # the host-side layout math (shapes must be static), instead of a
+    # fresh implicit transfer per use. ptpu: allow[host-sync-in-hot-path]
+    rows = np.asarray(rows)
+    counts_h = np.bincount(rows, minlength=n_rows)
     groups, n_virtual, n_vpad = split_layout(counts_h, L, pad_rows_to)
     n_rows_pad = max(((n_rows + pad_rows_to - 1) // pad_rows_to)
                      * pad_rows_to, pad_rows_to)
@@ -196,10 +222,10 @@ def pack_histories_split_device(rows: np.ndarray, cols: np.ndarray,
         n_rows_pad=n_rows_pad)
     # host-land for the same reason as the bucketed pack: only the
     # blocked copies belong in HBM
-    return SplitHistories(indices=np.asarray(idx), values=np.asarray(val),
-                          counts=np.asarray(vcnt),
-                          row_ids=np.asarray(row_ids),
-                          real_counts=np.asarray(real_counts),
+    idx, val, vcnt, row_ids, real_counts = _host(
+        idx, val, vcnt, row_ids, real_counts)
+    return SplitHistories(indices=idx, values=val, counts=vcnt,
+                          row_ids=row_ids, real_counts=real_counts,
                           n_rows=n_rows)
 
 
@@ -343,8 +369,10 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
     without it the layout is drop-free."""
     import jax.numpy as jnp
 
+    # single host landing for the layout math (see the split pack)
+    rows = np.asarray(rows)  # ptpu: allow[host-sync-in-hot-path]
     if counts is None:  # callers that already histogrammed pass it in
-        counts = np.bincount(np.asarray(rows), minlength=n_rows)
+        counts = np.bincount(rows, minlength=n_rows)
     if max_len is not None:
         counts = np.minimum(counts, int(max_len))
     plan, row_base, S = bucket_layout(counts, min_len, pad_rows_to)
@@ -365,13 +393,8 @@ def pack_histories_bucketed_device(rows: np.ndarray, cols: np.ndarray,
             jnp.asarray(row_base, dtype=jnp.int32),
             jnp.asarray(counts, dtype=jnp.int32),  # post-cap budget
             n_rows=n_rows, S=S)
-    # land the packed layout on HOST: the only device-resident form
-    # should be the BLOCKED (mesh-shaped) copies that training actually
-    # reads (``PackedRatings.blocked``). Keeping these slices on device
-    # made every pack live twice in HBM — measured as the eval sweep's
-    # RESOURCE_EXHAUSTED with fold packs held by the fast-eval cache.
-    flat_idx = np.asarray(flat[0])
-    flat_val = np.asarray(flat[1])
+    # land the packed layout on HOST (see _host for why)
+    flat_idx, flat_val = _host(flat[0], flat[1])
     buckets = []
     for L, rows_k, n_bk_pad, off in plan:
         n_bk = len(rows_k)
@@ -407,11 +430,11 @@ def _pack_flat_native(rows, cols, vals, row_base, row_cap, n_rows: int,
     mod = codec()
     if mod is None or not hasattr(mod, "pack_flat"):
         return None
-    r32 = np.ascontiguousarray(rows, dtype=np.int32)
-    c32 = np.ascontiguousarray(cols, dtype=np.int32)
-    v32 = np.ascontiguousarray(vals, dtype=np.float32)
-    b32 = np.ascontiguousarray(row_base, dtype=np.int32)
-    k32 = np.ascontiguousarray(row_cap, dtype=np.int32)
+    r32 = _c_contig(rows, np.int32)
+    c32 = _c_contig(cols, np.int32)
+    v32 = _c_contig(vals, np.float32)
+    b32 = _c_contig(row_base, np.int32)
+    k32 = _c_contig(row_cap, np.int32)
     ib, vb = mod.pack_flat(r32, c32, v32, b32, k32, int(n_rows), int(S))
     return (np.frombuffer(ib, dtype=np.int32),
             np.frombuffer(vb, dtype=np.float32))
@@ -496,6 +519,8 @@ def pack_histories_device(rows: np.ndarray, cols: np.ndarray,
 
     L = max(int(max_len), 1)
     n_pad = ((n_rows + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    # single host landing for the layout math (see the split pack)
+    rows = np.asarray(rows)  # ptpu: allow[host-sync-in-hot-path]
     # native host pack first (no device round-trip, no pack compile)
     base = np.arange(n_rows, dtype=np.int64) * L
     if n_pad * L < 2 ** 31:
@@ -505,7 +530,7 @@ def pack_histories_device(rows: np.ndarray, cols: np.ndarray,
     else:  # pragma: no cover — >2^31 slots needs the device path
         flat = None
     if flat is not None:
-        counts = np.bincount(np.asarray(rows), minlength=n_rows)
+        counts = np.bincount(rows, minlength=n_rows)
         cnt = np.zeros(n_pad, np.int32)
         cnt[:n_rows] = np.minimum(counts, L)
         return PaddedHistories(indices=flat[0].reshape(n_pad, L),
@@ -516,12 +541,9 @@ def pack_histories_device(rows: np.ndarray, cols: np.ndarray,
         jnp.asarray(cols, dtype=jnp.int32),
         jnp.asarray(vals, dtype=jnp.float32),
         n_rows=n_rows, L=L, n_pad=n_pad)
-    # host-land (same reason as the bucketed/split packs): the only
-    # device-resident form should be the blocked copies training reads —
-    # keeping these too doubled every pack's HBM footprint
-    return PaddedHistories(indices=np.asarray(idx),
-                           values=np.asarray(val),
-                           counts=np.asarray(cnt))
+    # host-land (same reason as the bucketed/split packs; see _host)
+    idx, val, cnt = _host(idx, val, cnt)
+    return PaddedHistories(indices=idx, values=val, counts=cnt)
 
 
 def _pack_on_device(r, c, v, *, n_rows: int, L: int, n_pad: int):

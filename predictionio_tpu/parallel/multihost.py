@@ -39,8 +39,11 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         int(os.environ.get("PIO_PROCESS_ID", "-1"))
     if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
         # CPU-host pods (and tests): cross-process collectives need the
-        # gloo backend; must be configured before the backend exists
+        # gloo backend; must be configured before the backend exists,
+        # and only a process that KNOWS it is joining a multi-host
+        # system may decide this — platform.py cannot.
         try:
+            # ptpu: allow[config-drift] — multi-host init owns this flag
             jax.config.update("jax_cpu_collectives_implementation",
                               "gloo")
         except Exception as e:  # noqa: BLE001 — older/newer jax

@@ -23,7 +23,7 @@ import logging
 import secrets
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, List, Optional
 
 from ..controller.context import Context
@@ -87,6 +87,13 @@ class ServerConfig:
     #: p90/p99 pathology. Runs in a background thread; ``/status.json``
     #: exposes ``servingWarm``.
     warm_start: bool = True
+    #: ``jax.transfer_guard`` level wrapped around the post-warmup query
+    #: path — the runtime complement of ``ptpu check``'s
+    #: host-sync-in-hot-path lint. "log" surfaces every implicit
+    #: device↔host transfer a query triggers; "disallow" turns them into
+    #: errors (canary deployments); "allow"/"off"/None disables. Applied
+    #: only once warmup is done: warmup itself legitimately transfers.
+    transfer_guard: Optional[str] = "log"
 
 
 class QueryServer:
@@ -117,6 +124,11 @@ class QueryServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        # recompile sentinel: armed when warmup finishes, so every
+        # compile after that is a query paying a trace it shouldn't
+        # (the runtime half of ptpu check's recompile-hazard lint)
+        from .stats import RecompileSentinel
+        self.recompile_sentinel = RecompileSentinel()
         self.warm_done = threading.Event()
         self._warm_gen = 0  # stale warm threads must not set the event
         if self.config.warm_start:
@@ -124,6 +136,7 @@ class QueryServer:
                              daemon=True, name="serving-warmup").start()
         else:
             self.warm_done.set()
+            self.recompile_sentinel.arm()
 
     def _warm_serving(self, gen: int) -> None:
         """Pre-compile the serving path's device shapes (single query +
@@ -149,6 +162,7 @@ class QueryServer:
         with self._lock:
             if gen == self._warm_gen:
                 self.warm_done.set()
+                self.recompile_sentinel.arm()
 
     def _bind(self, engine_params: EngineParams, models: List[Any],
               instance: EngineInstance) -> None:
@@ -165,6 +179,25 @@ class QueryServer:
             self.models = [a.prepare_serving_model(m, bind_batch)
                            for a, m in zip(self.algorithms, models)]
             self.serving = self.engine.make_serving(engine_params)
+
+    def _transfer_guard(self):
+        """Post-warmup queries run under ``jax.transfer_guard`` so any
+        implicit device↔host transfer on the hot path is logged (or
+        rejected, per config) instead of silently stalling dispatch.
+        Warmup-phase traffic and guard levels of "allow"/"off" get a
+        no-op context; so does a jax too old to have the API."""
+        from contextlib import nullcontext
+
+        level = self.config.transfer_guard
+        if not level or level in ("off", "allow") \
+                or not self.warm_done.is_set():
+            return nullcontext()
+        try:
+            import jax
+
+            return jax.transfer_guard(level)
+        except Exception:  # noqa: BLE001 — observability, never a dep
+            return nullcontext()
 
     # -- batched hot path ---------------------------------------------------
     def query_batch(self, query_jsons: List[Any]) -> List[Any]:
@@ -189,8 +222,9 @@ class QueryServer:
             except (TypeError, ValueError) as e:
                 out[i] = HTTPError(400, str(e))
         if ok_rows:
-            served = predict_serve_batch(algorithms, models, serving,
-                                         parsed)
+            with self._transfer_guard():
+                served = predict_serve_batch(algorithms, models, serving,
+                                             parsed)
             for j, i in enumerate(ok_rows):
                 prediction = served[j]
                 if isinstance(prediction, Exception):
@@ -227,11 +261,13 @@ class QueryServer:
             query = from_jsonable(query_cls, query_json)
         except (TypeError, ValueError) as e:
             raise HTTPError(400, str(e))
-        supplemented = serving.supplement(query)
-        predictions = [a.predict(m, supplemented)
-                       for a, m in zip(algorithms, models)]
-        # by design: serve sees the original query (CreateServer.scala:511)
-        prediction = serving.serve(query, predictions)
+        with self._transfer_guard():
+            supplemented = serving.supplement(query)
+            predictions = [a.predict(m, supplemented)
+                           for a, m in zip(algorithms, models)]
+            # by design: serve sees the original query
+            # (CreateServer.scala:511)
+            prediction = serving.serve(query, predictions)
         result = to_jsonable(prediction)
 
         if self.config.feedback:
@@ -369,6 +405,8 @@ def build_app(server: QueryServer) -> HTTPApp:
             "avgServingSec": server.avg_serving_sec,
             "lastServingSec": server.last_serving_sec,
             "servingWarm": server.warm_done.is_set(),
+            "transferGuard": cfg.transfer_guard or "off",
+            "recompile": server.recompile_sentinel.snapshot(),
         })
 
     @app.route("POST", "/queries.json")

@@ -1,16 +1,22 @@
-"""Event Server request bookkeeping.
+"""Server request/runtime bookkeeping.
 
 Capability parity with the reference's ``Stats``/``StatsActor``
 (``data/api/Stats.scala:41-80``, ``data/api/StatsActor.scala:30-76``):
 per-appId counts keyed by (entityType, targetEntityType, event) and by
 status code, kept for the current hour with the previous hour retained
 after cutoff. No actor needed — a lock suffices.
+
+Also home of :class:`RecompileSentinel` — the runtime complement of the
+``ptpu check`` recompile-hazard lint: it counts XLA backend compiles
+after the serving warmup finished, so a recompile storm on the query
+path (novel shapes, unhashable statics regressions) is visible in the
+engine server's ``/status.json`` instead of only as tail latency.
 """
 
 from __future__ import annotations
 
 import threading
-from datetime import datetime, timedelta, timezone
+from datetime import datetime, timezone
 from typing import Dict, Optional, Tuple
 
 from ..data.event import Event, isoformat_millis
@@ -87,3 +93,73 @@ class StatsCollector:
             if self._previous is not None:
                 result["prev"] = self._previous.snapshot(app_id)
             return result
+
+
+class RecompileSentinel:
+    """Post-warmup compilation-cache-miss counter.
+
+    ``jax.monitoring`` fires one duration event per XLA backend compile
+    (``/jax/core/compile/backend_compile_duration``); a process-wide
+    listener tallies them. :meth:`arm` snapshots the tally when serving
+    warmup completes — after that, every additional compile is traffic
+    paying a compile it should not, and :meth:`snapshot` reports the
+    delta. The listener registers once per process and is never removed
+    (jax offers no unregister); instances only read the shared counter,
+    so sentinels are cheap and re-armable (deploy → reload → re-warm).
+    """
+
+    _lock = threading.Lock()
+    _total = 0
+    _installed = False
+    _available = False
+
+    @classmethod
+    def _listener(cls, name: str, *args, **kwargs) -> None:
+        if name == "/jax/core/compile/backend_compile_duration":
+            with cls._lock:
+                cls._total += 1
+
+    @classmethod
+    def _install(cls) -> None:
+        with cls._lock:
+            if cls._installed:
+                return
+            cls._installed = True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                cls._listener)
+            cls._available = True
+        except Exception:  # noqa: BLE001 — jax absent/changed: degrade
+            cls._available = False
+
+    def __init__(self):
+        self._install()
+        self._baseline: Optional[int] = None
+
+    @classmethod
+    def total_compiles(cls) -> int:
+        with cls._lock:
+            return cls._total
+
+    @property
+    def armed(self) -> bool:
+        return self._baseline is not None
+
+    def arm(self) -> None:
+        """Start (or restart) counting — call when warmup completes."""
+        self._baseline = self.total_compiles()
+
+    @property
+    def since_armed(self) -> int:
+        if self._baseline is None:
+            return 0
+        return self.total_compiles() - self._baseline
+
+    def snapshot(self) -> dict:
+        return {
+            "available": self._available,
+            "armed": self.armed,
+            "compilesSinceWarm": self.since_armed,
+            "compilesTotal": self.total_compiles(),
+        }

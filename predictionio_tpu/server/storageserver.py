@@ -101,7 +101,10 @@ def _batch_version(batch, memo_key=None) -> str:
             batch.props_offsets, batch.props_blob]
     cols += [batch.float_props[k] for k in sorted(batch.float_props)]
     for arr in cols:
-        a = np.ascontiguousarray(np.asarray(arr))
+        # hashing inherently needs host bytes, but ONE C-ordered landing
+        # suffices — the former asarray+ascontiguousarray pair copied
+        # device columns twice. ptpu: allow[host-sync-in-hot-path]
+        a = np.asarray(arr, order="C")
         h.update(str(a.dtype).encode())
         h.update(a.tobytes())
     version = h.hexdigest()[:32]

@@ -9,7 +9,7 @@ the candidate-item filter (``isCandidateItem``), and top-N selection.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 

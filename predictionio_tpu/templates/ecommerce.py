@@ -19,8 +19,8 @@ masked matvec/matmul over the ``[I, rank]`` factor matrix.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
 
 import logging
 

@@ -1,0 +1,533 @@
+"""`ptpu check` static-analysis tests: every rule's positive, negative,
+and pragma-suppressed cases; the repo-wide clean gate; the CLI contract;
+and the runtime complement (transfer guard + recompile sentinel)."""
+
+import os
+import textwrap
+from dataclasses import dataclass
+
+import pytest
+
+from predictionio_tpu.analysis import RULES, check_source, run_check
+from predictionio_tpu.cli import main
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "predictionio_tpu")
+
+HOT = "predictionio_tpu/server/hot.py"    # host-sync rule applies
+COLD = "predictionio_tpu/models/cold.py"  # ...and here it does not
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def src(text):
+    return textwrap.dedent(text)
+
+
+class TestHostSyncInHotPath:
+    def test_positive_all_sync_forms(self):
+        code = src("""
+            import numpy as np
+            import jax
+            import jax.numpy as jnp
+
+            def handler(arr, dev):
+                a = np.asarray(arr)
+                b = np.ascontiguousarray(arr)
+                c = jax.device_get(dev)
+                d = dev.item()
+                e = dev.tolist()
+                dev.block_until_ready()
+                f = float(jnp.sum(dev))
+                return a, b, c, d, e, f
+        """)
+        findings = check_source(code, path=HOT)
+        assert rules_of(findings) == ["host-sync-in-hot-path"] * 7
+
+    def test_negative_outside_hot_packages(self):
+        code = src("""
+            import numpy as np
+
+            def handler(arr):
+                return np.asarray(arr)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_module_level_is_not_hot(self):
+        # import-time code runs once; only function bodies are hot
+        code = src("""
+            import numpy as np
+
+            TABLE = np.asarray([1, 2, 3])
+        """)
+        assert check_source(code, path=HOT) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import numpy as np
+
+            def handler(arr):
+                # ptpu: allow[host-sync-in-hot-path] — test justification
+                return np.asarray(arr)
+        """)
+        assert check_source(code, path=HOT) == []
+
+    def test_pragma_in_comment_block_above(self):
+        code = src("""
+            import numpy as np
+
+            def handler(arr):
+                # a multi-line justification whose marker sits on the
+                # first line: ptpu: allow[host-sync-in-hot-path]
+                # and more prose after it
+                return np.asarray(arr)
+        """)
+        assert check_source(code, path=HOT) == []
+
+
+class TestRecompileHazard:
+    def test_positive_unhashable_static_arg(self):
+        code = src("""
+            import jax
+
+            def f(x, cfg):
+                return x
+
+            g = jax.jit(f, static_argnames=("cfg",))
+
+            def call(x):
+                return g(x, cfg=[1, 2])
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["recompile-hazard"]
+        assert "unhashable" in findings[0].message
+
+    def test_positive_closure_over_jnp_array(self):
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            def build(vals):
+                w = jnp.asarray(vals)
+                return jax.jit(lambda x: x + w)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["recompile-hazard"]
+        assert "closes over" in findings[0].message
+
+    def test_positive_python_if_on_traced_arg(self):
+        code = src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def f(x, n, flag):
+                if n > 0:
+                    return x
+                return -x
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["recompile-hazard"]
+        assert "traced argument" in findings[0].message
+
+    def test_negative_static_branch_and_hashable_call(self):
+        code = src("""
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=("flag", "n"))
+            def f(x, n, flag):
+                if flag:
+                    return x * n
+                return jnp.where(x > 0, x, -x)
+
+            def call(x):
+                return f(x, n=4, flag=True)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            def build(vals):
+                w = jnp.asarray(vals)
+                # ptpu: allow[recompile-hazard] — built once, cached
+                return jax.jit(lambda x: x + w)
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+class TestMissingDonation:
+    def test_positive_rebound_without_donation(self):
+        code = src("""
+            import jax
+
+            @jax.jit
+            def step(w, g):
+                return w - g
+
+            def train(w, g):
+                w = step(w, g)
+                return w
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["missing-donation"]
+        assert "`w`" in findings[0].message
+
+    def test_positive_tuple_rebind(self):
+        code = src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(w, m, g):
+                return w - g, m * g
+
+            def train(w, m, g):
+                w, m = step(w, m, g)
+                return w, m
+        """)
+        findings = check_source(code, path=COLD)
+        # w (argnum 0) is donated; m (argnum 1) is not
+        assert rules_of(findings) == ["missing-donation"]
+        assert "`m`" in findings[0].message
+
+    def test_negative_donated(self):
+        code = src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(w, m, g):
+                return w - g, m * g
+
+            def train(w, m, g):
+                w, m = step(w, m, g)
+                return w, m
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_no_rebind(self):
+        code = src("""
+            import jax
+
+            @jax.jit
+            def score(w, x):
+                return w @ x
+
+            def run(w, x):
+                s = score(w, x)
+                return s
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax
+
+            @jax.jit
+            def step(w, g):
+                return w - g
+
+            def train(w, g):
+                # ptpu: allow[missing-donation] — tiny buffers, test only
+                w = step(w, g)
+                return w
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+class TestShardingMismatch:
+    def test_positive_undeclared_axis(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("bogus_axis", None)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+        assert "bogus_axis" in findings[0].message
+
+    def test_positive_undeclared_axis_in_tuple(self):
+        code = src("""
+            from jax.sharding import PartitionSpec
+
+            SPEC = PartitionSpec(("data", "oops"))
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+        assert "oops" in findings[0].message
+
+    def test_negative_declared_axes(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+
+            A = P("data", None)
+            B = P(("data", "model"))
+            C = P()
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+
+            # ptpu: allow[sharding-mismatch] — external mesh contract
+            SPEC = P("expert")
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+class TestConfigDrift:
+    def test_positive_update_outside_platform(self):
+        code = src("""
+            import jax
+
+            def setup():
+                jax.config.update("jax_enable_x64", True)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["config-drift"]
+        assert "jax_enable_x64" in findings[0].message
+
+    def test_negative_platform_module_owns_config(self):
+        code = src("""
+            import jax
+
+            def setup():
+                jax.config.update("jax_enable_x64", True)
+        """)
+        path = "predictionio_tpu/utils/platform.py"
+        assert check_source(code, path=path) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax
+
+            def setup():
+                # ptpu: allow[config-drift] — init-time, owns this flag
+                jax.config.update("jax_enable_x64", True)
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+class TestPragmaGeneral:
+    def test_wildcard_allows_every_rule(self):
+        code = src("""
+            import jax
+
+            def setup():
+                jax.config.update("jax_enable_x64", True)  # ptpu: allow[*]
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_for_other_rule_does_not_suppress(self):
+        code = src("""
+            import jax
+
+            def setup():
+                # ptpu: allow[missing-donation] — wrong rule on purpose
+                jax.config.update("jax_enable_x64", True)
+        """)
+        assert rules_of(check_source(code, path=COLD)) == ["config-drift"]
+
+
+class TestRepoWide:
+    def test_package_is_clean(self):
+        findings = run_check([PKG])
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule"):
+            run_check([PKG], rule_names=["not-a-rule"])
+
+    def test_rule_catalogue_complete(self):
+        assert set(RULES) == {
+            "host-sync-in-hot-path", "recompile-hazard",
+            "missing-donation", "sharding-mismatch", "config-drift"}
+
+    def test_parse_error_is_reported_not_raised(self):
+        findings = check_source("def broken(:", path=COLD)
+        assert rules_of(findings) == ["parse-error"]
+
+
+class TestCheckCLI:
+    def test_findings_exit_1(self, tmp_path, capsys):
+        bad = tmp_path / "server" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text(src("""
+            import numpy as np
+
+            def handler(arr):
+                return np.asarray(arr)
+        """))
+        # the hot-path rule keys off path parts, so check the parent dir
+        assert main(["check", str(tmp_path)]) == 1
+        out = capsys.readouterr()
+        assert "host-sync-in-hot-path" in out.out
+        assert "1 finding(s)" in out.err
+
+    def test_clean_exit_0(self, tmp_path, capsys):
+        good = tmp_path / "fine.py"
+        good.write_text("X = 1\n")
+        assert main(["check", str(good)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_rule_filter_and_list(self, tmp_path, capsys):
+        bad = tmp_path / "drift.py"
+        bad.write_text(src("""
+            import jax
+
+            def setup():
+                jax.config.update("jax_enable_x64", True)
+        """))
+        assert main(["check", str(bad), "--rule", "missing-donation"]) == 0
+        assert main(["check", str(bad), "--rule", "config-drift"]) == 1
+        assert main(["check", "--list-rules"]) == 0
+        assert "config-drift" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# runtime complement: recompile sentinel + transfer guard wiring
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _EchoQuery:
+    v: int = 0
+
+
+class _EchoAlgo:
+    query_class = _EchoQuery
+
+    def bind_serving(self, ctx):
+        pass
+
+    def prepare_serving_model(self, model, max_batch):
+        return model
+
+    def predict(self, model, query):
+        return {"doubled": query.v * 2}
+
+
+class _EchoServing:
+    def supplement(self, query):
+        return query
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class _EchoEngine:
+    def make_algorithms(self, engine_params):
+        return [_EchoAlgo()]
+
+    def make_serving(self, engine_params):
+        return _EchoServing()
+
+
+def _make_query_server(**config_kwargs):
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+    )
+
+    class _Ctx:
+        storage = None
+
+    from predictionio_tpu.data.event import utcnow
+
+    now = utcnow()
+    instance = EngineInstance(id="i1", status="COMPLETED",
+                              start_time=now, end_time=now,
+                              engine_id="echo", engine_version="1",
+                              engine_variant="engine.json",
+                              engine_factory="tests:echo")
+    cfg = ServerConfig(warm_start=False, **config_kwargs)
+    return QueryServer(_Ctx(), _EchoEngine(), engine_params=None,
+                       models=[None], instance=instance, config=cfg)
+
+
+class TestRecompileSentinel:
+    def test_counts_fresh_compiles_after_arm(self):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.server.stats import RecompileSentinel
+
+        sentinel = RecompileSentinel()
+        assert not sentinel.armed
+        assert sentinel.since_armed == 0
+        sentinel.arm()
+        # a never-before-seen shape forces a fresh XLA compile
+        jax.jit(lambda x: x * 3 + 1)(jnp.ones(11))
+        snap = sentinel.snapshot()
+        assert snap["available"] and snap["armed"]
+        assert snap["compilesSinceWarm"] >= 1
+        assert snap["compilesTotal"] >= snap["compilesSinceWarm"]
+
+    def test_rearm_resets_baseline(self):
+        from predictionio_tpu.server.stats import RecompileSentinel
+
+        sentinel = RecompileSentinel()
+        sentinel.arm()
+        sentinel.arm()
+        assert sentinel.since_armed == 0
+
+
+class TestServingRuntimeWiring:
+    def test_sentinel_armed_and_query_guarded(self):
+        import contextlib
+
+        server = _make_query_server(transfer_guard="log")
+        assert server.warm_done.is_set()
+        assert server.recompile_sentinel.armed
+        # post-warmup with a level set: a real jax guard context
+        guard = server._transfer_guard()
+        assert not isinstance(guard, contextlib.nullcontext)
+        result = server.query({"v": 21})
+        assert result == {"doubled": 42}
+
+    def test_guard_off_is_noop_context(self):
+        import contextlib
+
+        server = _make_query_server(transfer_guard="off")
+        assert isinstance(server._transfer_guard(),
+                          contextlib.nullcontext)
+        server2 = _make_query_server(transfer_guard=None)
+        assert isinstance(server2._transfer_guard(),
+                          contextlib.nullcontext)
+
+    def test_guard_waits_for_warmup(self):
+        import contextlib
+
+        server = _make_query_server(transfer_guard="log")
+        server.warm_done.clear()
+        assert isinstance(server._transfer_guard(),
+                          contextlib.nullcontext)
+
+    def test_status_json_exposes_sentinel_and_guard(self):
+        from predictionio_tpu.server.engineserver import build_app
+
+        server = _make_query_server(transfer_guard="log")
+        app = build_app(server)
+        route = next(h for m, _, h in app._routes
+                     if getattr(h, "__name__", "") == "status")
+        doc = route(None).body
+        assert doc["transferGuard"] == "log"
+        assert doc["recompile"]["armed"] is True
+        assert "compilesSinceWarm" in doc["recompile"]
+
+    def test_disallowed_transfer_rejected_under_guard(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        server = _make_query_server(transfer_guard="disallow")
+        with pytest.raises(Exception):
+            with server._transfer_guard():
+                np.asarray(jnp.ones(13) + 1)  # implicit D2H
