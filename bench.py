@@ -510,6 +510,16 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, don't die
             roofline = {"error": str(e)[:200]}
 
+    # telemetry tails (ISSUE 2): surface the serving battery's scraped
+    # server-side signals as top-level keys so the perf trajectory
+    # captures recompiles / hidden transfers / p99, not just means
+    def _tele(cfg_key: str, field: str):
+        tele = ((serving or {}).get(cfg_key) or {}).get("telemetry") or {}
+        return tele.get(field)
+
+    tele_cfg = "microbatch" if (serving or {}).get("microbatch") \
+        else "per_query"
+
     print(json.dumps({
         "metric": "als_implicit_train_throughput",
         "value": round(ratings_per_sec, 1),
@@ -526,6 +536,11 @@ def main():
         "rank128": rank128,
         "serving_p50_ms": (serving or {}).get(
             "per_query", {}).get("p50_ms"),
+        "serving_p99_ms": (serving or {}).get(
+            "per_query", {}).get("p99_ms"),
+        "compiles_since_warm": _tele(tele_cfg, "compilesSinceWarm"),
+        "transfer_guard_violations": _tele(tele_cfg,
+                                           "transferGuardViolations"),
         "serving": serving,
         "roofline": roofline,
         "device": jax.devices()[0].device_kind,

@@ -1,0 +1,172 @@
+"""CI smoke: boot a real engine server, fire queries, validate /metrics.
+
+Deploys a toy synthetic-model engine on a loopback port (batched AND
+unbatched), pushes queries through HTTP, then asserts:
+
+- ``GET /metrics`` parses as Prometheus text format 0.0.4 (every
+  non-comment line is ``name{labels} value``, every histogram's +Inf
+  bucket equals its ``_count``)
+- the query-latency histogram series recorded the traffic
+- the per-phase, batch-occupancy, and queue-depth series exist
+- ``/status.json`` carries ``compilesSinceWarm`` and the transfer-guard
+  violation counter
+
+Exit 0 on success; non-zero with a reason otherwise. Run on CPU:
+``JAX_PLATFORMS=cpu python benchmarks/metrics_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_METRIC_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                      # name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'    # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?[0-9.eE+-]+|[+-]Inf|NaN)$')
+
+
+def validate_exposition(text: str) -> None:
+    """Line-grammar + histogram-consistency check of the 0.0.4 format."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    counts: dict = {}
+    inf_buckets: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line.startswith("# TYPE"):
+                parts = line.split()
+                assert len(parts) == 4 and parts[3] in (
+                    "counter", "gauge", "histogram", "summary",
+                    "untyped"), f"bad TYPE line: {line!r}"
+            continue
+        assert _METRIC_LINE.match(line), f"bad metric line: {line!r}"
+        name = line.split("{")[0].split(" ")[0]
+        value = float(line.rsplit(" ", 1)[1].replace("+Inf", "inf"))
+        if name.endswith("_count"):
+            base_and_labels = line.rsplit(" ", 1)[0].replace(
+                "_count", "", 1)
+            counts[base_and_labels] = value
+        if name.endswith("_bucket") and 'le="+Inf"' in line:
+            key = (line.rsplit(" ", 1)[0]
+                   .replace("_bucket", "", 1)
+                   .replace(',le="+Inf"', "").replace('le="+Inf"', "")
+                   .replace("{}", ""))
+            inf_buckets[key] = value
+    for key, v in inf_buckets.items():
+        assert counts.get(key) == v, \
+            f"histogram {key!r}: +Inf bucket {v} != _count {counts.get(key)}"
+
+
+def fetch(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read().decode("utf-8")
+
+
+def boot_and_probe(batching: bool) -> None:
+    import numpy as np
+
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.data.storage.base import (
+        STATUS_COMPLETED,
+        EngineInstance,
+    )
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        create_engine_server,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+
+    rank, n_users, n_items = 8, 32, 64
+    rng = np.random.default_rng(0)
+    model = ALSModel(
+        user_factors=rng.standard_normal((n_users, rank)).astype(
+            np.float32),
+        item_factors=rng.standard_normal((n_items, rank)).astype(
+            np.float32),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "smoke"))
+    ctx = Context(app_name="smoke", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="smoke", status=STATUS_COMPLETED, start_time=now,
+        end_time=now, engine_id="smoke", engine_version="1",
+        engine_variant="engine.json", engine_factory="synthetic")
+    cfg = ServerConfig(batching=batching, max_batch=8,
+                       batch_window_ms=2.0)
+    qs = QueryServer(ctx, recommendation_engine(),
+                     default_engine_params("smoke", rank=rank),
+                     [model], inst, cfg)
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    port = srv.port
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if json.loads(fetch(port, "/status.json")).get("servingWarm"):
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("serving warmup did not finish")
+        for i in range(12):
+            body = json.dumps({"user": f"u{i % n_users}",
+                               "num": 3}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=30).read()
+
+        text = fetch(port, "/metrics")
+        validate_exposition(text)
+        mode = "batched" if batching else "unbatched"
+        for series in ("pio_query_latency_seconds_bucket",
+                       "pio_query_phase_seconds_bucket",
+                       "pio_http_request_duration_seconds_bucket",
+                       "pio_xla_compiles_total",
+                       "pio_transfer_guard_violations_total",
+                       "pio_compiles_since_warm"):
+            assert series in text, f"[{mode}] missing series {series}"
+        if batching:
+            assert "pio_batch_occupancy_bucket" in text
+            assert "pio_queue_depth_bucket" in text
+        status = json.loads(fetch(port, "/status.json"))
+        assert status["recompile"]["compilesSinceWarm"] is not None
+        assert "transferGuardViolations" in status
+        assert status["latency"]["count"] >= 12
+        assert status["latency"]["p99"] is not None
+        print(f"[{mode}] /metrics valid, "
+              f"{len(text.splitlines())} exposition lines, "
+              f"latency count={status['latency']['count']}")
+    finally:
+        srv.shutdown()
+
+
+def main() -> int:
+    boot_and_probe(batching=False)
+    boot_and_probe(batching=True)
+    print("metrics smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,28 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     for t in threads:
         t.join()
     wall = time.monotonic() - t_start
+    # scrape the server's own telemetry BEFORE shutdown (ISSUE 2): the
+    # emitted bench line carries compilesSinceWarm + transfer-guard
+    # violations so the perf trajectory captures recompile storms and
+    # hidden host syncs, not just client-side latency
+    telemetry = None
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status.json",
+                timeout=30) as resp:
+            status = json.loads(resp.read())
+        lat_hist = status.get("latency") or {}
+        telemetry = {
+            "compilesSinceWarm":
+                (status.get("recompile") or {}).get("compilesSinceWarm"),
+            "transferGuardViolations":
+                status.get("transferGuardViolations"),
+            "server_p99_ms": (round(lat_hist["p99"] * 1000, 2)
+                              if lat_hist.get("p99") is not None
+                              else None),
+        }
+    except Exception as e:  # noqa: BLE001 — telemetry is advisory
+        telemetry = {"error": str(e)[:200]}
     srv.shutdown()
     if errors or not lat:
         raise RuntimeError(
@@ -174,6 +196,7 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
         "p90_ms": round(float(np.percentile(arr, 90)), 2),
         "p99_ms": round(float(np.percentile(arr, 99)), 2),
         "qps": round(len(arr) / wall, 1),
+        "telemetry": telemetry,
     }
 
 

@@ -322,6 +322,8 @@ def cmd_deploy(args, storage: Storage) -> int:
     scheme = "https" if ssl_ctx else "http"
     _out(f"Engine is deployed and running. Engine API is live at "
          f"{scheme}://{args.ip}:{server.port}.")
+    _out(f"Telemetry: {scheme}://{args.ip}:{server.port}/metrics "
+         f"(Prometheus) and /status.json.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -383,6 +385,9 @@ def cmd_eventserver(args, storage: Storage) -> int:
                        host=args.ip, port=args.port, ssl_context=ssl_ctx)
     scheme = "https" if ssl_ctx else "http"
     _out(f"Event Server is listening at {scheme}://{args.ip}:{server.port}.")
+    if not args.stats:
+        _out("Per-app /stats.json is OFF (enable with --stats); "
+             "aggregate telemetry is always on at /metrics.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -402,7 +407,8 @@ def cmd_storageserver(args, storage: Storage) -> int:
                        host=args.ip, port=args.port, ssl_context=ssl_ctx)
     scheme = "https" if ssl_ctx else "http"
     _out(f"Storage Server is listening at "
-         f"{scheme}://{args.ip}:{server.port}.")
+         f"{scheme}://{args.ip}:{server.port}. "
+         f"Telemetry at /metrics.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

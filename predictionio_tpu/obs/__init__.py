@@ -1,0 +1,67 @@
+"""Unified telemetry: streaming histograms, metric registry, exposition.
+
+The cross-cutting observability layer (ISSUE 2): every server mounts a
+:class:`MetricsRegistry` whose contents are served as Prometheus text
+format on ``GET /metrics`` and as JSON inside ``/status.json``. See
+docs/observability.md for the full metric catalog.
+"""
+
+from .guard import TransferGuardCounter
+from .histogram import (
+    DEFAULT_LATENCY_BOUNDS,
+    POW2_COUNT_BOUNDS,
+    StreamingHistogram,
+    exponential_bounds,
+    linear_bounds,
+)
+from .registry import (
+    MetricsRegistry,
+    escape_label_value,
+    format_value,
+    render_histogram_lines,
+)
+from .runtime import hbm_stats, register_runtime_metrics
+
+__all__ = [
+    "DEFAULT_LATENCY_BOUNDS",
+    "POW2_COUNT_BOUNDS",
+    "MetricsRegistry",
+    "StreamingHistogram",
+    "TransferGuardCounter",
+    "escape_label_value",
+    "exponential_bounds",
+    "format_value",
+    "hbm_stats",
+    "linear_bounds",
+    "mount_span_metrics",
+    "register_runtime_metrics",
+    "render_histogram_lines",
+]
+
+
+def mount_span_metrics(reg: MetricsRegistry, span_registry=None,
+                       metric_name: str = "pio_span_seconds") -> None:
+    """Expose a :class:`..utils.tracing.SpanRegistry`'s bounded
+    histograms as one labeled histogram family on ``reg`` (collector:
+    spans are recorded outside the registry's family machinery)."""
+    from ..utils.tracing import spans as default_spans
+
+    sr = span_registry if span_registry is not None else default_spans
+    mounted = getattr(reg, "_span_registries", None)
+    if mounted is None:
+        mounted = reg._span_registries = set()  # type: ignore[attr-defined]
+    if id(sr) in mounted:  # idempotent: no duplicate series on remount
+        return
+    mounted.add(id(sr))
+
+    def collect():
+        lines = [f"# HELP {metric_name} Wall-clock spans recorded via "
+                 f"utils.tracing.timed(name)",
+                 f"# TYPE {metric_name} histogram"]
+        for name, hist in sorted(sr.histograms().items()):
+            items = (("span", name),)
+            lines.extend(render_histogram_lines(metric_name, items,
+                                                hist))
+        return lines if len(lines) > 2 else []
+
+    reg.register_collector(collect)

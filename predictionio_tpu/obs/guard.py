@@ -1,0 +1,89 @@
+"""Transfer-guard violation counter.
+
+``ServerConfig.transfer_guard="log"`` wraps the post-warmup query path
+in ``jax.transfer_guard("log")`` so every implicit device↔host transfer
+is logged instead of silently stalling dispatch (PR 1). That made
+violations *visible in the log stream* but not *countable*: an operator
+watching ``/metrics`` had no series to alert on. This module closes the
+loop with a ``logging.Handler`` installed across the ``jax`` logger
+hierarchy (and the root logger, for guard messages that propagate) that
+tallies records matching the guard's message shapes.
+
+Caveat, documented rather than hidden: some jax builds emit log-mode
+guard messages from the C++ PJRT layer straight to stderr, bypassing
+Python ``logging`` entirely — there the counter stays at zero and the
+log lines remain the source of truth. Python-side guard errors (the
+``disallow`` level's exception text, re-logged by the server) and any
+Python-logged guard message are always counted.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+
+#: Message shapes of jax's transfer-guard diagnostics (log and
+#: disallow levels; host↔device both directions, device→device).
+_GUARD_RE = re.compile(
+    r"(disallowed|guarded)?\s*"
+    r"(host-to-device|device-to-host|device-to-device)\s+transfer",
+    re.IGNORECASE)
+
+
+class TransferGuardCounter(logging.Handler):
+    """Process-wide tally of transfer-guard hits seen via ``logging``.
+
+    Install once per process (:meth:`install`); every instance reads the
+    same shared counter, mirroring :class:`..server.stats.RecompileSentinel`'s
+    shape (cheap instances over one process-wide listener).
+    """
+
+    _lock = threading.Lock()
+    _total = 0
+    _installed = False
+
+    def emit(self, record: logging.LogRecord) -> None:
+        # the shared handler sits on both the `jax` logger and root: a
+        # record logged under `jax` propagates to root and would fire
+        # this handler twice — mark the record so it counts once
+        if getattr(record, "_ptpu_guard_seen", False):
+            return
+        record._ptpu_guard_seen = True
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — a malformed record must not
+            return         # crash the emitting thread
+        if _GUARD_RE.search(msg):
+            with TransferGuardCounter._lock:
+                TransferGuardCounter._total += 1
+
+    @classmethod
+    def install(cls) -> "TransferGuardCounter":
+        """Attach one shared handler to the ``jax`` logger and the root
+        logger (idempotent)."""
+        with cls._lock:
+            if cls._installed:
+                return cls._shared
+            cls._installed = True
+            handler = cls(level=logging.DEBUG)
+            cls._shared = handler
+        for name in ("jax", None):
+            logger = logging.getLogger(name)
+            if handler not in logger.handlers:
+                logger.addHandler(handler)
+        return handler
+
+    _shared: "TransferGuardCounter"
+
+    @classmethod
+    def total(cls) -> int:
+        with cls._lock:
+            return cls._total
+
+    @classmethod
+    def count(cls, n: int = 1) -> None:
+        """Direct tally for callers that catch a guard *exception*
+        (``transfer_guard="disallow"``) rather than a log line."""
+        with cls._lock:
+            cls._total += n

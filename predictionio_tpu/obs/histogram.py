@@ -1,0 +1,172 @@
+"""Streaming fixed-bucket histograms: O(1) record, bounded memory.
+
+The round-1 ``SpanRegistry`` kept every observation in a raw per-name
+list — unbounded memory on a server that lives for weeks, and no
+percentiles without a sort over the whole history. A fixed-log-bucket
+histogram replaces it: ``record`` is one bisect plus one increment,
+memory is ``len(bounds) + 1`` integers forever, and p50/p90/p99/max are
+derivable at read time by linear interpolation inside the target bucket
+(the same estimator Prometheus' ``histogram_quantile`` applies to the
+scraped cumulative buckets, so the server-side numbers and the
+fleet-side PromQL numbers agree on the same data).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def exponential_bounds(start: float, factor: float,
+                       count: int) -> List[float]:
+    """``count`` log-spaced bucket upper bounds from ``start``."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    return [start * factor ** i for i in range(count)]
+
+
+def linear_bounds(start: float, width: float, count: int) -> List[float]:
+    """``count`` evenly spaced bucket upper bounds from ``start``."""
+    if width <= 0 or count < 1:
+        raise ValueError("need width > 0, count >= 1")
+    return [start + width * i for i in range(count)]
+
+
+#: Default latency buckets: 100µs → ~105s, ×2 per bucket (21 buckets).
+#: Wide enough for host fast-path serving (sub-ms) AND a cold XLA
+#: compile paid on the query path (tens of seconds, the round-4 p99
+#: pathology) to land inside the measured range rather than overflow.
+DEFAULT_LATENCY_BOUNDS: Tuple[float, ...] = tuple(
+    exponential_bounds(0.0001, 2.0, 21))
+
+#: Small-integer buckets (batch occupancy, queue depth): pow2 ladder
+#: 1..1024 — matches the micro-batcher's warmed shape ladder.
+POW2_COUNT_BOUNDS: Tuple[float, ...] = tuple(
+    float(1 << i) for i in range(11))
+
+
+class StreamingHistogram:
+    """Thread-safe fixed-bucket histogram.
+
+    ``bounds`` are strictly increasing *inclusive* upper bounds
+    (Prometheus ``le`` semantics); one overflow bucket is implicit.
+    """
+
+    __slots__ = ("bounds", "_counts", "_count", "_sum", "_min", "_max",
+                 "_lock")
+
+    def __init__(self,
+                 bounds: Optional[Sequence[float]] = None) -> None:
+        bs = tuple(float(b) for b in
+                   (bounds if bounds is not None
+                    else DEFAULT_LATENCY_BOUNDS))
+        if not bs or any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+            raise ValueError("bounds must be non-empty and strictly "
+                             "increasing")
+        self.bounds = bs
+        self._counts = [0] * (len(bs) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def record(self, value: float) -> None:
+        """O(1): one bisect over the fixed bounds + one increment."""
+        v = float(value)
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    # Prometheus naming for drop-in familiarity
+    observe = record
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self._count else 0.0
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs, ending with ``(inf, n)`` —
+        exactly the Prometheus exposition shape."""
+        with self._lock:
+            counts = list(self._counts)
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            out.append((b, cum))
+        out.append((math.inf, cum + counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the ``q``-quantile (``q`` in [0, 1]) by linear
+        interpolation inside the target bucket; None when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        with self._lock:
+            counts = list(self._counts)
+            n = self._count
+            lo_seen, hi_seen = self._min, self._max
+        if n == 0:
+            return None
+        target = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else min(
+                    lo_seen, self.bounds[0])
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else hi_seen)
+                hi = max(hi, lo)
+                v = lo + (hi - lo) * ((target - cum) / c)
+                # never report outside the observed range
+                return min(max(v, lo_seen), hi_seen)
+            cum += c
+        return hi_seen
+
+    def snapshot(self) -> Dict[str, float]:
+        """count/sum/mean/min/max plus the standard percentile trio."""
+        with self._lock:
+            n, s = self._count, self._sum
+        if n == 0:
+            return {"count": 0}
+        return {
+            "count": n,
+            "sum": s,
+            "mean": s / n,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf

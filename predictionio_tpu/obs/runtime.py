@@ -1,0 +1,137 @@
+"""TPU-native runtime gauges: XLA compiles, HBM occupancy, guard hits.
+
+ALX-style TPU serving treats HBM occupancy and recompile counts as
+first-class signals (PAPERS: Google ads-serving infrastructure) — a
+recompile storm or HBM creep shows up in the tail long before it shows
+up in an error log. These helpers register the process-level series on
+any :class:`.registry.MetricsRegistry`; everything degrades gracefully
+off-TPU (gauges read 0 or are simply absent).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .guard import TransferGuardCounter
+from .registry import MetricsRegistry
+
+
+def hbm_stats() -> List[Dict[str, object]]:
+    """Per-device HBM bytes in use / limit via ``device.memory_stats()``;
+    empty off-TPU (CPU PJRT returns None), when jax is absent, or when
+    no backend is initialized yet. NEVER initializes a backend itself:
+    an event/storage server scraping /metrics must not acquire the TPU
+    (operations.md "one chip, one tenant") just to report on it."""
+    import sys
+
+    if "jax" not in sys.modules:  # jax-free server: nothing to report,
+        return []                 # and a scrape must not pay the import
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized") \
+                and not xla_bridge.backends_are_initialized():
+            return []
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 — observability never requires jax
+        return []
+    out: List[Dict[str, object]] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — per-device degrade
+            stats = None
+        if not stats:
+            continue
+        out.append({
+            "device": str(d.id),
+            "kind": getattr(d, "device_kind", "unknown"),
+            "bytesInUse": int(stats.get("bytes_in_use", 0)),
+            "bytesLimit": int(stats.get("bytes_limit", 0) or
+                              stats.get("bytes_reservable_limit", 0)),
+            "peakBytesInUse": int(stats.get("peak_bytes_in_use", 0)),
+        })
+    return out
+
+
+def register_runtime_metrics(reg: MetricsRegistry, server: str,
+                             version: Optional[str] = None) -> None:
+    """Mount the standard process-level series on ``reg``:
+
+    - ``pio_build_info{server,version}`` — constant 1
+    - ``pio_process_start_time_seconds``
+    - ``pio_xla_compiles_total`` — lifetime XLA backend compiles
+      (:class:`..server.stats.RecompileSentinel` listener)
+    - ``pio_transfer_guard_violations_total`` — guard hits tallied by
+      :class:`.guard.TransferGuardCounter`
+    - ``pio_device_hbm_bytes{device,kind,stat=used|limit|peak}`` —
+      per-device HBM occupancy, absent off-TPU
+    """
+    # idempotent per registry: a second build_app over the same
+    # registry must not double-register the hbm/span collectors
+    # (duplicate series would make the exposition invalid)
+    if getattr(reg, "_runtime_mounted", False):
+        return
+    reg._runtime_mounted = True  # type: ignore[attr-defined]
+    if version is None:
+        try:
+            from .. import __version__ as version
+        except Exception:  # noqa: BLE001
+            version = "unknown"
+    reg.gauge("pio_build_info",
+              "Constant 1, labeled with server name and version"
+              ).labels(server=server, version=str(version)).set(1)
+    reg.gauge("pio_process_start_time_seconds",
+              "Unix time this server process started"
+              ).set(reg.start_time)
+
+    def _compiles_total() -> float:
+        # storage-only servers never import jax (the CLI skips it on
+        # purpose); a /metrics scrape must not be the thing that pays
+        # the import. When jax IS loaded, the sentinel's listener
+        # installs once and the gauge reads the shared tally.
+        import sys
+
+        if "jax" not in sys.modules:
+            return 0.0
+        from ..server.stats import RecompileSentinel
+
+        RecompileSentinel()  # idempotent listener install
+        return float(RecompileSentinel.total_compiles())
+
+    reg.gauge("pio_xla_compiles_total",
+              "XLA backend compiles observed in this process",
+              fn=_compiles_total)
+
+    TransferGuardCounter.install()
+    reg.gauge("pio_transfer_guard_violations_total",
+              "Transfer-guard hits (implicit device<->host transfers "
+              "observed under transfer_guard)",
+              fn=TransferGuardCounter.total)
+
+    # HBM is a render-time collector, not statically bound gauges:
+    # devices that come up AFTER the server mounts its registry (deploy
+    # initializes the backend when models land in HBM) still appear on
+    # the next scrape, and a device-less server emits nothing.
+    from .registry import escape_label_value, format_value
+
+    def _hbm_lines() -> List[str]:
+        stats = hbm_stats()
+        if not stats:
+            return []
+        lines = ["# HELP pio_device_hbm_bytes Per-device HBM occupancy "
+                 "from device.memory_stats(); absent off-TPU",
+                 "# TYPE pio_device_hbm_bytes gauge"]
+        for e in stats:
+            for key, stat in (("bytesInUse", "used"),
+                              ("bytesLimit", "limit"),
+                              ("peakBytesInUse", "peak")):
+                lines.append(
+                    'pio_device_hbm_bytes{device="%s",kind="%s",stat="%s"} %s'
+                    % (escape_label_value(str(e["device"])),
+                       escape_label_value(str(e["kind"])), stat,
+                       format_value(float(e[key]))))  # type: ignore[arg-type]
+        return lines
+
+    reg.register_collector(_hbm_lines)

@@ -14,6 +14,7 @@ from typing import Optional
 
 from ..data.storage.base import AccessKey, App
 from ..data.storage.registry import Storage, get_storage
+from ..obs import MetricsRegistry
 from .http import (
     AppServer,
     HTTPApp,
@@ -21,12 +22,19 @@ from .http import (
     Response,
     json_response,
     make_key_auth,
+    mount_metrics,
 )
 
 
 def build_app(storage: Optional[Storage] = None,
               accesskey: Optional[str] = None) -> HTTPApp:
     app = HTTPApp("adminserver")
+
+    # telemetry (ISSUE 2): the shared /metrics + /status.json mount
+    registry = MetricsRegistry()
+    mount_metrics(app, registry, server_name="adminserver",
+                  status=lambda: {"status": "alive"})
+    app.metrics_registry = registry  # type: ignore[attr-defined]
 
     def st() -> Storage:
         return storage if storage is not None else get_storage()

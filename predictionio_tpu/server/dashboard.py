@@ -14,7 +14,15 @@ from typing import Optional
 
 from ..data.event import utcnow
 from ..data.storage.registry import Storage, get_storage
-from .http import AppServer, HTTPApp, Request, Response, SessionAuth
+from ..obs import MetricsRegistry
+from .http import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    SessionAuth,
+    mount_metrics,
+)
 
 
 def build_app(storage: Optional[Storage] = None,
@@ -22,6 +30,13 @@ def build_app(storage: Optional[Storage] = None,
               secure: bool = False) -> HTTPApp:
     app = HTTPApp("dashboard")
     start_time = utcnow()
+
+    # telemetry (ISSUE 2): the dashboard scrapes like every other
+    # server; its index page surfaces the percentile table
+    registry = MetricsRegistry()
+    mount_metrics(app, registry, server_name="dashboard",
+                  status=lambda: {"status": "alive"})
+    app.metrics_registry = registry  # type: ignore[attr-defined]
 
     def st() -> Storage:
         return storage if storage is not None else get_storage()
@@ -56,13 +71,36 @@ def build_app(storage: Optional[Storage] = None,
                 f"evaluator_results.json'>JSON</a> "
                 f"<a href='/engine_instances/{esc(i.id)}/"
                 f"evaluator_results.txt'>TXT</a></td></tr>")
+        # request-latency percentile table from this server's own
+        # registry (ISSUE 2: tails on the dashboard, not just uptime)
+        lat_rows = []
+        hist = registry.snapshot().get(
+            "pio_http_request_duration_seconds") or {}
+        if isinstance(hist, dict) and "count" in hist:
+            hist = {"(all)": hist}
+        for route, s in sorted(hist.items()):
+            if not isinstance(s, dict) or not s.get("count"):
+                continue
+            lat_rows.append(
+                f"<tr><td>{_html.escape(str(route))}</td>"
+                f"<td>{s['count']}</td>"
+                f"<td>{s['p50'] * 1000:.3f}</td>"
+                f"<td>{s['p90'] * 1000:.3f}</td>"
+                f"<td>{s['p99'] * 1000:.3f}</td></tr>")
+        lat_table = (
+            "<h2>Request latency percentiles</h2>"
+            "<table border='1'><tr><th>route</th><th>count</th>"
+            "<th>p50 (ms)</th><th>p90 (ms)</th><th>p99 (ms)</th></tr>"
+            + "".join(lat_rows) + "</table>"
+            "<p><a href='/metrics'>Prometheus metrics</a></p>"
+            if lat_rows else "")
         body = (
             "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
             f"<body><h1>Evaluation history</h1>"
             f"<p>Dashboard up since {start_time}</p>"
             "<table border='1'><tr><th>ID</th><th>Start</th><th>End</th>"
             "<th>Evaluation</th><th>Result</th><th>Details</th></tr>"
-            + "".join(rows) + "</table></body></html>")
+            + "".join(rows) + "</table>" + lat_table + "</body></html>")
         return Response(status=200, body=body,
                         content_type="text/html; charset=utf-8",
                         headers=headers)

@@ -31,6 +31,12 @@ from ..controller.engine import Engine
 from ..controller.params import EngineParams
 from ..data.event import Event, utcnow
 from ..data.storage.base import EngineInstance
+from ..obs import (
+    DEFAULT_LATENCY_BOUNDS,
+    POW2_COUNT_BOUNDS,
+    MetricsRegistry,
+    hbm_stats,
+)
 from ..utils.jsonutil import from_jsonable, to_jsonable
 from .http import (
     AppServer,
@@ -40,6 +46,7 @@ from .http import (
     Response,
     json_response,
     make_key_auth,
+    mount_metrics,
 )
 from .plugins import EngineServerPlugins
 
@@ -124,12 +131,46 @@ class QueryServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        # telemetry (ISSUE 2): the engine server's metric registry —
+        # per-phase query-path histograms plus the batcher's occupancy
+        # and queue-depth series. QueryServer owns it so direct query()
+        # callers (tests, batch jobs) record the same series HTTP
+        # traffic does; build_app mounts it on /metrics.
+        self.metrics = MetricsRegistry()
+        self._phase_hist = self.metrics.histogram(
+            "pio_query_phase_seconds",
+            "Per-phase query-path wall time (queue_wait, assemble, "
+            "supplement, dispatch, serve, readback, feedback)",
+            bounds=DEFAULT_LATENCY_BOUNDS)
+        self._latency_hist = self.metrics.histogram(
+            "pio_query_latency_seconds",
+            "End-to-end serving wall time per query",
+            bounds=DEFAULT_LATENCY_BOUNDS)
+        self._batch_occupancy = self.metrics.histogram(
+            "pio_batch_occupancy",
+            "Queries coalesced per micro-batch dispatch",
+            bounds=POW2_COUNT_BOUNDS)
+        self._queue_depth = self.metrics.histogram(
+            "pio_queue_depth",
+            "Batcher queue depth observed at each batch pickup",
+            bounds=POW2_COUNT_BOUNDS)
+        self._query_errors = self.metrics.counter(
+            "pio_query_errors_total", "Failed queries by status class")
         # recompile sentinel: armed when warmup finishes, so every
         # compile after that is a query paying a trace it shouldn't
         # (the runtime half of ptpu check's recompile-hazard lint)
         from .stats import RecompileSentinel
         self.recompile_sentinel = RecompileSentinel()
         self.warm_done = threading.Event()
+        self.metrics.gauge(
+            "pio_compiles_since_warm",
+            "XLA compiles after serving warmup finished — every one is "
+            "traffic paying a trace it should not",
+            fn=lambda: self.recompile_sentinel.since_armed)
+        self.metrics.gauge(
+            "pio_serving_warm",
+            "1 once the serving shapes are pre-compiled",
+            fn=lambda: 1.0 if self.warm_done.is_set() else 0.0)
         self._warm_gen = 0  # stale warm threads must not set the event
         if self.config.warm_start:
             threading.Thread(target=self._warm_serving, args=(0,),
@@ -199,14 +240,46 @@ class QueryServer:
         except Exception:  # noqa: BLE001 — observability, never a dep
             return nullcontext()
 
+    def _record_phases(self, phases: dict) -> None:
+        for phase, sec in phases.items():
+            self._phase_hist.labels(phase=phase).observe(sec)
+
+    def spans_summary(self) -> dict:
+        """Percentile rows for the status page: each query phase plus
+        end-to-end latency, from the live bounded histograms."""
+        out: dict = {}
+
+        def row(hist) -> Optional[dict]:
+            s = hist.snapshot()
+            if not s.get("count"):
+                return None
+            return {"count": s["count"], "p50": s["p50"],
+                    "p90": s["p90"], "p99": s["p99"],
+                    "max_sec": s["max"]}
+
+        for items, child in self._phase_hist.children():
+            r = row(child)
+            if r is not None:
+                out["phase:" + dict(items).get("phase", "?")] = r
+        for items, child in self._latency_hist.children():
+            r = row(child)
+            if r is not None:
+                out["query (end-to-end)"] = r
+        return out
+
     # -- batched hot path ---------------------------------------------------
-    def query_batch(self, query_jsons: List[Any]) -> List[Any]:
+    def query_batch(self, query_jsons: List[Any],
+                    obs_list: Optional[List[dict]] = None) -> List[Any]:
         """Serve many queries with ONE ``batch_predict`` device dispatch
         per algorithm. Per-query errors come back as ``HTTPError``s in the
-        result slots so one bad query never fails its batch-mates."""
+        result slots so one bad query never fails its batch-mates.
+        ``obs_list`` (one dict per query, from the batcher) receives each
+        query's access-log payload: the shared batch phase timings plus
+        its own readback/feedback time."""
         from ..workflow.batch_predict import predict_serve_batch
 
         t0 = time.monotonic()
+        phases: dict = {}
         with self._lock:
             algorithms, models, serving = \
                 self.algorithms, self.models, self.serving
@@ -221,25 +294,52 @@ class QueryServer:
                 ok_rows.append(i)
             except (TypeError, ValueError) as e:
                 out[i] = HTTPError(400, str(e))
+        phases["assemble"] = time.monotonic() - t0
+        per_query_ms: List[dict] = [{} for _ in query_jsons]
         if ok_rows:
             with self._transfer_guard():
                 served = predict_serve_batch(algorithms, models, serving,
-                                             parsed)
+                                             parsed, timings=phases)
             for j, i in enumerate(ok_rows):
                 prediction = served[j]
                 if isinstance(prediction, Exception):
                     out[i] = HTTPError(500, str(prediction))
                     continue
                 try:
+                    tr0 = time.monotonic()
                     result = to_jsonable(prediction)
+                    tr1 = time.monotonic()
+                    phases["readback"] = (phases.get("readback", 0.0)
+                                          + (tr1 - tr0))
+                    per_query_ms[i]["readbackMs"] = round(
+                        (tr1 - tr0) * 1000, 3)
                     if self.config.feedback:
                         result = self._feedback(parsed[j], query_jsons[i],
                                                 result, instance_id)
+                        tf = time.monotonic() - tr1
+                        phases["feedback"] = (phases.get("feedback", 0.0)
+                                              + tf)
+                        per_query_ms[i]["feedbackMs"] = round(tf * 1000, 3)
                     out[i] = self.plugins.process_output(query_jsons[i],
                                                          result)
                 except Exception as e:  # noqa: BLE001 — per-query slot
                     out[i] = HTTPError(500, str(e))
         dt = time.monotonic() - t0
+        self._record_phases(phases)
+        self._batch_occupancy.observe(len(query_jsons))
+        batch_obs = {"batchSize": len(query_jsons)}
+        batch_obs.update({f"{k}Ms": round(v * 1000, 3)
+                          for k, v in phases.items()})
+        for i, result in enumerate(out):
+            # each coalesced query experienced the batch's wall time
+            self._latency_hist.observe(dt)
+            if isinstance(result, HTTPError):
+                self._query_errors.labels(
+                    status=str(result.status)).inc()
+            if obs_list is not None and i < len(obs_list) \
+                    and obs_list[i] is not None:
+                obs_list[i].update(batch_obs)
+                obs_list[i].update(per_query_ms[i])
         with self._lock:
             self.last_serving_sec = dt / max(len(query_jsons), 1)
             n = self.request_count
@@ -250,8 +350,9 @@ class QueryServer:
         return out
 
     # -- the per-query hot path (CreateServer.scala:484-633) ---------------
-    def query(self, query_json: Any) -> Any:
+    def query(self, query_json: Any, obs: Optional[dict] = None) -> Any:
         t0 = time.monotonic()
+        phases: dict = {}
         with self._lock:
             algorithms, models, serving = \
                 self.algorithms, self.models, self.serving
@@ -260,21 +361,44 @@ class QueryServer:
         try:
             query = from_jsonable(query_cls, query_json)
         except (TypeError, ValueError) as e:
+            self._query_errors.labels(status="400").inc()
             raise HTTPError(400, str(e))
-        with self._transfer_guard():
-            supplemented = serving.supplement(query)
-            predictions = [a.predict(m, supplemented)
-                           for a, m in zip(algorithms, models)]
-            # by design: serve sees the original query
-            # (CreateServer.scala:511)
-            prediction = serving.serve(query, predictions)
-        result = to_jsonable(prediction)
+        t1 = time.monotonic()
+        phases["assemble"] = t1 - t0
+        try:
+            with self._transfer_guard():
+                supplemented = serving.supplement(query)
+                t2 = time.monotonic()
+                phases["supplement"] = t2 - t1
+                predictions = [a.predict(m, supplemented)
+                               for a, m in zip(algorithms, models)]
+                t3 = time.monotonic()
+                phases["dispatch"] = t3 - t2
+                # by design: serve sees the original query
+                # (CreateServer.scala:511)
+                prediction = serving.serve(query, predictions)
+                t4 = time.monotonic()
+                phases["serve"] = t4 - t3
+            result = to_jsonable(prediction)
+            t5 = time.monotonic()
+            phases["readback"] = t5 - t4
 
-        if self.config.feedback:
-            result = self._feedback(query, query_json, result, instance_id)
-        result = self.plugins.process_output(query_json, result)
+            if self.config.feedback:
+                result = self._feedback(query, query_json, result,
+                                        instance_id)
+                phases["feedback"] = time.monotonic() - t5
+            result = self.plugins.process_output(query_json, result)
+        except Exception:
+            self._query_errors.labels(status="500").inc()
+            self._record_phases(phases)
+            raise
 
         dt = time.monotonic() - t0
+        self._record_phases(phases)
+        self._latency_hist.observe(dt)
+        if obs is not None:
+            obs.update({f"{k}Ms": round(v * 1000, 3)
+                        for k, v in phases.items()})
         with self._lock:
             self.last_serving_sec = dt
             self.avg_serving_sec = (
@@ -379,9 +503,39 @@ def build_app(server: QueryServer) -> HTTPApp:
 
     _auth = make_key_auth(cfg.accesskey)
 
+    def _phase_table() -> dict:
+        """p50/p90/p99 per phase + end-to-end, from the live registry."""
+        snap = server.metrics.snapshot()
+        out = {}
+        for key, label in (("pio_query_phase_seconds", "phases"),
+                           ("pio_query_latency_seconds", "latency"),
+                           ("pio_batch_occupancy", "batchOccupancy"),
+                           ("pio_queue_depth", "queueDepth")):
+            v = snap.get(key)
+            if v:
+                out[label] = v
+        return out
+
     @app.route("GET", "/")
     def index(req: Request) -> Response:
         inst = server.instance
+        # percentile latency table (ISSUE 2): the status page shows
+        # tails, not just means
+        rows = []
+        for name, s in sorted(
+                server.spans_summary().items()):
+            rows.append(
+                f"<tr><td>{html.escape(name)}</td><td>{s['count']}</td>"
+                f"<td>{s['p50'] * 1000:.3f}</td>"
+                f"<td>{s['p90'] * 1000:.3f}</td>"
+                f"<td>{s['p99'] * 1000:.3f}</td>"
+                f"<td>{s['max_sec'] * 1000:.3f}</td></tr>")
+        table = (
+            "<h2>Latency percentiles</h2>"
+            "<table border='1'><tr><th>series</th><th>count</th>"
+            "<th>p50 (ms)</th><th>p90 (ms)</th><th>p99 (ms)</th>"
+            "<th>max (ms)</th></tr>" + "".join(rows) + "</table>"
+            if rows else "")
         body = f"""<html><head><title>{html.escape(inst.engine_id)} \
 - predictionio_tpu engine server</title></head><body>
 <h1>Engine: {html.escape(inst.engine_id)} v{html.escape(inst.engine_version)}</h1>
@@ -392,11 +546,16 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>requests served: {server.request_count}</li>
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
-</ul></body></html>"""
+<li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
+</ul>{table}
+<p><a href="/metrics">Prometheus metrics</a> ·
+<a href="/status.json">status.json</a></p></body></html>"""
         return Response(body=body, content_type="text/html")
 
     @app.route("GET", "/status.json")
     def status(req: Request) -> Response:
+        from ..obs import TransferGuardCounter
+
         return json_response({
             "engineId": server.instance.engine_id,
             "engineVersion": server.instance.engine_version,
@@ -406,7 +565,10 @@ def build_app(server: QueryServer) -> HTTPApp:
             "lastServingSec": server.last_serving_sec,
             "servingWarm": server.warm_done.is_set(),
             "transferGuard": cfg.transfer_guard or "off",
+            "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
+            "hbm": hbm_stats(),
+            **_phase_table(),
         })
 
     @app.route("POST", "/queries.json")
@@ -417,11 +579,11 @@ def build_app(server: QueryServer) -> HTTPApp:
             raise HTTPError(400, str(e))
         try:
             if batcher is not None:
-                result = batcher.submit(query_json)
+                result = batcher.submit(query_json, obs=req.obs)
                 if isinstance(result, HTTPError):
                     raise result
                 return json_response(result)
-            return json_response(server.query(query_json))
+            return json_response(server.query(query_json, obs=req.obs))
         except HTTPError as e:
             # batch-wide failures are logged ONCE by the batcher, not by
             # each of the coalesced handler threads
@@ -475,6 +637,10 @@ def build_app(server: QueryServer) -> HTTPApp:
             req.path_params["rest"])
         return json_response(plugin.handle_rest(args))
 
+    # /metrics + request instrumentation through the server's own
+    # registry (the engine server keeps its bespoke /status.json above)
+    mount_metrics(app, server.metrics, server_name="engineserver")
+
     app_server_ref: List[AppServer] = []
     app._server_ref = app_server_ref  # type: ignore[attr-defined]
     return app
@@ -512,10 +678,10 @@ class MicroBatcher:
         for t in self._threads:
             t.start()
 
-    def submit(self, query_json: Any) -> Any:
+    def submit(self, query_json: Any, obs: Optional[dict] = None) -> Any:
         done = threading.Event()
         slot: List[Any] = [None]
-        self._q.put((query_json, done, slot))
+        self._q.put((query_json, done, slot, time.monotonic(), obs))
         done.wait()
         return slot[0]
 
@@ -524,6 +690,10 @@ class MicroBatcher:
 
         while True:
             first = self._q.get()
+            # queue depth at pickup: how much backlog this batch found —
+            # the arrival-rate × service-time signal the round-4
+            # unbounded-backlog pathology would have shown immediately
+            self.server._queue_depth.observe(self._q.qsize() + 1)
             batch = [first]
             waited = False
             while len(batch) < self.max_batch:
@@ -540,14 +710,24 @@ class MicroBatcher:
                         batch.append(self._q.get(timeout=self.window))
                     except queue.Empty:
                         break
+            t_pick = time.monotonic()
+            phase = self.server._phase_hist.labels(phase="queue_wait")
+            obs_list: List[Optional[dict]] = []
+            for _, _, _, t_enq, obs in batch:
+                wait = t_pick - t_enq
+                phase.observe(wait)
+                if obs is not None:
+                    obs["queueWaitMs"] = round(wait * 1000, 3)
+                obs_list.append(obs)
             try:
-                results = self.server.query_batch([b[0] for b in batch])
+                results = self.server.query_batch(
+                    [b[0] for b in batch], obs_list=obs_list)
             except Exception as e:  # noqa: BLE001 — isolate to this batch
                 self.server.remote_log(str(e))  # once for the whole batch
                 err = HTTPError(500, str(e))
                 err._remote_logged = True
                 results = [err] * len(batch)
-            for (_, done, slot), result in zip(batch, results):
+            for (_, done, slot, _, _), result in zip(batch, results):
                 slot[0] = result
                 done.set()
 

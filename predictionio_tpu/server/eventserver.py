@@ -25,7 +25,16 @@ from ..data.webhooks import (
     json_connectors,
     to_event,
 )
-from .http import AppServer, HTTPApp, HTTPError, Request, Response, json_response
+from ..obs import MetricsRegistry
+from .http import (
+    AppServer,
+    HTTPApp,
+    HTTPError,
+    Request,
+    Response,
+    json_response,
+    mount_metrics,
+)
 from .plugins import EventServerPlugins
 from .stats import StatsCollector
 
@@ -81,6 +90,20 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
     plug = plugins or EventServerPlugins()
     app = HTTPApp("eventserver")
 
+    # telemetry (ISSUE 2): event-ingest counters + the shared runtime
+    # series; /metrics and an enriched /status.json via mount_metrics
+    registry = MetricsRegistry()
+    registry.gauge("pio_stats_enabled",
+                   "1 when the --stats per-app collector is on"
+                   ).set(1.0 if stats else 0.0)
+    ingested = registry.counter(
+        "pio_events_ingested_total",
+        "Events accepted into the store, by ingest route")
+    mount_metrics(app, registry, server_name="eventserver",
+                  status=lambda: {"status": "alive",
+                                  "statsEnabled": bool(collector)})
+    app.metrics_registry = registry  # type: ignore[attr-defined]
+
     def _auth(req: Request) -> AuthData:
         return authenticate(st, req)
 
@@ -121,6 +144,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                 {"message": f"{event.event} events are not allowed"}, 403)
         plug.process_input(auth.app_id, auth.channel_id, event)
         event_id = st.events().insert(event, auth.app_id, auth.channel_id)
+        ingested.labels(route="events").inc()
         if collector:
             collector.bookkeeping(auth.app_id, 201, event)
         return json_response({"eventId": event_id}, 201)
@@ -197,6 +221,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
             if ids is not None:
                 for (pos, event), eid in zip(valid, ids):
                     results[pos] = {"status": 201, "eventId": eid}
+                    ingested.labels(route="batch").inc()
                     if collector:
                         collector.bookkeeping(auth.app_id, 201, event)
             else:
@@ -205,6 +230,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                         eid = st.events().insert(event, auth.app_id,
                                                  auth.channel_id)
                         results[pos] = {"status": 201, "eventId": eid}
+                        ingested.labels(route="batch").inc()
                         if collector:
                             collector.bookkeeping(auth.app_id, 201, event)
                     except Exception as e:  # noqa: BLE001
@@ -215,9 +241,17 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
     def get_stats(req: Request) -> Response:
         auth = _auth(req)
         if collector is None:
+            # runtime hint (ISSUE 2 satellite): the toggle is
+            # boot-time-only, so the 404 explains exactly how to turn
+            # it on; /status.json and /metrics carry the same state
             return json_response(
                 {"message": "To see stats, launch Event Server with --stats "
-                            "argument."}, 404)
+                            "argument.",
+                 "statsEnabled": False,
+                 "hint": "Restart with `ptpu eventserver --stats` — the "
+                         "collector only exists when enabled at boot. "
+                         "Aggregate counters are always available at "
+                         "/metrics and /status.json."}, 404)
         return json_response(collector.get(auth.app_id))
 
     @app.route("GET", r"/events/(?P<event_id>[^/]+)\.json")
@@ -252,6 +286,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
         except (ConnectorException, EventValidationError, ValueError) as e:
             raise HTTPError(400, str(e))
         event_id = st.events().insert(event, auth.app_id, auth.channel_id)
+        ingested.labels(route="webhook").inc()
         if collector:
             collector.bookkeeping(auth.app_id, 201, event)
         return json_response({"eventId": event_id}, 201)

@@ -11,14 +11,23 @@ system, no external dependencies, good enough for host-side control planes
 from __future__ import annotations
 
 import json
+import logging
 import re
+import secrets
 import threading
+import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-__all__ = ["Request", "Response", "HTTPApp", "AppServer", "json_response"]
+__all__ = ["Request", "Response", "HTTPApp", "AppServer", "json_response",
+           "mount_metrics"]
+
+#: Structured JSON access log — one line per request with the request id
+#: and any per-phase timings the handler attached (``Request.obs``).
+#: Quiet unless the operator enables INFO on this logger.
+access_log = logging.getLogger("predictionio_tpu.access")
 
 
 @dataclass
@@ -30,6 +39,13 @@ class Request:
     body: bytes
     #: Named groups from the route pattern match.
     path_params: Dict[str, str] = field(default_factory=dict)
+    #: Per-request id: echoed from an ``X-Request-ID`` header or minted
+    #: here, attached to the access-log line and the response so any
+    #: slow query can be decomposed post-hoc.
+    request_id: str = ""
+    #: Handler-attached observability payload (per-phase timings etc.);
+    #: merged into this request's access-log line.
+    obs: Dict[str, Any] = field(default_factory=dict)
 
     def json(self) -> Any:
         if not self.body:
@@ -180,37 +196,80 @@ Handler = Callable[[Request], Response]
 
 
 class HTTPApp:
-    """Routes ``(method, path-regex) → handler``; first match wins."""
+    """Routes ``(method, path-regex) → handler``; first match wins.
+
+    When a :class:`~predictionio_tpu.obs.MetricsRegistry` is mounted
+    (:func:`mount_metrics`), every request is timed into a per-route
+    latency histogram, counted by status, stamped with a request id, and
+    logged as one structured JSON access-log line.
+    """
 
     def __init__(self, name: str = "app"):
         self.name = name
-        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._routes: List[Tuple[str, re.Pattern, str, Handler]] = []
+        self.metrics = None  # set by mount_metrics
+        self._http_hist = None
+        self._http_count = None
 
     def route(self, method: str, pattern: str) -> Callable[[Handler], Handler]:
         compiled = re.compile(f"^{pattern}$")
 
         def deco(fn: Handler) -> Handler:
-            self._routes.append((method.upper(), compiled, fn))
+            self._routes.append((method.upper(), compiled, pattern, fn))
             return fn
         return deco
 
-    def handle(self, req: Request) -> Response:
+    def enable_metrics(self, registry) -> None:
+        """Record per-route request latency/status into ``registry``."""
+        self.metrics = registry
+        self._http_hist = registry.histogram(
+            "pio_http_request_duration_seconds",
+            "HTTP request wall time by route")
+        self._http_count = registry.counter(
+            "pio_http_requests_total",
+            "HTTP requests by route, method, and status code")
+
+    def _dispatch(self, req: Request) -> Tuple[Response, str]:
+        """Route + run the handler; returns (response, route pattern —
+        the bounded-cardinality label, never the raw path)."""
         path_matched = False
-        for method, pattern, fn in self._routes:
+        for method, pattern, raw, fn in self._routes:
             m = pattern.match(req.path)
             if m:
                 path_matched = True
                 if method == req.method:
                     req.path_params = m.groupdict()
                     try:
-                        return fn(req)
+                        return fn(req), raw
                     except HTTPError as e:
-                        return json_response({"message": e.message}, e.status)
+                        return (json_response({"message": e.message},
+                                              e.status), raw)
                     except Exception as e:  # noqa: BLE001 — server boundary
-                        return json_response({"message": str(e)}, 500)
+                        return json_response({"message": str(e)}, 500), raw
         if path_matched:
-            return json_response({"message": "Method Not Allowed"}, 405)
-        return json_response({"message": "Not Found"}, 404)
+            return json_response({"message": "Method Not Allowed"},
+                                 405), "(method-not-allowed)"
+        return json_response({"message": "Not Found"}, 404), "(unmatched)"
+
+    def handle(self, req: Request) -> Response:
+        req.request_id = (req.headers.get("X-Request-ID")
+                          or secrets.token_hex(8))
+        t0 = time.monotonic()
+        resp, route = self._dispatch(req)
+        dt = time.monotonic() - t0
+        resp.headers.setdefault("X-Request-ID", req.request_id)
+        if self.metrics is not None:
+            self._http_hist.labels(route=route).observe(dt)
+            self._http_count.labels(route=route, method=req.method,
+                                    status=str(resp.status)).inc()
+        if access_log.isEnabledFor(logging.INFO):
+            line = {"server": self.name, "requestId": req.request_id,
+                    "method": req.method, "path": req.path,
+                    "status": resp.status,
+                    "durationMs": round(dt * 1000, 3)}
+            line.update(req.obs)
+            access_log.info(json.dumps(line))
+        return resp
 
 
 class HTTPError(Exception):
@@ -220,6 +279,42 @@ class HTTPError(Exception):
         super().__init__(message)
         self.status = status
         self.message = message
+
+
+def mount_metrics(app: HTTPApp, registry, server_name: Optional[str] = None,
+                  status: Optional[Callable[[], Dict[str, Any]]] = None,
+                  runtime: bool = True) -> None:
+    """The shared telemetry mount every server goes through:
+
+    - instruments the app's request path (latency histogram, status
+      counters, request ids, access log) via :meth:`HTTPApp.enable_metrics`
+    - registers the standard runtime series (build info, XLA compiles,
+      transfer-guard violations, per-device HBM) and the global
+      ``timed(name)`` span registry
+    - adds ``GET /metrics`` — Prometheus text format 0.0.4
+    - when ``status`` is given, adds ``GET /status.json`` returning its
+      dict enriched with the registry snapshot (servers with a bespoke
+      status route — the engine server — pass ``status=None`` and
+      enrich their own)
+    """
+    from ..obs import mount_span_metrics, register_runtime_metrics
+
+    if runtime:
+        register_runtime_metrics(registry, server_name or app.name)
+        mount_span_metrics(registry)
+    app.enable_metrics(registry)
+
+    @app.route("GET", "/metrics")
+    def metrics(req: Request) -> Response:
+        return Response(
+            body=registry.render(),
+            content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    if status is not None:
+        @app.route("GET", "/status.json")
+        def status_json(req: Request) -> Response:
+            return json_response(dict(status(),
+                                      metrics=registry.snapshot()))
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -42,8 +42,9 @@ from ..data.storage.wire import (
     entity_to_doc,
     filter_from_doc,
 )
+from ..obs import MetricsRegistry
 from .http import AppServer, HTTPApp, HTTPError, Request, Response, \
-    json_response
+    json_response, mount_metrics
 
 log = logging.getLogger("predictionio_tpu.storageserver")
 
@@ -129,6 +130,20 @@ def _batch_version(batch, memo_key=None) -> str:
 
 def build_app(storage: Storage, secret: Optional[str] = None) -> HTTPApp:
     app = HTTPApp("storageserver")
+
+    # telemetry (ISSUE 2): columnar-read cache efficiency + payload
+    # volume ride beside the shared per-route latency histograms —
+    # steady-state pod-host training should be ~all ETag hits
+    registry = MetricsRegistry()
+    columnar_reqs = registry.counter(
+        "pio_columnar_requests_total",
+        "Columnar bulk reads by outcome (hit = 304 ETag match)")
+    columnar_bytes = registry.counter(
+        "pio_columnar_bytes_total",
+        "npz payload bytes served by columnar bulk reads")
+    mount_metrics(app, registry, server_name="storageserver",
+                  status=lambda: {"status": "alive"})
+    app.metrics_registry = registry  # type: ignore[attr-defined]
 
     def hdr(req: Request, name: str) -> str:
         # Request.headers preserves as-sent case; match insensitively
@@ -279,8 +294,12 @@ def build_app(storage: Storage, secret: Optional[str] = None) -> HTTPApp:
             headers["X-Shard-Total"] = str(
                 getattr(batch, "shard_total", batch.n))
         if hdr(req, "if-none-match") == version:
+            columnar_reqs.labels(outcome="hit").inc()
             return Response(status=304, body=b"", headers=headers)
-        return Response(status=200, body=batch_to_npz(batch),
+        payload = batch_to_npz(batch)
+        columnar_reqs.labels(outcome="miss").inc()
+        columnar_bytes.inc(len(payload))
+        return Response(status=200, body=payload,
                         content_type="application/octet-stream",
                         headers=headers)
 

@@ -14,7 +14,9 @@ import contextlib
 import logging
 import threading
 import time
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, Optional
+
+from ..obs.histogram import StreamingHistogram
 
 log = logging.getLogger(__name__)
 
@@ -51,27 +53,58 @@ def annotate(name: str) -> Iterator[None]:
 
 
 class SpanRegistry:
-    """Thread-safe wall-clock span collection (count/total/max per name)."""
+    """Thread-safe wall-clock span collection, bounded per name.
+
+    Round-1 kept a raw ``List[float]`` per span — unbounded memory on a
+    long-lived server. Each name is now one fixed-bucket
+    :class:`~predictionio_tpu.obs.histogram.StreamingHistogram`:
+    ``record`` is O(1), memory is constant however many observations
+    arrive, and :meth:`summary` gains p50/p90/p99 while keeping the
+    original ``count/total_sec/mean_sec/max_sec`` keys.
+    """
+
+    #: a runaway caller generating span names per request must not grow
+    #: the registry without bound; past this, records fold into one
+    #: overflow bucket (visible, not silent)
+    MAX_SPAN_NAMES = 1024
+    _OVERFLOW = "(overflow)"
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._spans: Dict[str, List[float]] = {}
+        self._spans: Dict[str, StreamingHistogram] = {}
 
     def record(self, name: str, seconds: float) -> None:
         with self._lock:
-            self._spans.setdefault(name, []).append(seconds)
+            hist = self._spans.get(name)
+            if hist is None:
+                if len(self._spans) >= self.MAX_SPAN_NAMES:
+                    name = self._OVERFLOW
+                    hist = self._spans.get(name)
+                if hist is None:
+                    hist = self._spans[name] = StreamingHistogram()
+        hist.record(seconds)
+
+    def histograms(self) -> Dict[str, StreamingHistogram]:
+        """Live per-name histograms (the /metrics exposition bridge)."""
+        with self._lock:
+            return dict(self._spans)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        with self._lock:
-            return {
-                name: {
-                    "count": len(xs),
-                    "total_sec": sum(xs),
-                    "mean_sec": sum(xs) / len(xs),
-                    "max_sec": max(xs),
-                }
-                for name, xs in self._spans.items() if xs
+        out: Dict[str, Dict[str, float]] = {}
+        for name, h in self.histograms().items():
+            if not h.count:
+                continue
+            s = h.snapshot()
+            out[name] = {
+                "count": s["count"],
+                "total_sec": s["sum"],
+                "mean_sec": s["mean"],
+                "max_sec": s["max"],
+                "p50": s["p50"],
+                "p90": s["p90"],
+                "p99": s["p99"],
             }
+        return out
 
     def reset(self) -> None:
         with self._lock:

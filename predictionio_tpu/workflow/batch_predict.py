@@ -12,7 +12,8 @@ query per dispatch.
 from __future__ import annotations
 
 import json
-from typing import Any, Iterable, Iterator, List, Optional, TextIO
+import time
+from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 from ..controller.context import Context
 from ..controller.engine import Engine
@@ -22,22 +23,31 @@ from ..utils.jsonutil import from_jsonable, to_jsonable
 
 
 def predict_serve_batch(algorithms: List[Any], models: List[Any],
-                        serving: Any, queries: List[Any]) -> List[Any]:
+                        serving: Any, queries: List[Any],
+                        timings: Optional[Dict[str, float]] = None
+                        ) -> List[Any]:
     """The batched serving pipeline shared by the engine server's
     micro-batcher and the batch-predict job: supplement each query, ONE
     ``batch_predict`` device dispatch per algorithm, then serve per
     query. Per-query failures (supplement/serve) come back as the raised
     exception in that query's slot; a ``batch_predict`` failure fills
-    every live slot (it is one dispatch)."""
+    every live slot (it is one dispatch). When ``timings`` is given, the
+    wall time of each internal phase is accumulated into it under
+    ``supplement``/``dispatch``/``serve`` (the engine server's per-phase
+    telemetry reads these)."""
     out: List[Any] = [None] * len(queries)
     supplemented: List[Any] = []
     live: List[int] = []
+    t0 = time.monotonic()
     for i, q in enumerate(queries):
         try:
             supplemented.append(serving.supplement(q))
             live.append(i)
         except Exception as e:  # noqa: BLE001 — isolate to this query
             out[i] = e
+    t1 = time.monotonic()
+    if timings is not None:
+        timings["supplement"] = timings.get("supplement", 0.0) + (t1 - t0)
     if live:
         try:
             per_algo = [a.batch_predict(m, supplemented)
@@ -46,6 +56,11 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
             for i in live:
                 out[i] = e
             return out
+        finally:
+            t2 = time.monotonic()
+            if timings is not None:
+                timings["dispatch"] = (timings.get("dispatch", 0.0)
+                                       + (t2 - t1))
         for row, i in enumerate(live):
             try:
                 # serve sees the original query (CreateServer.scala:511)
@@ -53,6 +68,9 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
                                        [preds[row] for preds in per_algo])
             except Exception as e:  # noqa: BLE001
                 out[i] = e
+        if timings is not None:
+            timings["serve"] = (timings.get("serve", 0.0)
+                                + (time.monotonic() - t2))
     return out
 
 

@@ -516,7 +516,7 @@ class TestServingRuntimeWiring:
 
         server = _make_query_server(transfer_guard="log")
         app = build_app(server)
-        route = next(h for m, _, h in app._routes
+        route = next(h for m, _, _, h in app._routes
                      if getattr(h, "__name__", "") == "status")
         doc = route(None).body
         assert doc["transferGuard"] == "log"

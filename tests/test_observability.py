@@ -1,0 +1,627 @@
+"""Unified telemetry tests (ISSUE 2): histogram math, Prometheus
+exposition validity, per-phase spans through a real in-process engine
+server (batched and unbatched), transfer-guard counter wiring, and
+memory-boundedness of the span registry under 100k records."""
+
+import json
+import logging
+import re
+import sys
+import threading
+import urllib.error
+import urllib.request
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import (
+    DEFAULT_LATENCY_BOUNDS,
+    MetricsRegistry,
+    StreamingHistogram,
+    TransferGuardCounter,
+    exponential_bounds,
+    linear_bounds,
+)
+from predictionio_tpu.utils.tracing import SpanRegistry, timed
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket / percentile math
+# ---------------------------------------------------------------------------
+
+class TestStreamingHistogram:
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError):
+            StreamingHistogram([])
+        with pytest.raises(ValueError):
+            StreamingHistogram([1.0, 1.0])
+        with pytest.raises(ValueError):
+            StreamingHistogram([2.0, 1.0])
+        with pytest.raises(ValueError):
+            exponential_bounds(0, 2, 3)
+        with pytest.raises(ValueError):
+            linear_bounds(0, -1, 3)
+
+    def test_bucket_assignment_le_semantics(self):
+        h = StreamingHistogram([1.0, 2.0, 4.0])
+        for v in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 100.0):
+            h.record(v)
+        # cumulative: le=1 → {0.5, 1.0}; le=2 → +{1.5, 2.0};
+        # le=4 → +{3.0, 4.0}; +Inf → +{100.0}
+        assert h.bucket_counts() == [
+            (1.0, 2), (2.0, 4), (4.0, 6), (float("inf"), 7)]
+        assert h.count == 7
+        assert h.max == 100.0
+        assert h.min == 0.5
+        assert h.sum == pytest.approx(112.0)
+
+    def test_percentiles_uniform_distribution(self):
+        # 1..1000 into fine linear buckets: interpolation error is
+        # bounded by one bucket width (10)
+        h = StreamingHistogram(linear_bounds(10.0, 10.0, 100))
+        for v in range(1, 1001):
+            h.record(float(v))
+        assert h.quantile(0.5) == pytest.approx(500, abs=10)
+        assert h.quantile(0.9) == pytest.approx(900, abs=10)
+        assert h.quantile(0.99) == pytest.approx(990, abs=10)
+        assert h.quantile(1.0) == pytest.approx(1000, abs=10)
+
+    def test_percentiles_skewed_distribution(self):
+        # 99 fast + 1 slow: p50 stays in the fast bucket, p99+ sees the
+        # tail — the exact signal raw-mean bookkeeping hides
+        h = StreamingHistogram(exponential_bounds(0.001, 2.0, 20))
+        for _ in range(99):
+            h.record(0.002)
+        h.record(10.0)
+        assert h.quantile(0.5) < 0.01
+        # p99 of 99 fast + 1 slow is still fast — the tail shows at
+        # p99.9 and max (exactly why max is part of the snapshot)
+        assert h.quantile(0.999) > 1.0
+        s = h.snapshot()
+        assert s["count"] == 100
+        assert s["p99"] >= s["p50"]
+        assert s["max"] == 10.0
+
+    def test_quantile_clamped_to_observed_range(self):
+        h = StreamingHistogram([1.0, 100.0])
+        h.record(5.0)
+        h.record(6.0)
+        for q in (0.0, 0.5, 1.0):
+            assert 5.0 <= h.quantile(q) <= 6.0
+
+    def test_empty_histogram(self):
+        h = StreamingHistogram()
+        assert h.quantile(0.5) is None
+        assert h.snapshot() == {"count": 0}
+        assert h.count == 0 and h.max == 0.0
+
+    def test_o1_memory_under_100k_records(self):
+        h = StreamingHistogram(DEFAULT_LATENCY_BOUNDS)
+        baseline_cells = len(h._counts)
+        baseline_size = sys.getsizeof(h._counts)
+        rng = np.random.default_rng(0)
+        for v in rng.lognormal(-5, 2, size=100_000):
+            h.record(float(v))
+        assert h.count == 100_000
+        # the whole state is still the same fixed bucket array
+        assert len(h._counts) == baseline_cells
+        assert sys.getsizeof(h._counts) == baseline_size
+        assert h.quantile(0.99) is not None
+
+    def test_thread_safety_no_lost_updates(self):
+        h = StreamingHistogram([1.0])
+        n, threads = 10_000, 8
+
+        def hammer():
+            for _ in range(n):
+                h.record(0.5)
+
+        ts = [threading.Thread(target=hammer) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.count == n * threads
+        assert h.bucket_counts()[0][1] == n * threads
+
+
+# ---------------------------------------------------------------------------
+# span registry: bounded memory + backward-compatible summary
+# ---------------------------------------------------------------------------
+
+class TestSpanRegistry:
+    def test_summary_keys_backward_compatible_plus_percentiles(self):
+        reg = SpanRegistry()
+        with timed("op", registry=reg):
+            pass
+        reg.record("op", 0.5)
+        s = reg.summary()["op"]
+        for key in ("count", "total_sec", "mean_sec", "max_sec",
+                    "p50", "p90", "p99"):
+            assert key in s
+        assert s["count"] == 2
+        assert s["max_sec"] == pytest.approx(0.5, abs=0.01)
+
+    def test_memory_bounded_under_100k_records(self):
+        reg = SpanRegistry()
+        for i in range(100_000):
+            reg.record("hot", 0.001 * (i % 100))
+        hist = reg.histograms()["hot"]
+        # bounded: fixed bucket array, no raw list of 100k floats
+        assert len(hist._counts) == len(hist.bounds) + 1
+        assert reg.summary()["hot"]["count"] == 100_000
+
+    def test_span_name_cardinality_capped(self):
+        reg = SpanRegistry()
+        for i in range(SpanRegistry.MAX_SPAN_NAMES + 50):
+            reg.record(f"span-{i}", 0.001)
+        hists = reg.histograms()
+        assert len(hists) <= SpanRegistry.MAX_SPAN_NAMES + 1
+        assert SpanRegistry._OVERFLOW in hists
+        assert hists[SpanRegistry._OVERFLOW].count == 50
+
+    def test_reset(self):
+        reg = SpanRegistry()
+        reg.record("x", 1.0)
+        reg.reset()
+        assert reg.summary() == {}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition format
+# ---------------------------------------------------------------------------
+
+_METRIC_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?[0-9.eE+-]+|[+-]Inf|NaN)$')
+
+
+def validate_exposition(text: str):
+    """Grammar + histogram-consistency validation; returns the parsed
+    (name → type) map."""
+    assert text.endswith("\n")
+    types = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split()
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        assert _METRIC_LINE.match(line), f"bad line: {line!r}"
+    return types
+
+
+class TestPrometheusExposition:
+    def test_render_all_kinds(self):
+        reg = MetricsRegistry()
+        reg.counter("t_requests_total", "requests").labels(
+            method="GET", status="200").inc(3)
+        reg.gauge("t_temperature", "a gauge").set(36.6)
+        h = reg.histogram("t_latency_seconds", "latency",
+                          bounds=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = reg.render()
+        types = validate_exposition(text)
+        assert types["t_requests_total"] == "counter"
+        assert types["t_temperature"] == "gauge"
+        assert types["t_latency_seconds"] == "histogram"
+        assert 't_requests_total{method="GET",status="200"} 3' in text
+        assert 't_latency_seconds_bucket{le="0.1"} 1' in text
+        assert 't_latency_seconds_bucket{le="1"} 2' in text
+        assert 't_latency_seconds_bucket{le="+Inf"} 3' in text
+        assert "t_latency_seconds_count 3" in text
+        assert "t_latency_seconds_sum 5.55" in text
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("t_esc_total", "escaping").labels(
+            path='we"ird\\path\nline').inc()
+        text = reg.render()
+        validate_exposition(text)
+        assert r'path="we\"ird\\path\nline"' in text
+
+    def test_help_escaping_and_type_lines(self):
+        reg = MetricsRegistry()
+        reg.gauge("t_g", "multi\nline \\ help").set(1)
+        text = reg.render()
+        assert "# HELP t_g multi\\nline \\\\ help" in text
+        assert "# TYPE t_g gauge" in text
+
+    def test_invalid_names_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("bad-name", "nope")
+        with pytest.raises(ValueError):
+            reg.counter("t_ok_total", "ok").labels(**{"0bad": "v"})
+
+    def test_kind_conflict_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("t_same", "x")
+        with pytest.raises(ValueError):
+            reg.gauge("t_same", "x")
+
+    def test_counter_monotonic(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_c_total", "x")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_fn_failure_reads_zero(self):
+        reg = MetricsRegistry()
+        reg.gauge("t_broken", "x", fn=lambda: 1 / 0)
+        assert "t_broken 0" in reg.render()
+
+    def test_snapshot_shapes(self):
+        reg = MetricsRegistry()
+        reg.counter("t_plain_total", "x").inc(2)
+        reg.histogram("t_h_seconds", "x",
+                      bounds=[1.0]).labels(phase="a").observe(0.5)
+        snap = reg.snapshot()
+        assert snap["t_plain_total"] == 2
+        assert snap["t_h_seconds"]["phase=a"]["count"] == 1
+        assert "p99" in snap["t_h_seconds"]["phase=a"]
+
+    def test_collector_errors_isolated(self):
+        reg = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("collector down")
+
+        reg.register_collector(boom)
+        reg.gauge("t_alive", "x").set(1)
+        assert "t_alive 1" in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# transfer-guard counter wiring
+# ---------------------------------------------------------------------------
+
+class TestTransferGuardCounter:
+    def test_counts_guard_log_records(self):
+        TransferGuardCounter.install()
+        before = TransferGuardCounter.total()
+        logging.getLogger("jax").warning(
+            "Disallowed host-to-device transfer: aval=ShapedArray(...)")
+        assert TransferGuardCounter.total() == before + 1
+        # unrelated records do not count
+        logging.getLogger("jax").warning("compiling module jit_step")
+        assert TransferGuardCounter.total() == before + 1
+
+    def test_direct_count_and_registry_gauge(self):
+        from predictionio_tpu.obs import register_runtime_metrics
+
+        reg = MetricsRegistry()
+        register_runtime_metrics(reg, server="test")
+        before = TransferGuardCounter.total()
+        TransferGuardCounter.count(2)
+        assert TransferGuardCounter.total() == before + 2
+        text = reg.render()
+        m = re.search(
+            r"^pio_transfer_guard_violations_total (\d+)$", text,
+            re.MULTILINE)
+        assert m and int(m.group(1)) == TransferGuardCounter.total()
+
+    def test_install_idempotent(self):
+        h1 = TransferGuardCounter.install()
+        h2 = TransferGuardCounter.install()
+        assert h1 is h2
+        root_handlers = [h for h in logging.getLogger().handlers
+                         if isinstance(h, TransferGuardCounter)]
+        assert len(root_handlers) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-phase spans through a REAL in-process engine server
+# ---------------------------------------------------------------------------
+
+def _deploy_synthetic(batching: bool):
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.data.storage.base import (
+        STATUS_COMPLETED,
+        EngineInstance,
+    )
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        create_engine_server,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+
+    rank, n_users, n_items = 4, 16, 32
+    rng = np.random.default_rng(0)
+    model = ALSModel(
+        user_factors=rng.standard_normal((n_users, rank)).astype(
+            np.float32),
+        item_factors=rng.standard_normal((n_items, rank)).astype(
+            np.float32),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "obsapp"))
+    ctx = Context(app_name="obsapp", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="obs", status=STATUS_COMPLETED, start_time=now, end_time=now,
+        engine_id="obs", engine_version="1", engine_variant="e.json",
+        engine_factory="synthetic")
+    qs = QueryServer(ctx, recommendation_engine(),
+                     default_engine_params("obsapp", rank=rank),
+                     [model], inst,
+                     ServerConfig(warm_start=False, batching=batching,
+                                  max_batch=8, batch_window_ms=5.0))
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    return qs, srv
+
+
+def _call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            return (resp.status,
+                    json.loads(raw) if "json" in ctype else raw.decode(),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), dict(e.headers)
+
+
+class TestEngineServerPhases:
+    def test_unbatched_phases_recorded(self):
+        qs, srv = _deploy_synthetic(batching=False)
+        try:
+            for i in range(5):
+                status, body, headers = _call(
+                    srv.port, "POST", "/queries.json",
+                    {"user": f"u{i}", "num": 3})
+                assert status == 200
+                assert headers.get("X-Request-ID")
+            status, st, _ = _call(srv.port, "GET", "/status.json")
+            assert status == 200
+            phases = st["phases"]
+            for phase in ("phase=assemble", "phase=supplement",
+                          "phase=dispatch", "phase=serve",
+                          "phase=readback"):
+                assert phases[phase]["count"] >= 5, phases.keys()
+                assert phases[phase]["p99"] is not None
+            assert st["latency"]["count"] >= 5
+            assert st["transferGuardViolations"] >= 0
+            assert isinstance(st["hbm"], list)  # empty on CPU: graceful
+            status, text, _ = _call(srv.port, "GET", "/metrics")
+            assert status == 200
+            validate_exposition(text)
+            assert 'pio_query_phase_seconds_bucket{phase="dispatch"' \
+                in text
+            assert "pio_query_latency_seconds_count 5" in text
+            assert "pio_compiles_since_warm" in text
+            # the global timed(name) span registry bridges into the
+            # same exposition once a span exists
+            with timed("obs-bridge-span"):
+                pass
+            status, text, _ = _call(srv.port, "GET", "/metrics")
+            assert 'pio_span_seconds_bucket{span="obs-bridge-span"' \
+                in text
+        finally:
+            srv.shutdown()
+
+    def test_batched_phases_queue_and_occupancy(self):
+        qs, srv = _deploy_synthetic(batching=True)
+        try:
+            results = [None] * 8
+
+            def fire(i):
+                results[i] = _call(srv.port, "POST", "/queries.json",
+                                   {"user": f"u{i}", "num": 3})
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(r[0] == 200 for r in results)
+            status, st, _ = _call(srv.port, "GET", "/status.json")
+            assert st["phases"]["phase=queue_wait"]["count"] >= 8
+            assert st["batchOccupancy"]["count"] >= 1
+            assert st["queueDepth"]["count"] >= 1
+            # 8 concurrent queries over max_batch=8: every query was
+            # coalesced, so total occupancy-weighted count is 8
+            status, text, _ = _call(srv.port, "GET", "/metrics")
+            validate_exposition(text)
+            assert "pio_batch_occupancy_count" in text
+            assert "pio_queue_depth_count" in text
+            assert 'pio_query_phase_seconds_bucket{phase="queue_wait"' \
+                in text
+        finally:
+            srv.shutdown()
+
+    def test_direct_query_records_without_http(self):
+        qs, srv = _deploy_synthetic(batching=False)
+        try:
+            obs = {}
+            qs.query({"user": "u1", "num": 2}, obs=obs)
+            assert "dispatchMs" in obs and "serveMs" in obs
+            assert qs.spans_summary()["query (end-to-end)"]["count"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_query_errors_counted(self):
+        qs, srv = _deploy_synthetic(batching=False)
+        try:
+            status, _, _ = _call(srv.port, "POST", "/queries.json",
+                                 {"bogus": 1})
+            assert status == 400
+            snap = qs.metrics.snapshot()
+            assert snap["pio_query_errors_total"]["status=400"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_access_log_line_carries_request_id_and_phases(self):
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        access = logging.getLogger("predictionio_tpu.access")
+        handler = Capture()
+        old_level = access.level
+        access.addHandler(handler)
+        access.setLevel(logging.INFO)
+        qs, srv = _deploy_synthetic(batching=False)
+        try:
+            status, _, headers = _call(srv.port, "POST", "/queries.json",
+                                       {"user": "u1", "num": 2})
+            assert status == 200
+            lines = [json.loads(r) for r in records]
+            mine = [ln for ln in lines
+                    if ln.get("path") == "/queries.json"]
+            assert mine, "no access-log line for the query"
+            line = mine[-1]
+            assert line["requestId"] == headers["X-Request-ID"]
+            assert line["status"] == 200
+            assert "dispatchMs" in line and "durationMs" in line
+        finally:
+            srv.shutdown()
+            access.removeHandler(handler)
+            access.setLevel(old_level)
+
+
+# ---------------------------------------------------------------------------
+# event + storage server exposition
+# ---------------------------------------------------------------------------
+
+class TestEventServerMetrics:
+    @pytest.fixture()
+    def served(self):
+        from predictionio_tpu.data.storage import AccessKey, App, Storage
+        from predictionio_tpu.server.eventserver import (
+            create_event_server,
+        )
+
+        storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+        app_id = storage.apps().insert(App(0, "obsev"))
+        storage.access_keys().insert(
+            AccessKey(key="KEY", app_id=app_id, events=()))
+        storage.events().init(app_id)
+        srv = create_event_server(storage, host="127.0.0.1", port=0)
+        srv.start_background()
+        yield srv
+        srv.shutdown()
+
+    def test_metrics_and_status(self, served):
+        ev = {"event": "rate", "entityType": "user", "entityId": "u1",
+              "targetEntityType": "item", "targetEntityId": "i1",
+              "properties": {"rating": 5}}
+        status, body, _ = _call(served.port, "POST",
+                                "/events.json?accessKey=KEY", ev)
+        assert status == 201
+        status, st, _ = _call(served.port, "GET", "/status.json")
+        assert status == 200
+        assert st["statsEnabled"] is False
+        assert st["metrics"]["pio_stats_enabled"] == 0
+        assert st["metrics"]["pio_events_ingested_total"][
+            "route=events"] == 1
+        status, text, _ = _call(served.port, "GET", "/metrics")
+        assert status == 200
+        validate_exposition(text)
+        assert 'pio_events_ingested_total{route="events"} 1' in text
+        assert "pio_stats_enabled 0" in text
+        # event-ingest latency histogram (the acceptance criterion's
+        # "event latency" series) exists for the /events.json route
+        assert 'pio_http_request_duration_seconds_bucket' in text
+        assert 'route="/events.json"' in text
+
+    def test_stats_404_explains_flag(self, served):
+        status, body, _ = _call(served.port, "GET",
+                                "/stats.json?accessKey=KEY")
+        assert status == 404
+        assert "--stats" in body["message"]
+        assert body["statsEnabled"] is False
+        assert "hint" in body
+
+
+class TestStorageServerMetrics:
+    def test_columnar_hit_miss_counters(self, tmp_path):
+        from tests.conftest import start_sqlite_backed_storage_server
+
+        srv, backing = start_sqlite_backed_storage_server(tmp_path)
+        try:
+            from predictionio_tpu.data.event import Event
+            from predictionio_tpu.data.storage import App
+
+            app_id = backing.apps().insert(App(0, "obsst"))
+            backing.events().init(app_id)
+            backing.events().insert(
+                Event(event="rate", entity_type="user", entity_id="u1",
+                      target_entity_type="item", target_entity_id="i1",
+                      properties={"rating": 4.0}), app_id)
+            url = (f"http://127.0.0.1:{srv.port}"
+                   f"/v1/events/{app_id}/columnar")
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                etag = resp.headers["ETag"]
+                assert resp.status == 200
+            req = urllib.request.Request(
+                url, headers={"If-None-Match": etag})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == 304
+            except urllib.error.HTTPError as e:
+                assert e.code == 304
+            status, text, _ = _call(srv.port, "GET", "/metrics")
+            assert status == 200
+            validate_exposition(text)
+            assert 'pio_columnar_requests_total{outcome="miss"} 1' \
+                in text
+            assert 'pio_columnar_requests_total{outcome="hit"} 1' \
+                in text
+            m = re.search(r"^pio_columnar_bytes_total (\d+)$", text,
+                          re.MULTILINE)
+            assert m and int(m.group(1)) > 0
+            status, st, _ = _call(srv.port, "GET", "/status.json")
+            assert st["status"] == "alive"
+        finally:
+            srv.shutdown()
+
+
+class TestDashboardMetrics:
+    def test_dashboard_mounts_metrics_and_table(self):
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.server.dashboard import create_dashboard
+
+        storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+        srv = create_dashboard(storage, host="127.0.0.1", port=0)
+        srv.start_background()
+        try:
+            status, html, _ = _call(srv.port, "GET", "/")
+            assert status == 200
+            # second hit: the first request is now in the registry, so
+            # the index renders its percentile table
+            status, html, _ = _call(srv.port, "GET", "/")
+            assert "Request latency percentiles" in html
+            status, text, _ = _call(srv.port, "GET", "/metrics")
+            assert status == 200
+            validate_exposition(text)
+            assert "pio_http_request_duration_seconds_bucket" in text
+        finally:
+            srv.shutdown()
