@@ -1,0 +1,183 @@
+"""CI smoke: the progressive-delivery loop end-to-end on a toy engine.
+
+Boots a real engine server on a loopback port with a synthetic stable
+release, then exercises BOTH terminal rollout outcomes:
+
+1. **Auto-rollback** — canaries a deliberately erroring candidate at
+   50% and asserts the health gate rolls it back within the configured
+   window, stable traffic never stops answering, and ``/release.json``
+   records the canary + rollback history.
+2. **Auto-promote** — canaries a healthy candidate and asserts it ramps
+   to 100%, becomes the serving + pinned stable, and zero queries fail
+   across the swap.
+
+Exit 0 on success; non-zero with a reason otherwise. Run on CPU:
+``JAX_PLATFORMS=cpu python benchmarks/rollout_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def call(port: int, method: str, path: str, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def synth_model(seed: int, n_users: int = 32, n_items: int = 48,
+                rank: int = 8):
+    import numpy as np
+
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_factors=rng.standard_normal(
+            (n_users, rank)).astype(np.float32),
+        item_factors=rng.standard_normal(
+            (n_items, rank)).astype(np.float32),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+
+
+class PoisonServing:
+    """The 'bad retrain': every candidate query fails."""
+
+    def supplement(self, q):
+        raise RuntimeError("candidate poison")
+
+
+def drive(port: int, n_users: int = 24):
+    results = []
+    for u in range(n_users):
+        results.append(call(port, "POST", "/queries.json",
+                            {"user": f"u{u}", "num": 3}))
+    return results
+
+
+def main() -> int:
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.data.storage.base import (
+        STATUS_COMPLETED,
+        EngineInstance,
+        Model,
+    )
+    from predictionio_tpu.rollout import HealthPolicy
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        create_engine_server,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import persistence
+    from predictionio_tpu.workflow.core import load_models_for_deploy
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "rollsmoke"))
+    ctx = Context(app_name="rollsmoke", _storage=storage)
+    now = datetime.now(timezone.utc)
+    for i, iid in enumerate(("stable-1", "cand-bad", "cand-good")):
+        storage.engine_instances().insert(EngineInstance(
+            id=iid, status=STATUS_COMPLETED, start_time=now,
+            end_time=now, engine_id="smoke", engine_version="1",
+            engine_variant="engine.json", engine_factory="synthetic"))
+        storage.models().insert(Model(
+            id=iid, models=persistence.dumps_models(
+                [synth_model(seed=i)])))
+
+    engine = recommendation_engine()
+    ep = default_engine_params("rollsmoke", rank=8)
+    inst = storage.engine_instances().get("stable-1")
+    models = load_models_for_deploy(ctx, engine, inst, ep)
+    qs = QueryServer(ctx, engine, ep, models, inst,
+                     ServerConfig(warm_start=False))
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    port = srv.port
+    try:
+        # -- phase 1: erroring candidate must auto-roll-back ---------------
+        policy = HealthPolicy(window_sec=0.3, min_queries=5,
+                              ramp=(0.5, 1.0), max_error_rate=0.2)
+        ctl = qs.start_canary("cand-bad", fraction=0.5, policy=policy,
+                              actor="rollout-smoke",
+                              reason="deliberately erroring")
+        qs._candidate.serving = PoisonServing()
+        deadline = time.monotonic() + 60
+        saw_candidate_error = False
+        while time.monotonic() < deadline and ctl.active:
+            for status, body in drive(port):
+                if status == 500:
+                    saw_candidate_error = True
+                elif status == 200:
+                    assert body.get("itemScores"), f"bad body: {body}"
+                else:
+                    raise AssertionError(
+                        f"unexpected status {status}: {body}")
+            time.sleep(0.02)
+        assert not ctl.active, "gate never concluded on erroring canary"
+        assert ctl.outcome == "rolled_back", ctl.outcome
+        assert saw_candidate_error, "canary traffic never hit candidate"
+        status, rel = call(port, "GET", "/release.json")
+        actions = [e["action"] for e in rel["history"]]
+        assert "canary" in actions and "rollback" in actions, actions
+        assert rel["serving"]["stableInstanceId"] == "stable-1"
+        assert rel["arms"]["candidate"]["errors"] > 0
+        print(f"[rollback] auto-rolled-back after {ctl.windows} "
+              f"window(s): {ctl.last_decision.reason}")
+
+        # -- phase 2: healthy candidate must ramp to pinned stable ---------
+        policy = HealthPolicy(window_sec=0.3, min_queries=5,
+                              ramp=(0.25, 1.0))
+        ctl = qs.start_canary("cand-good", policy=policy,
+                              actor="rollout-smoke",
+                              reason="healthy retrain")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and ctl.active:
+            for status, body in drive(port):
+                assert status == 200 and body.get("itemScores"), \
+                    f"query failed during healthy ramp: {status} {body}"
+            time.sleep(0.02)
+        assert not ctl.active, "gate never concluded on healthy canary"
+        assert ctl.outcome == "promoted", ctl.outcome
+        assert qs.instance.id == "cand-good"
+        status, rel = call(port, "GET", "/release.json")
+        assert rel["state"]["stable"] == "cand-good"
+        assert rel["state"]["pinned"] == "cand-good"
+        actions = [e["action"] for e in rel["history"]]
+        assert "ramp" in actions and "promote" in actions, actions
+        # the promoted release also answers /status.json coherently
+        status, st = call(port, "GET", "/status.json")
+        assert st["release"]["stable"] == "cand-good"
+        print(f"[promote] ramped to 100% and pinned after "
+              f"{ctl.windows} window(s)")
+    finally:
+        srv.shutdown()
+    print("rollout smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,14 @@ Measures `POST /queries.json` latency through the full deployed stack
 
 Prints ONE JSON line with p50/p90/p99 (ms) and throughput per config.
 
+With ``--canary FRACTION``, an extra config binds a second synthetic
+model as a CANDIDATE release at that traffic fraction (the rollout
+splitter's hash-of-entity cohort, health gate held) and reports
+stable-vs-candidate p50/p99 side by side from the server's own per-arm
+release series — the canary latency-overhead view.
+
 Usage: python benchmarks/serving_bench.py [n_items_device] [rank]
+                                          [--canary FRACTION]
 Env:   SERVE_THREADS (8), SERVE_REQUESTS (400 per config)
 """
 
@@ -229,7 +236,122 @@ def standard_battery(n_items_dev: int, rank: int, n_req: int,
     }
 
 
+def bench_canary(model: ALSModel, candidate: ALSModel, fraction: float,
+                 n_requests: int, n_threads: int) -> dict:
+    """Stable + candidate bound side by side: the canary splitter
+    routes ``fraction`` of the cohort to the candidate while the gate
+    is held open (no ramp), then both arms' server-side latency series
+    are reported together."""
+    from predictionio_tpu.rollout import HealthPolicy
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "servebench"))
+    ctx = Context(app_name="servebench", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("servebench", rank=model.params.rank)
+    now = datetime.now(timezone.utc)
+    for iid in ("bench-stable", "bench-cand"):
+        storage.engine_instances().insert(EngineInstance(
+            id=iid, status=STATUS_COMPLETED, start_time=now,
+            end_time=now, engine_id="bench", engine_version="1",
+            engine_variant="engine.json", engine_factory="synthetic"))
+    qs = QueryServer(ctx, engine, ep, [model],
+                     storage.engine_instances().get("bench-stable"),
+                     ServerConfig())
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    port = srv.port
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status.json",
+                    timeout=30) as resp:
+                if json.loads(resp.read()).get("servingWarm"):
+                    break
+            time.sleep(0.5)
+        # hold the gate open for the whole bench: no ramp, no verdict
+        qs.start_canary("bench-cand", fraction=fraction,
+                        policy=HealthPolicy(window_sec=3600,
+                                            min_queries=1 << 30),
+                        models=[candidate], actor="serving-bench")
+        qs._candidate.warm_done.wait(timeout=300)
+
+        rng = np.random.default_rng(2)
+        users = rng.integers(0, model.n_users, n_requests)
+        errors: list = []
+        lock = threading.Lock()
+        idx = iter(range(n_requests))
+
+        def worker():
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            try:
+                while True:
+                    with lock:
+                        k = next(idx, None)
+                    if k is None:
+                        return
+                    body = json.dumps({"user": f"u{users[k]}",
+                                       "num": 10}).encode()
+                    try:
+                        conn.request(
+                            "POST", "/queries.json", body=body,
+                            headers={"Content-Type":
+                                     "application/json"})
+                        out = json.loads(conn.getresponse().read())
+                        if out.get("itemScores") is None:
+                            raise RuntimeError(f"bad response: {out}")
+                    except Exception as e:  # noqa: BLE001 — surface
+                        with lock:
+                            errors.append(str(e))
+                        conn.close()
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        arms = qs.release_arms()
+    finally:
+        srv.shutdown()
+    if errors:
+        raise RuntimeError(
+            f"canary bench: {len(errors)} failed requests "
+            f"(first: {errors[0]})")
+
+    def arm_row(arm: dict) -> dict:
+        lat = arm.get("latency") or {}
+        return {
+            "queries": arm["queries"],
+            "errors": arm["errors"],
+            "p50_ms": (round(lat["p50"] * 1000, 2)
+                       if lat.get("p50") is not None else None),
+            "p99_ms": (round(lat["p99"] * 1000, 2)
+                       if lat.get("p99") is not None else None),
+        }
+
+    return {
+        "config": "canary_split",
+        "fraction": fraction,
+        "stable": arm_row(arms["stable"]),
+        "candidate": arm_row(arms["candidate"]),
+    }
+
+
 def main() -> None:
+    argv = sys.argv[1:]
+    canary_fraction = None
+    if "--canary" in argv:
+        i = argv.index("--canary")
+        canary_fraction = float(argv[i + 1])
+        del argv[i:i + 2]
+    sys.argv[1:] = argv
     n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
     n_threads = int(os.environ.get("SERVE_THREADS", "8"))
@@ -247,6 +369,12 @@ def main() -> None:
     hi = int(os.environ.get("SERVE_THREADS_HI", "256"))
     results = list(standard_battery(n_items_dev, rank, n_requests,
                                     n_threads, hi).values())
+    if canary_fraction is not None:
+        dev_model = synth_model(50_000, n_items_dev, rank, device=True)
+        cand_model = synth_model(50_000, n_items_dev, rank, device=True)
+        results.append(bench_canary(dev_model, cand_model,
+                                    canary_fraction,
+                                    max(n_requests, 200), n_threads))
     print(json.dumps({
         "bench": "serving_queries_json",
         "device": device_kind,

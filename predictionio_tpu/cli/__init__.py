@@ -331,33 +331,75 @@ def cmd_deploy(args, storage: Storage) -> int:
     return 0
 
 
-def cmd_undeploy(args, storage: Storage) -> int:
+def _server_ssl_kwargs(args) -> dict:
+    import ssl as _ssl
+
+    kw = {}
+    if getattr(args, "https", False):
+        ctx = _ssl.create_default_context()
+        if getattr(args, "insecure", False):
+            # opt-in for self-signed local certs; the accessKey rides
+            # this URL, so verification stays on by default
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE
+        kw["context"] = ctx
+    return kw
+
+
+def _server_call(args, path: str, method: str = "GET",
+                 body: Optional[dict] = None, timeout: float = 30.0):
+    """One control-plane round trip to the deployed engine server;
+    returns the parsed JSON body (raises on transport errors; HTTP
+    error responses raise urllib's HTTPError with the JSON body)."""
     import urllib.request
 
-    scheme = "https" if args.https else "http"
-    url = f"{scheme}://{args.ip}:{args.port}/stop"
-    if args.accesskey:
-        url += f"?accessKey={args.accesskey}"
-    try:
-        import ssl as _ssl
+    scheme = "https" if getattr(args, "https", False) else "http"
+    url = f"{scheme}://{args.ip}:{args.port}{path}"
+    if getattr(args, "accesskey", ""):
+        sep = "&" if "?" in url else "?"
+        url += f"{sep}accessKey={args.accesskey}"
+    data = json.dumps(body).encode("utf-8") if body is not None else \
+        (b"" if method == "POST" else None)
+    req = urllib.request.Request(url, method=method, data=data)
+    with urllib.request.urlopen(req, timeout=timeout,
+                                **_server_ssl_kwargs(args)) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else None
 
-        kw = {}
-        if args.https:
-            ctx = _ssl.create_default_context()
-            if getattr(args, "insecure", False):
-                # opt-in for self-signed local certs; the accessKey rides
-                # this URL, so verification stays on by default
-                ctx.check_hostname = False
-                ctx.verify_mode = _ssl.CERT_NONE
-            kw["context"] = ctx
-        req = urllib.request.Request(url, method="POST", data=b"")
-        with urllib.request.urlopen(req, timeout=10, **kw) as resp:
-            resp.read()
-        _out(f"Undeployed engine server at {args.ip}:{args.port}.")
-        return 0
-    except Exception as e:
-        _err(f"Cannot undeploy {url}: {e}")
+
+def cmd_undeploy(args, storage: Storage) -> int:
+    # learn which release is being taken off traffic BEFORE stopping it
+    # (the undeploy must land in the release history — ISSUE 3)
+    info = None
+    try:
+        info = _server_call(args, "/status.json")
+    except Exception:  # noqa: BLE001 — liveness is checked by /stop below
+        pass
+    try:
+        _server_call(args, "/stop", method="POST", timeout=10)
+    except Exception as e:  # noqa: BLE001 — report, don't traceback
+        _err(f"Cannot undeploy {args.ip}:{args.port}: {e}")
         return 1
+    if info and info.get("engineId"):
+        _out(f"Undeployed engine server at {args.ip}:{args.port} "
+             f"(engine {info['engineId']}, release instance "
+             f"{info.get('engineInstanceId', '?')}).")
+        try:
+            from ..rollout import ReleaseRegistry
+
+            reg = ReleaseRegistry(
+                storage, info["engineId"],
+                info.get("engineVersion", "1"),
+                info.get("engineVariant", "engine.json"))
+            reg.record("undeploy",
+                       instance_id=info.get("engineInstanceId", ""),
+                       actor="ptpu undeploy",
+                       reason=f"stopped {args.ip}:{args.port}")
+        except Exception as e:  # noqa: BLE001 — history is best-effort
+            _err(f"release history write failed: {e}")
+    else:
+        _out(f"Undeployed engine server at {args.ip}:{args.port}.")
+    return 0
 
 
 def cmd_batchpredict(args, storage: Storage) -> int:
@@ -645,7 +687,8 @@ def cmd_stop_all(args, storage: Storage) -> int:
 
 def cmd_status(args, storage: Storage) -> int:
     """``pio status`` (``commands/Management.scala:99``): environment +
-    storage smoke check."""
+    storage smoke check + the active release per tracked engine (not
+    just process liveness — the RELEASE is what serves traffic)."""
     _out(f"PredictionIO-TPU {__version__}")
     try:
         import jax
@@ -660,8 +703,145 @@ def cmd_status(args, storage: Storage) -> int:
     except Exception as e:  # noqa: BLE001
         _err(f"Storage check failed: {e}")
         return 1
+    try:
+        from ..rollout import ReleaseRegistry
+
+        tracked = ReleaseRegistry.list_tracked(storage)
+    except Exception as e:  # noqa: BLE001 — release state is advisory
+        _err(f"release registry read failed: {e}")
+        tracked = []
+    for engine_id, engine_version, engine_variant in tracked:
+        from ..rollout import ReleaseRegistry
+
+        st = ReleaseRegistry(storage, engine_id, engine_version,
+                             engine_variant).state()
+        line = (f"Release [{engine_id} v{engine_version}]: "
+                f"stable={st.get('stable') or '(none)'}")
+        if st.get("pinned"):
+            line += f" pinned={st['pinned']}"
+        if st.get("candidate"):
+            line += (f" candidate={st['candidate']} "
+                     f"({st.get('candidateMode')} at "
+                     f"{float(st.get('fraction') or 0) * 100:.0f}%)")
+        _out(line)
     _out("(sleeping 0 seconds) Your system is all ready to go.")
     return 0
+
+
+def cmd_release(args, storage: Storage) -> int:
+    """``ptpu release`` — the progressive-delivery console (ISSUE 3):
+    list/show release state and history from storage; pin releases;
+    drive a running engine server's canary/promote/rollback/status
+    over its control routes."""
+    from ..rollout import ReleaseRegistry
+    from ..rollout.splitter import parse_fraction
+
+    sub = args.release_command
+
+    if sub == "list":
+        tracked = ReleaseRegistry.list_tracked(storage)
+        if not tracked:
+            _out("No releases recorded yet (deploy to create one).")
+            return 0
+        for engine_id, engine_version, engine_variant in sorted(tracked):
+            st = ReleaseRegistry(storage, engine_id, engine_version,
+                                 engine_variant).state()
+            _out(f"{engine_id} v{engine_version} ({engine_variant}): "
+                 f"stable={st.get('stable') or '(none)'} "
+                 f"pinned={st.get('pinned') or '-'} "
+                 f"candidate={st.get('candidate') or '-'}")
+        return 0
+
+    reg = ReleaseRegistry(storage, args.engine_id or "default",
+                          args.engine_version or "1",
+                          args.engine_json)
+
+    if sub == "show":
+        payload = reg.to_json(history_limit=args.limit)
+        _out(json.dumps(payload, indent=2))
+        return 0
+
+    if sub == "pin":
+        if args.clear:
+            reg.unpin(actor="ptpu release", reason=args.reason)
+            _out("Unpinned; deploy/reload bind the latest COMPLETED "
+                 "instance again.")
+            return 0
+        if not args.instance_id:
+            _err("instance_id required (or --clear).")
+            return 1
+        try:
+            reg.pin(args.instance_id, actor="ptpu release",
+                    reason=args.reason)
+        except ValueError as e:
+            _err(str(e))
+            return 1
+        _out(f"Pinned release {args.instance_id}; deploy/reload now "
+             f"bind it (POST /reload to apply on a live server).")
+        return 0
+
+    if sub == "status":
+        try:
+            payload = _server_call(args, "/release.json")
+        except Exception as e:  # noqa: BLE001 — fall back to storage
+            _err(f"engine server at {args.ip}:{args.port} unreachable "
+                 f"({e}); showing storage state")
+            _out(json.dumps(reg.to_json(history_limit=10), indent=2))
+            return 0
+        _out(json.dumps(payload, indent=2))
+        return 0
+
+    if sub == "canary":
+        try:
+            fraction = (parse_fraction(args.fraction)
+                        if args.fraction else None)
+        except ValueError as e:
+            _err(str(e))
+            return 1
+        body = {"instanceId": args.instance_id, "shadow": args.shadow,
+                "actor": "ptpu release", "reason": args.reason}
+        if fraction is not None:
+            body["fraction"] = fraction
+        try:
+            resp = _server_call(args, "/release/canary", method="POST",
+                                body=body)
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"canary start failed: {_http_err_detail(e)}")
+            return 1
+        ro = (resp or {}).get("rollout") or {}
+        _out(f"{'Shadow' if args.shadow else 'Canary'} rollout of "
+             f"{args.instance_id} started at "
+             f"{float(ro.get('fraction') or 0) * 100:.0f}% "
+             f"(watch: ptpu release status).")
+        return 0
+
+    if sub in ("promote", "rollback"):
+        try:
+            resp = _server_call(args, f"/release/{sub}", method="POST",
+                                body={"reason": args.reason})
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"{sub} failed: {_http_err_detail(e)}")
+            return 1
+        _out(f"{resp.get('message', 'OK')} Serving instance: "
+             f"{resp.get('engineInstanceId', '?')}")
+        return 0
+
+    _err(f"Unknown release subcommand {sub!r}")
+    return 1
+
+
+def _http_err_detail(e: Exception) -> str:
+    """Surface the server's JSON error message instead of a bare
+    'HTTP Error 409'."""
+    import urllib.error
+
+    if isinstance(e, urllib.error.HTTPError):
+        try:
+            body = json.loads(e.read() or b"{}")
+            return f"{e.code}: {body.get('message', '')}"
+        except Exception:  # noqa: BLE001 — fall back to the bare error
+            return str(e)
+    return str(e)
 
 
 def cmd_export(args, storage: Storage) -> int:
@@ -964,6 +1144,57 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip TLS certificate verification (self-signed "
                         "local certs only)")
 
+    s = sub.add_parser(
+        "release",
+        help="progressive delivery: list/show/pin releases, drive "
+             "canary/shadow rollouts, promote, roll back")
+    rel_sub = s.add_subparsers(dest="release_command", required=True)
+
+    def add_release_flags(sp, server: bool = False):
+        add_engine_flags(sp)
+        sp.add_argument("--reason", default="",
+                        help="recorded in the release history")
+        if server:
+            sp.add_argument("--ip", default="127.0.0.1")
+            sp.add_argument("--port", type=int, default=8000)
+            sp.add_argument("--accesskey", default="")
+            sp.add_argument("--https", action="store_true")
+            sp.add_argument("--insecure", action="store_true")
+
+    rel_sub.add_parser("list", help="every engine with release state")
+    r = rel_sub.add_parser("show", help="full state + history (JSON)")
+    add_release_flags(r)
+    r.add_argument("--limit", type=int, default=50,
+                   help="history entries to include")
+    r = rel_sub.add_parser(
+        "pin", help="pin deploy/reload to an instance id")
+    add_release_flags(r)
+    r.add_argument("instance_id", nargs="?", default="")
+    r.add_argument("--clear", action="store_true",
+                   help="unpin (bind latest COMPLETED again)")
+    r = rel_sub.add_parser(
+        "canary", help="start a health-gated canary of an instance "
+                       "on the running engine server")
+    add_release_flags(r, server=True)
+    r.add_argument("instance_id")
+    r.add_argument("--fraction", default="",
+                   help="initial candidate traffic fraction "
+                        "(e.g. 0.05 or 5%%; default: first ramp step)")
+    r.add_argument("--shadow", action="store_true",
+                   help="mirror queries to the candidate without "
+                        "returning its answers (never auto-promotes)")
+    r = rel_sub.add_parser(
+        "promote", help="promote the live candidate to pinned stable")
+    add_release_flags(r, server=True)
+    r = rel_sub.add_parser(
+        "rollback", help="abort the live candidate (or revert stable "
+                         "to the previous release)")
+    add_release_flags(r, server=True)
+    r = rel_sub.add_parser(
+        "status", help="live /release.json from the engine server "
+                       "(falls back to storage state)")
+    add_release_flags(r, server=True)
+
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)
     s.add_argument("--input", required=True)
@@ -1065,6 +1296,7 @@ COMMANDS = {
     "eval": cmd_eval,
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
+    "release": cmd_release,
     "batchpredict": cmd_batchpredict,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

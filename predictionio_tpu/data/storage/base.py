@@ -443,10 +443,21 @@ class EvaluationInstance:
         return replace(self, **changes)
 
 
+#: Model-blob ids starting with this prefix are RESERVED for framework
+#: metadata riding the MODELDATA repository — today the release
+#: registry's state documents (``predictionio_tpu.rollout.registry``).
+#: Engine-instance ids (uuids / DAO-assigned integers) never collide
+#: with it, and tooling that enumerates or garbage-collects model
+#: blobs must skip reserved keys.
+RESERVED_MODEL_KEY_PREFIX = "__release__"
+
+
 @dataclass(frozen=True)
 class Model:
     """A persisted model blob keyed by engine-instance id
-    (``data/.../storage/Models.scala:33``)."""
+    (``data/.../storage/Models.scala:33``); ids under
+    :data:`RESERVED_MODEL_KEY_PREFIX` carry framework metadata instead
+    of model bytes."""
     id: str
     models: bytes
 

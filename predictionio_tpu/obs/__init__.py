@@ -13,6 +13,7 @@ from .histogram import (
     StreamingHistogram,
     exponential_bounds,
     linear_bounds,
+    window_quantile,
 )
 from .registry import (
     MetricsRegistry,
@@ -36,6 +37,7 @@ __all__ = [
     "mount_span_metrics",
     "register_runtime_metrics",
     "render_histogram_lines",
+    "window_quantile",
 ]
 
 

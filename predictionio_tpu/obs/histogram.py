@@ -170,3 +170,42 @@ class StreamingHistogram:
             self._sum = 0.0
             self._min = math.inf
             self._max = -math.inf
+
+
+def window_quantile(start: List[Tuple[float, int]],
+                    now: List[Tuple[float, int]],
+                    q: float) -> Optional[float]:
+    """Quantile of the observations that landed BETWEEN two cumulative
+    :meth:`StreamingHistogram.bucket_counts` snapshots of the same
+    histogram — the sliding-window read (cumulative-count deltas per
+    bucket ARE the window's own histogram; the rollout health gate
+    windows candidate-vs-stable p99 this way). Interpolates inside the
+    target bucket like :meth:`StreamingHistogram.quantile`; returns
+    None on an empty window or mismatched snapshots."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("q must be in [0, 1]")
+    if len(start) != len(now):
+        # bounds changed between snapshots (rebind with different
+        # buckets): no sample rather than mis-mixing the two shapes
+        return None
+    deltas: List[Tuple[float, int]] = []
+    prev_s = prev_n = 0
+    for (le_s, cum_s), (le_n, cum_n) in zip(start, now):
+        if le_s != le_n:
+            return None
+        deltas.append((le_n, (cum_n - prev_n) - (cum_s - prev_s)))
+        prev_s, prev_n = cum_s, cum_n
+    total = sum(c for _, c in deltas)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    lo = 0.0
+    for le, c in deltas:
+        if c > 0 and cum + c >= target:
+            hi = lo * 2 if math.isinf(le) else le
+            return lo + (max(hi, lo) - lo) * ((target - cum) / c)
+        cum += c
+        if not math.isinf(le):
+            lo = le
+    return lo

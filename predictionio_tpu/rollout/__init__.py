@@ -1,0 +1,44 @@
+"""Progressive delivery: versioned releases, canary/shadow traffic,
+health-gated auto-promotion and auto-rollback.
+
+The deploy story of the reference was binary — ``pio deploy`` bound one
+COMPLETED engine instance and ``/reload`` flipped 100% of traffic to
+the newest blob in one step. This subsystem makes every model that
+reaches traffic a *recorded, reversible release*:
+
+- :mod:`.registry` — a versioned release registry layered over
+  engine-instance metadata (pin, promote, rollback, history with
+  who/when/why), persisted through the existing storage repos.
+- :mod:`.splitter` — a deterministic traffic splitter for the
+  QueryServer hot path: hash-of-entity cohorts route a configurable
+  fraction of queries to a *candidate* instance bound alongside the
+  stable one, plus a shadow mode that mirrors queries without
+  returning the candidate's answers.
+- :mod:`.policy` — the health gate: candidate vs. stable error rate
+  and serve-phase p99 over a sliding window.
+- :mod:`.controller` — the loop that ramps a healthy candidate
+  (1% → 5% → 25% → 100%), promotes it to the pinned stable, or
+  auto-rolls-back an unhealthy one.
+
+Wired through ``ptpu release {list,show,pin,promote,rollback,canary,
+status}`` and the engine server's ``/release.json`` +
+``/release/{canary,promote,rollback}`` routes. See
+docs/deployment.md "Release lifecycle".
+"""
+
+from .controller import RolloutController
+from .policy import ArmWindow, Decision, HealthPolicy, window_quantile
+from .registry import ReleaseEvent, ReleaseRegistry
+from .splitter import TrafficSplitter, cohort_bucket
+
+__all__ = [
+    "ArmWindow",
+    "Decision",
+    "HealthPolicy",
+    "ReleaseEvent",
+    "ReleaseRegistry",
+    "RolloutController",
+    "TrafficSplitter",
+    "cohort_bucket",
+    "window_quantile",
+]

@@ -30,13 +30,15 @@ from ..controller.context import Context
 from ..controller.engine import Engine
 from ..controller.params import EngineParams
 from ..data.event import Event, utcnow
-from ..data.storage.base import EngineInstance
+from ..data.storage.base import STATUS_COMPLETED, EngineInstance
 from ..obs import (
     DEFAULT_LATENCY_BOUNDS,
     POW2_COUNT_BOUNDS,
     MetricsRegistry,
     hbm_stats,
 )
+from ..rollout.registry import ReleaseRegistry
+from ..rollout.splitter import ARM_CANDIDATE, ARM_STABLE
 from ..utils.jsonutil import from_jsonable, to_jsonable
 from .http import (
     AppServer,
@@ -103,6 +105,24 @@ class ServerConfig:
     transfer_guard: Optional[str] = "log"
 
 
+@dataclass
+class CandidateBinding:
+    """A candidate release bound ALONGSIDE the stable one: its own
+    algorithms/models/serving so the two arms never share mutable
+    state. ``raw_models`` keep the as-loaded blobs — promotion rebinds
+    through the normal ``_bind`` path so the stable batch budget (and
+    its device placement) is re-derived, not inherited from the
+    candidate's batch-1 serving."""
+
+    engine_params: EngineParams
+    algorithms: List[Any]
+    models: List[Any]
+    raw_models: List[Any]
+    serving: Any
+    instance: EngineInstance
+    warm_done: threading.Event
+
+
 class QueryServer:
     """One deployed engine: algorithms + live models + serving logic."""
 
@@ -156,6 +176,34 @@ class QueryServer:
             bounds=POW2_COUNT_BOUNDS)
         self._query_errors = self.metrics.counter(
             "pio_query_errors_total", "Failed queries by status class")
+        # progressive delivery (ISSUE 3): per-release-arm series the
+        # rollout health gate windows over, the release registry this
+        # server's deploy/reload/promote/rollback actions are recorded
+        # in, and the (at most one) live candidate binding + controller
+        self._release_queries = self.metrics.counter(
+            "pio_release_queries_total",
+            "Queries served per release arm while a rollout is live")
+        self._release_errors = self.metrics.counter(
+            "pio_release_query_errors_total",
+            "Server-side (5xx) query failures per release arm while a "
+            "rollout is live")
+        self._release_latency = self.metrics.histogram(
+            "pio_release_latency_seconds",
+            "End-to-end serving wall time per release arm while a "
+            "rollout is live",
+            bounds=DEFAULT_LATENCY_BOUNDS)
+        self._shadow_mirrors = self.metrics.counter(
+            "pio_release_shadow_mirrors_total",
+            "Queries mirrored to a shadow candidate")
+        self.releases = ReleaseRegistry(
+            ctx.storage, instance.engine_id, instance.engine_version,
+            instance.engine_variant)
+        self.rollout = None  # the live RolloutController, if any
+        self._candidate: Optional[CandidateBinding] = None
+        self._algo_pool = None    # parallel per-algorithm dispatch
+        self._mirror_pool = None  # shadow mirrors (separate pool: a
+        # mirror runs query_candidate, which dispatches into the algo
+        # pool — sharing one pool could deadlock at saturation
         # recompile sentinel: armed when warmup finishes, so every
         # compile after that is a query paying a trace it shouldn't
         # (the runtime half of ptpu check's recompile-hazard lint)
@@ -240,9 +288,64 @@ class QueryServer:
         except Exception:  # noqa: BLE001 — observability, never a dep
             return nullcontext()
 
+    def _ensure_algo_pool(self):
+        with self._lock:
+            if self._algo_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._algo_pool = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="algo-dispatch")
+            return self._algo_pool
+
+    def _predict_all(self, algorithms: List[Any], models: List[Any],
+                     supplemented: Any) -> List[Any]:
+        """Per-algorithm predictions, dispatched CONCURRENTLY when the
+        engine has more than one algorithm (the reference served them
+        serially — ``CreateServer.scala:507-510`` "TODO: Parallelize";
+        predictions are independent by the DASE contract, serving sees
+        them in params order). The single-algorithm common case stays
+        pool-free."""
+        if len(algorithms) == 1:
+            return [algorithms[0].predict(models[0], supplemented)]
+        pool = self._ensure_algo_pool()
+        futures = [pool.submit(a.predict, m, supplemented)
+                   for a, m in zip(algorithms, models)]
+        return [f.result() for f in futures]
+
     def _record_phases(self, phases: dict) -> None:
         for phase, sec in phases.items():
             self._phase_hist.labels(phase=phase).observe(sec)
+
+    def _observe_release(self, arm: str, seconds: float,
+                         error: bool) -> None:
+        """Per-arm health series, recorded only while a rollout is
+        live (the controller windows these; client 4xx never counts
+        against an arm's health)."""
+        rollout = self.rollout
+        if rollout is None or not rollout.active:
+            return
+        self._release_queries.labels(arm=arm).inc()
+        if error:
+            self._release_errors.labels(arm=arm).inc()
+        self._release_latency.labels(arm=arm).observe(seconds)
+
+    def release_arm_snapshot(self, arm: str):
+        """Cumulative ``(queries, errors, latency buckets)`` for one
+        release arm — the rollout controller diffs successive snapshots
+        into sliding windows."""
+        return (self._release_queries.labels(arm=arm).value,
+                self._release_errors.labels(arm=arm).value,
+                self._release_latency.labels(arm=arm).bucket_counts())
+
+    def release_arms(self) -> dict:
+        """Live per-arm stats for ``/release.json`` and the bench."""
+        out = {}
+        for arm in (ARM_STABLE, ARM_CANDIDATE):
+            queries, errors, _ = self.release_arm_snapshot(arm)
+            out[arm] = {
+                "queries": int(queries), "errors": int(errors),
+                "latency": self._release_latency.labels(
+                    arm=arm).snapshot()}
+        return out
 
     def spans_summary(self) -> dict:
         """Percentile rows for the status page: each query phase plus
@@ -333,7 +436,10 @@ class QueryServer:
         for i, result in enumerate(out):
             # each coalesced query experienced the batch's wall time
             self._latency_hist.observe(dt)
-            if isinstance(result, HTTPError):
+            is_err = isinstance(result, HTTPError)
+            self._observe_release(
+                ARM_STABLE, dt, error=is_err and result.status >= 500)
+            if is_err:
                 self._query_errors.labels(
                     status=str(result.status)).inc()
             if obs_list is not None and i < len(obs_list) \
@@ -370,8 +476,8 @@ class QueryServer:
                 supplemented = serving.supplement(query)
                 t2 = time.monotonic()
                 phases["supplement"] = t2 - t1
-                predictions = [a.predict(m, supplemented)
-                               for a, m in zip(algorithms, models)]
+                predictions = self._predict_all(algorithms, models,
+                                                supplemented)
                 t3 = time.monotonic()
                 phases["dispatch"] = t3 - t2
                 # by design: serve sees the original query
@@ -390,12 +496,15 @@ class QueryServer:
             result = self.plugins.process_output(query_json, result)
         except Exception:
             self._query_errors.labels(status="500").inc()
+            self._observe_release(ARM_STABLE, time.monotonic() - t0,
+                                  error=True)
             self._record_phases(phases)
             raise
 
         dt = time.monotonic() - t0
         self._record_phases(phases)
         self._latency_hist.observe(dt)
+        self._observe_release(ARM_STABLE, dt, error=False)
         if obs is not None:
             obs.update({f"{k}Ms": round(v * 1000, 3)
                         for k, v in phases.items()})
@@ -435,6 +544,181 @@ class QueryServer:
             result = dict(result, prId=pr_id)
         return result
 
+    # -- progressive delivery (ISSUE 3) -------------------------------------
+    def bind_candidate(self, instance: EngineInstance,
+                       engine_params: Optional[EngineParams] = None,
+                       models: Optional[List[Any]] = None) -> None:
+        """Bind a candidate release ALONGSIDE the stable one (stable
+        serving is untouched). The candidate serves per-query (batch 1)
+        — at canary fractions there is nothing to coalesce — and warms
+        its serving shapes in the background."""
+        from ..workflow import core as wf
+
+        ep = engine_params or self.engine_params
+        if models is None:
+            models = wf.load_models_for_deploy(self.ctx, self.engine,
+                                               instance, ep)
+        algorithms = self.engine.make_algorithms(ep)
+        for algo in algorithms:
+            algo.bind_serving(self.ctx)
+        prepared = [a.prepare_serving_model(m, 1)
+                    for a, m in zip(algorithms, models)]
+        binding = CandidateBinding(
+            engine_params=ep, algorithms=algorithms, models=prepared,
+            raw_models=list(models),
+            serving=self.engine.make_serving(ep),
+            instance=instance, warm_done=threading.Event())
+
+        def _warm_candidate():
+            for algo, model in zip(algorithms, prepared):
+                warm = getattr(algo, "warm_serving", None)
+                if warm is None:
+                    continue
+                try:
+                    warm(model, 1)
+                except Exception as e:  # noqa: BLE001 — cold is slow,
+                    log.warning(        # not broken
+                        "candidate warmup failed for %s: %s",
+                        type(algo).__name__, e)
+            binding.warm_done.set()
+
+        threading.Thread(target=_warm_candidate, daemon=True,
+                         name="candidate-warmup").start()
+        with self._lock:
+            self._candidate = binding
+        log.info("candidate release %s bound alongside stable %s",
+                 instance.id, self.instance.id)
+
+    def drop_candidate(self) -> None:
+        with self._lock:
+            self._candidate = None
+
+    @property
+    def candidate_instance_id(self) -> Optional[str]:
+        cand = self._candidate
+        return cand.instance.id if cand is not None else None
+
+    def promote_candidate(self) -> str:
+        """Swap the candidate in as the stable release. The swap is the
+        same single-lock ``_bind`` every deploy/reload takes —
+        concurrent queries see either the old or the new binding in
+        full, never a mix — and the batch ladder re-warms so
+        post-promote traffic pays no cold compiles."""
+        with self._lock:
+            cand = self._candidate
+            self._candidate = None
+        if cand is None:
+            raise HTTPError(409, "no candidate release bound")
+        self._bind(cand.engine_params, cand.raw_models, cand.instance)
+        self._rewarm()
+        log.info("candidate %s promoted to serving stable",
+                 cand.instance.id)
+        return cand.instance.id
+
+    def start_canary(self, instance_id: str,
+                     fraction: Optional[float] = None,
+                     shadow: bool = False, actor: str = "",
+                     reason: str = "", policy=None,
+                     models: Optional[List[Any]] = None):
+        """Bind ``instance_id`` as the candidate and start the
+        health-gated rollout loop (canary split or shadow mirror).
+        Returns the live :class:`~..rollout.RolloutController`."""
+        from ..rollout import HealthPolicy, RolloutController
+
+        if self.rollout is not None and self.rollout.active:
+            raise HTTPError(409, "a rollout is already in progress "
+                            f"(candidate {self.rollout.instance_id})")
+        inst = self.ctx.storage.engine_instances().get(instance_id)
+        if inst is None:
+            raise HTTPError(
+                404, f"engine instance {instance_id!r} not found")
+        if inst.status != STATUS_COMPLETED:
+            raise HTTPError(
+                400, f"instance {instance_id!r} is {inst.status}, "
+                     f"not {STATUS_COMPLETED}")
+        if inst.id == self.instance.id:
+            raise HTTPError(
+                400, f"instance {instance_id!r} is already the "
+                     f"serving stable")
+        self.bind_candidate(inst, models=models)
+        pol = policy or HealthPolicy()
+        mode = "shadow" if shadow else "canary"
+        start_fraction = (fraction if fraction is not None
+                          else (1.0 if shadow else pol.ramp[0]))
+        try:
+            self.releases.start_candidate(
+                inst.id, start_fraction, mode=mode, actor=actor,
+                reason=reason)
+        except Exception as e:  # noqa: BLE001 — history is best-effort
+            log.error("release history write failed on %s: %s", mode, e)
+        controller = RolloutController(
+            self, self.releases, inst.id, policy=pol,
+            fraction=start_fraction, shadow=shadow,
+            actor=actor or "engine-server")
+        self.rollout = controller
+        controller.start()
+        return controller
+
+    def query_candidate(self, query_json: Any,
+                        obs: Optional[dict] = None) -> Any:
+        """Serve one query off the CANDIDATE binding (canary route or
+        shadow mirror). Leaner than the stable path by design: no
+        feedback events (the ``prId`` lineage belongs to the stable
+        release — a rolled-back candidate must leave no trace in the
+        event store) and no micro-batching."""
+        t0 = time.monotonic()
+        with self._lock:
+            cand = self._candidate
+        if cand is None:
+            raise HTTPError(503, "no candidate release bound")
+        try:
+            query = from_jsonable(cand.algorithms[0].query_class,
+                                  query_json)
+        except (TypeError, ValueError) as e:
+            # malformed input is the client's fault: it must not count
+            # against the candidate's health
+            self._query_errors.labels(status="400").inc()
+            raise HTTPError(400, str(e))
+        try:
+            with self._transfer_guard():
+                supplemented = cand.serving.supplement(query)
+                predictions = self._predict_all(
+                    cand.algorithms, cand.models, supplemented)
+                prediction = cand.serving.serve(query, predictions)
+            result = to_jsonable(prediction)
+            result = self.plugins.process_output(query_json, result)
+        except Exception:
+            self._query_errors.labels(status="500").inc()
+            self._observe_release(ARM_CANDIDATE,
+                                  time.monotonic() - t0, error=True)
+            raise
+        dt = time.monotonic() - t0
+        self._observe_release(ARM_CANDIDATE, dt, error=False)
+        if obs is not None:
+            obs["releaseArm"] = ARM_CANDIDATE
+        return result
+
+    def mirror_to_candidate(self, query_json: Any) -> None:
+        """Shadow mode: replay the query against the candidate from a
+        pool thread. The answer is discarded (the arm metrics keep the
+        outcome); errors are counted and swallowed — mirroring must
+        never slow or fail stable traffic."""
+        with self._lock:
+            if self._mirror_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._mirror_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="shadow-mirror")
+            pool = self._mirror_pool
+
+        def _mirror():
+            try:
+                self.query_candidate(query_json)
+            except Exception:  # noqa: BLE001 — counted in arm metrics
+                pass
+
+        self._shadow_mirrors.inc()
+        pool.submit(_mirror)
+
     def remote_log(self, message: str, wait: bool = False) -> None:
         """Ship an error to the configured log collector
         (``remoteLog``, ``CreateServer.scala:435-446``); failures to ship
@@ -464,33 +748,62 @@ class QueryServer:
             threading.Thread(target=ship, daemon=True,
                              name="remote-log").start()
 
+    def _rewarm(self) -> None:
+        """Re-warm after a rebind (reload/promote): the swapped-in
+        models may have new device shapes (catalog growth changes the
+        compiled [B, n_items] kernels) — re-warm so post-rebind traffic
+        doesn't pay cold compiles while /status.json still says warm."""
+        if not self.config.warm_start:
+            return
+        with self._lock:  # pairs with _warm_serving's check+set
+            self._warm_gen += 1
+            gen = self._warm_gen
+            self.warm_done.clear()
+        threading.Thread(target=self._warm_serving,
+                         args=(gen,), daemon=True,
+                         name="serving-rewarm").start()
+
     def reload(self) -> str:
-        """Rebind to the latest COMPLETED instance
-        (``MasterActor.receive`` :342-371)."""
+        """Rebind through the release registry: the PINNED release when
+        one is set, else the latest COMPLETED instance (the reference's
+        ``MasterActor.receive`` :342-371 semantics). Every reload is a
+        recorded release action."""
         from ..workflow import core as wf
 
-        latest = self.ctx.storage.engine_instances().get_latest_completed(
-            self.instance.engine_id, self.instance.engine_version,
-            self.instance.engine_variant)
-        if latest is None:
-            raise HTTPError(404, "no COMPLETED engine instance to reload")
+        instances = self.ctx.storage.engine_instances()
+        pinned = None
+        try:
+            pinned = self.releases.pinned_instance()
+        except Exception as e:  # noqa: BLE001 — registry must never
+            log.error(          # make a model unreloadable
+                "release registry read failed; reloading latest: %s", e)
+        if pinned:
+            latest = instances.get(pinned)
+            if latest is None or latest.status != STATUS_COMPLETED:
+                raise HTTPError(
+                    409, f"pinned release {pinned!r} is not a "
+                         f"COMPLETED engine instance (unpin or re-pin)")
+        else:
+            latest = instances.get_latest_completed(
+                self.instance.engine_id, self.instance.engine_version,
+                self.instance.engine_variant)
+            if latest is None:
+                raise HTTPError(
+                    404, "no COMPLETED engine instance to reload")
         engine_params = self.engine_params
         models = wf.load_models_for_deploy(self.ctx, self.engine, latest,
                                            engine_params)
         self._bind(engine_params, models, latest)
-        # the swapped-in models may have new device shapes (catalog
-        # growth changes the compiled [B, n_items] kernels) — re-warm so
-        # post-reload traffic doesn't pay cold compiles while
-        # /status.json still says warm
-        if self.config.warm_start:
-            with self._lock:  # pairs with _warm_serving's check+set
-                self._warm_gen += 1
-                gen = self._warm_gen
-                self.warm_done.clear()
-            threading.Thread(target=self._warm_serving,
-                             args=(gen,), daemon=True,
-                             name="serving-rewarm").start()
-        log.info("reloaded engine instance %s", latest.id)
+        self._rewarm()
+        try:
+            self.releases.record_deploy(
+                latest.id, actor="/reload",
+                reason=("pinned release" if pinned
+                        else "latest COMPLETED instance"))
+        except Exception as e:  # noqa: BLE001 — history is best-effort
+            log.error("release history write failed on reload: %s", e)
+        log.info("reloaded engine instance %s%s", latest.id,
+                 " (pinned)" if pinned else "")
         return latest.id
 
 
@@ -516,6 +829,24 @@ def build_app(server: QueryServer) -> HTTPApp:
                 out[label] = v
         return out
 
+    def _release_summary() -> dict:
+        """Compact release state for /status.json and the status page."""
+        rollout = server.rollout
+        active = rollout is not None and rollout.active
+        state: dict = {}
+        try:
+            state = server.releases.state()
+        except Exception:  # noqa: BLE001 — status must always render
+            pass
+        return {
+            "stable": server.instance.id,
+            "pinned": state.get("pinned", ""),
+            "candidate": server.candidate_instance_id or "",
+            "mode": (("shadow" if rollout.shadow else "canary")
+                     if active else ""),
+            "fraction": rollout.splitter.fraction if active else 0.0,
+        }
+
     @app.route("GET", "/")
     def index(req: Request) -> Response:
         inst = server.instance
@@ -536,6 +867,36 @@ def build_app(server: QueryServer) -> HTTPApp:
             "<th>p50 (ms)</th><th>p90 (ms)</th><th>p99 (ms)</th>"
             "<th>max (ms)</th></tr>" + "".join(rows) + "</table>"
             if rows else "")
+        # release panel (ISSUE 3): which release serves, what is
+        # canarying/shadowing at what fraction, recent history
+        rel = _release_summary()
+        rel_rows = [
+            f"<li>stable release: {html.escape(rel['stable'])}</li>"]
+        if rel["pinned"]:
+            rel_rows.append(
+                f"<li>pinned: {html.escape(rel['pinned'])}</li>")
+        if rel["candidate"]:
+            rel_rows.append(
+                f"<li>candidate: {html.escape(rel['candidate'])} "
+                f"({html.escape(rel['mode'])} at "
+                f"{rel['fraction'] * 100:.0f}%)</li>")
+        hist_rows = []
+        try:
+            for ev in server.releases.history(limit=5):
+                hist_rows.append(
+                    f"<tr><td>{html.escape(ev.time[:19])}</td>"
+                    f"<td>{html.escape(ev.action)}</td>"
+                    f"<td>{html.escape(ev.instance_id)}</td>"
+                    f"<td>{html.escape(ev.actor)}</td>"
+                    f"<td>{html.escape(ev.reason)}</td></tr>")
+        except Exception:  # noqa: BLE001 — status must always render
+            pass
+        release_panel = (
+            "<h2>Release</h2><ul>" + "".join(rel_rows) + "</ul>"
+            + ("<table border='1'><tr><th>time</th><th>action</th>"
+               "<th>instance</th><th>actor</th><th>reason</th></tr>"
+               + "".join(hist_rows) + "</table>" if hist_rows else "")
+            + "<p><a href='/release.json'>release.json</a></p>")
         body = f"""<html><head><title>{html.escape(inst.engine_id)} \
 - predictionio_tpu engine server</title></head><body>
 <h1>Engine: {html.escape(inst.engine_id)} v{html.escape(inst.engine_version)}</h1>
@@ -547,7 +908,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
-</ul>{table}
+</ul>{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
         return Response(body=body, content_type="text/html")
@@ -559,7 +920,9 @@ def build_app(server: QueryServer) -> HTTPApp:
         return json_response({
             "engineId": server.instance.engine_id,
             "engineVersion": server.instance.engine_version,
+            "engineVariant": server.instance.engine_variant,
             "engineInstanceId": server.instance.id,
+            "release": _release_summary(),
             "requestCount": server.request_count,
             "avgServingSec": server.avg_serving_sec,
             "lastServingSec": server.last_serving_sec,
@@ -578,6 +941,23 @@ def build_app(server: QueryServer) -> HTTPApp:
         except (ValueError, UnicodeDecodeError) as e:
             raise HTTPError(400, str(e))
         try:
+            # progressive delivery: the splitter routes a cohort of
+            # queries to the candidate (canary) or mirrors them to it
+            # (shadow) while the stable arm keeps serving everyone else
+            rollout = server.rollout
+            if rollout is not None and rollout.active \
+                    and rollout.splitter.routes_candidate(query_json):
+                if rollout.shadow:
+                    server.mirror_to_candidate(query_json)
+                else:
+                    try:
+                        return json_response(server.query_candidate(
+                            query_json, obs=req.obs))
+                    except HTTPError as e:
+                        if e.status != 503:
+                            raise
+                        # the candidate unbound mid-flight (rollback
+                        # won the race) — the stable arm serves below
             if batcher is not None:
                 result = batcher.submit(query_json, obs=req.obs)
                 if isinstance(result, HTTPError):
@@ -601,9 +981,104 @@ def build_app(server: QueryServer) -> HTTPApp:
         return json_response({"message": "Reloading...",
                               "engineInstanceId": instance_id})
 
+    # -- progressive delivery routes (ISSUE 3) ------------------------------
+    @app.route("GET", "/release.json")
+    def release_json(req: Request) -> Response:
+        payload = server.releases.to_json()
+        rollout = server.rollout
+        payload["serving"] = {
+            "stableInstanceId": server.instance.id,
+            "candidateInstanceId": server.candidate_instance_id,
+        }
+        payload["rollout"] = (rollout.status()
+                              if rollout is not None else None)
+        payload["arms"] = server.release_arms()
+        return json_response(payload)
+
+    @app.route("POST", "/release/canary")
+    def release_canary(req: Request) -> Response:
+        """Start a canary (or shadow) rollout of a COMPLETED instance:
+        ``{"instanceId": ..., "fraction": 0.05, "shadow": false,
+        "reason": ...}``. The health gate ramps or rolls back from
+        here; ``/release.json`` tracks it."""
+        from ..rollout.splitter import parse_fraction
+
+        _auth(req)
+        try:
+            body = req.json() or {}
+        except (ValueError, UnicodeDecodeError) as e:
+            raise HTTPError(400, str(e))
+        instance_id = body.get("instanceId") or ""
+        if not instance_id:
+            raise HTTPError(400, "instanceId required")
+        fraction = None
+        if body.get("fraction") is not None:
+            try:
+                fraction = parse_fraction(body["fraction"])
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+        controller = server.start_canary(
+            instance_id, fraction=fraction,
+            shadow=bool(body.get("shadow")),
+            actor=body.get("actor") or "http",
+            reason=body.get("reason") or "")
+        return json_response({"message": "Rollout started.",
+                              "rollout": controller.status()})
+
+    @app.route("POST", "/release/promote")
+    def release_promote(req: Request) -> Response:
+        """Force-promote the live candidate to stable (skips the rest
+        of the ramp; the operator override for shadow rollouts)."""
+        _auth(req)
+        try:
+            body = req.json() or {}
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        reason = body.get("reason") or "operator promote"
+        rollout = server.rollout
+        if rollout is not None and rollout.active:
+            rollout.promote(reason)
+            return json_response({"message": "Promoted.",
+                                  "engineInstanceId":
+                                      rollout.instance_id})
+        instance_id = server.promote_candidate()  # 409 when none bound
+        try:
+            server.releases.promote(instance_id, actor="http",
+                                    reason=reason)
+        except Exception as e:  # noqa: BLE001 — serving already moved
+            log.error("release history write failed on promote: %s", e)
+        return json_response({"message": "Promoted.",
+                              "engineInstanceId": instance_id})
+
+    @app.route("POST", "/release/rollback")
+    def release_rollback(req: Request) -> Response:
+        """Roll back: abort the live candidate, or — with none bound —
+        revert stable to the previous release and rebind it."""
+        _auth(req)
+        try:
+            body = req.json() or {}
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        reason = body.get("reason") or "operator rollback"
+        rollout = server.rollout
+        if rollout is not None and rollout.active:
+            rollout.rollback(reason)
+            return json_response({"message": "Rolled back.",
+                                  "engineInstanceId":
+                                      server.instance.id})
+        try:
+            server.releases.rollback(actor="http", reason=reason)
+        except ValueError as e:
+            raise HTTPError(409, str(e))
+        instance_id = server.reload()  # binds the re-pinned previous
+        return json_response({"message": "Rolled back.",
+                              "engineInstanceId": instance_id})
+
     @app.route("POST", "/stop")
     def stop(req: Request) -> Response:
         _auth(req)
+        if server.rollout is not None:
+            server.rollout.stop()  # loop only; bindings die with us
 
         def delayed_shutdown():
             # grace period so THIS response flushes before the listener
@@ -749,16 +1224,40 @@ def deploy(ctx: Context, engine: Engine, engine_params: EngineParams,
            host: str = "0.0.0.0", port: int = 8000,
            ssl_context=None) -> AppServer:
     """The ``pio deploy`` flow (``commands/Engine.scala:207`` →
-    ``CreateServer``): find the latest COMPLETED instance, re-materialize
-    its models, bind the HTTP server."""
+    ``CreateServer``), through the release registry: bind the PINNED
+    release when one is set, else the latest COMPLETED instance, and
+    record the deploy so every model that reaches traffic has a
+    recorded, reversible release."""
     from ..workflow import core as wf
 
-    instance = ctx.storage.engine_instances().get_latest_completed(
-        engine_id, engine_version, engine_variant)
-    if instance is None:
-        raise RuntimeError(
-            f"No COMPLETED engine instance for {engine_id} {engine_version} "
-            f"{engine_variant}; run train first.")
+    releases = ReleaseRegistry(ctx.storage, engine_id, engine_version,
+                               engine_variant)
+    pinned = None
+    try:
+        pinned = releases.pinned_instance()
+    except Exception as e:  # noqa: BLE001 — registry must never make a
+        log.error(          # model undeployable
+            "release registry read failed; deploying latest: %s", e)
+    if pinned:
+        instance = ctx.storage.engine_instances().get(pinned)
+        if instance is None or instance.status != STATUS_COMPLETED:
+            raise RuntimeError(
+                f"Pinned release {pinned!r} is not a COMPLETED engine "
+                f"instance; `ptpu release pin --clear` or re-pin.")
+    else:
+        instance = ctx.storage.engine_instances().get_latest_completed(
+            engine_id, engine_version, engine_variant)
+        if instance is None:
+            raise RuntimeError(
+                f"No COMPLETED engine instance for {engine_id} "
+                f"{engine_version} {engine_variant}; run train first.")
     models = wf.load_models_for_deploy(ctx, engine, instance, engine_params)
     server = QueryServer(ctx, engine, engine_params, models, instance, config)
+    try:
+        releases.record_deploy(
+            instance.id, actor="pio deploy",
+            reason=("pinned release" if pinned
+                    else "latest COMPLETED instance"))
+    except Exception as e:  # noqa: BLE001 — history is best-effort
+        log.error("release history write failed on deploy: %s", e)
     return create_engine_server(server, host, port, ssl_context=ssl_context)

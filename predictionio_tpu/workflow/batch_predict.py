@@ -21,6 +21,23 @@ from ..controller.params import EngineParams
 from ..data.storage.base import EngineInstance
 from ..utils.jsonutil import from_jsonable, to_jsonable
 
+_dispatch_pool = None
+
+
+def _algo_pool():
+    """Shared executor for concurrent per-algorithm dispatches (the
+    reference's ``CreateServer.scala:507-510`` "TODO: Parallelize" —
+    per-algorithm predictions are independent by the DASE contract).
+    Module-level so multi-algorithm engines don't pay pool setup per
+    coalesced batch."""
+    global _dispatch_pool
+    if _dispatch_pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _dispatch_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="algo-batch-dispatch")
+    return _dispatch_pool
+
 
 def predict_serve_batch(algorithms: List[Any], models: List[Any],
                         serving: Any, queries: List[Any],
@@ -50,8 +67,16 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
         timings["supplement"] = timings.get("supplement", 0.0) + (t1 - t0)
     if live:
         try:
-            per_algo = [a.batch_predict(m, supplemented)
-                        for a, m in zip(algorithms, models)]
+            if len(algorithms) == 1:
+                per_algo = [algorithms[0].batch_predict(models[0],
+                                                        supplemented)]
+            else:
+                # independent per-algorithm dispatches run concurrently;
+                # results stay in params order (serving depends on it)
+                futures = [_algo_pool().submit(a.batch_predict, m,
+                                               supplemented)
+                           for a, m in zip(algorithms, models)]
+                per_algo = [f.result() for f in futures]
         except Exception as e:  # noqa: BLE001 — one dispatch, whole batch
             for i in live:
                 out[i] = e
