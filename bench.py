@@ -24,11 +24,21 @@ Honesty model (BASELINE.md "bench accounting"):
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
 
 import numpy as np
+
+#: CSI/SGR escape sequences (jax's colored tracebacks) — stripped from
+#: error strings before they land in BENCH JSON, which must stay
+#: greppable plain text (BENCH_LASTGOOD.json carried raw `\x1b[2m`)
+_ANSI_RE = re.compile(r"\x1b\[[0-9;]*[A-Za-z]")
+
+
+def _strip_ansi(s: str) -> str:
+    return _ANSI_RE.sub("", s)
 
 #: Headline peak matmul FLOP/s by TPU generation (bf16; public spec
 #: sheets). MFU is reported against this even though the bench runs f32 —
@@ -330,7 +340,9 @@ def main():
                         retried += 1
                         time.sleep(10.0)
                         continue
-                    cand_errors.append(f"{gm}/{gd}{f'/br{br}' if br else ''}: {str(ce)[:120]}")
+                    cand_errors.append(
+                        f"{gm}/{gd}{f'/br{br}' if br else ''}: "
+                        f"{_strip_ansi(str(ce))[:120]}")
                     f32_failed = f32_failed or gd == "float32"
                     break
         if best_params is None:

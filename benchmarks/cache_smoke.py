@@ -1,0 +1,152 @@
+"""Serving-cache smoke (ISSUE 4) — the CI gate for the cache hierarchy.
+
+End-to-end over real HTTP on whatever device is available (CI: CPU):
+
+1. deploy a synthetic model with the serving cache ON; repeat a query
+   and PROVE the second serve was a cache hit (and faster paths exist:
+   /cache.json hit counters move);
+2. ingest an event for that entity through the REAL event server and
+   prove the bus invalidated the cached result (invalidations > 0 and
+   the next serve is a recompute);
+3. fire concurrent identical misses and prove singleflight collapsed
+   them;
+4. operator flush via POST /cache/flush empties every tier.
+
+Prints one JSON line; exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from datetime import datetime, timezone
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from predictionio_tpu.controller import Context  # noqa: E402
+from predictionio_tpu.data.bimap import BiMap  # noqa: E402
+from predictionio_tpu.data.storage import App, Storage  # noqa: E402
+from predictionio_tpu.data.storage.base import (  # noqa: E402
+    STATUS_COMPLETED,
+    AccessKey,
+    EngineInstance,
+)
+from predictionio_tpu.models.als import ALSModel, ALSParams  # noqa: E402
+from predictionio_tpu.server.engineserver import (  # noqa: E402
+    QueryServer,
+    ServerConfig,
+    create_engine_server,
+)
+from predictionio_tpu.server.eventserver import (  # noqa: E402
+    build_app as build_event_app,
+)
+from predictionio_tpu.server.http import AppServer  # noqa: E402
+from predictionio_tpu.templates.recommendation import (  # noqa: E402
+    default_engine_params,
+    recommendation_engine,
+)
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else (
+        b"" if method == "POST" else None)
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank = 200, 200, 8
+    model = ALSModel(
+        user_factors=rng.standard_normal(
+            (n_users, rank)).astype(np.float32),
+        item_factors=rng.standard_normal(
+            (n_items, rank)).astype(np.float32),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, "cachesmoke"))
+    storage.access_keys().insert(
+        AccessKey(key="SMOKE", app_id=app_id, events=()))
+    ctx = Context(app_name="cachesmoke", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="smoke", status=STATUS_COMPLETED, start_time=now,
+        end_time=now, engine_id="smoke", engine_version="1",
+        engine_variant="engine.json", engine_factory="synthetic")
+    storage.engine_instances().insert(inst)
+    qs = QueryServer(
+        ctx, recommendation_engine(),
+        default_engine_params("cachesmoke", rank=rank), [model], inst,
+        ServerConfig(warm_start=False, serving_cache=True,
+                     cache_ttl_sec=600.0))
+    srv = create_engine_server(qs, "127.0.0.1", 0).start_background()
+    ev_srv = AppServer(build_event_app(storage), "127.0.0.1",
+                       0).start_background()
+    checks = {}
+    try:
+        # 1) hit
+        q = {"user": "u7", "num": 5}
+        r1 = call(srv.port, "POST", "/queries.json", q)
+        t0 = time.monotonic()
+        r2 = call(srv.port, "POST", "/queries.json", q)
+        hit_ms = (time.monotonic() - t0) * 1000
+        tiers = call(srv.port, "GET", "/cache.json")["tiers"]
+        checks["hit"] = (r1 == r2 and tiers["query"]["hits"] >= 1)
+        checks["hit_ms"] = round(hit_ms, 3)
+
+        # 2) ingest → invalidation → recompute
+        call(ev_srv.port, "POST", "/events.json?accessKey=SMOKE",
+             {"event": "view", "entityType": "user", "entityId": "u7",
+              "targetEntityType": "item", "targetEntityId": "i3"})
+        tiers = call(srv.port, "GET", "/cache.json")["tiers"]
+        checks["invalidated"] = tiers["query"]["invalidations"] >= 1
+        misses_before = tiers["query"]["misses"]
+        call(srv.port, "POST", "/queries.json", q)  # recompute
+        tiers = call(srv.port, "GET", "/cache.json")["tiers"]
+        checks["recomputed"] = tiers["query"]["misses"] > misses_before
+
+        # 3) singleflight: concurrent identical misses collapse
+        flights_q = {"user": "u42", "num": 5}
+        threads = [threading.Thread(
+            target=lambda: call(srv.port, "POST", "/queries.json",
+                                flights_q)) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = call(srv.port, "GET", "/cache.json")
+        checks["singleflight"] = (
+            stats["singleflightCoalesced"] + stats["tiers"]["query"][
+                "hits"] >= 2)
+
+        # 4) operator flush
+        removed = call(srv.port, "POST", "/cache/flush")["removed"]
+        tiers = call(srv.port, "GET", "/cache.json")["tiers"]
+        checks["flush"] = (removed.get("query", 0) >= 1
+                           and tiers["query"]["entries"] == 0)
+    finally:
+        srv.shutdown()
+        ev_srv.shutdown()
+
+    ok = all(v for k, v in checks.items() if k != "hit_ms")
+    print(json.dumps({"bench": "cache_smoke", "ok": ok, **checks}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

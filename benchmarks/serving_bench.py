@@ -21,8 +21,17 @@ splitter's hash-of-entity cohort, health gate held) and reports
 stable-vs-candidate p50/p99 side by side from the server's own per-arm
 release series — the canary latency-overhead view.
 
+With ``--zipf ALPHA``, the workload's users are drawn from a Zipf(α)
+distribution instead of uniform — the hot-entity skew production
+recommendation traffic actually has. With ``--cache`` (ISSUE 4), the
+device per-query config runs TWICE on that skewed workload — serving
+cache off vs on — and a trailing hot-query loop measures the pure
+cache-hit latency; the emitted row reports cached-vs-uncached p50/p99
+side by side plus the server's own /cache.json tier stats.
+
 Usage: python benchmarks/serving_bench.py [n_items_device] [rank]
                                           [--canary FRACTION]
+                                          [--zipf ALPHA] [--cache]
 Env:   SERVE_THREADS (8), SERVE_REQUESTS (400 per config)
 """
 
@@ -81,8 +90,17 @@ def synth_model(n_users: int, n_items: int, rank: int,
         params=ALSParams(rank=rank))
 
 
+def _sample_users(rng, n_users: int, n: int, zipf=None) -> np.ndarray:
+    """Uniform user draw, or Zipf(α)-skewed when ``zipf`` is set (rank
+    1 = the hottest user; wrapped into the id space)."""
+    if zipf is None:
+        return rng.integers(0, n_users, n)
+    return (rng.zipf(float(zipf), size=n) - 1) % n_users
+
+
 def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
-                 n_threads: int, label: str) -> dict:
+                 n_threads: int, label: str, zipf=None,
+                 hot_hit_probe: int = 0) -> dict:
     storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
     storage.apps().insert(App(0, "servebench"))
     ctx = Context(app_name="servebench", _storage=storage)
@@ -98,7 +116,7 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     srv.start_background()
     port = srv.port
     rng = np.random.default_rng(1)
-    users = rng.integers(0, model.n_users, n_requests)
+    users = _sample_users(rng, model.n_users, n_requests, zipf)
 
     # wait for the server-side warmup (ServerConfig.warm_start compiles
     # the single-query + pow2 batch ladder), then a few real queries
@@ -167,6 +185,49 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     for t in threads:
         t.join()
     wall = time.monotonic() - t_start
+    # hot-query probe (ISSUE 4): with the serving cache on, repeat ONE
+    # hot user's query sequentially — after the first fill these are
+    # pure cache hits, measuring the parse→cache→respond floor the
+    # acceptance gate compares against the uncached device p50
+    hot_hit = None
+    if hot_hit_probe > 0:
+        import http.client
+
+        hot_body = json.dumps({"user": f"u{users[0]}",
+                               "num": 10}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=120)
+        try:
+            hot_lat = []
+            for i in range(hot_hit_probe + 1):
+                t0 = time.monotonic()
+                conn.request("POST", "/queries.json", body=hot_body,
+                             headers={"Content-Type":
+                                      "application/json"})
+                conn.getresponse().read()
+                if i > 0:  # drop the (possible) fill miss
+                    hot_lat.append(time.monotonic() - t0)
+        finally:
+            conn.close()
+        arr_h = np.sort(np.asarray(hot_lat)) * 1e3
+        hot_hit = {
+            "n": len(arr_h),
+            "p50_ms": round(float(np.percentile(arr_h, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr_h, 99)), 3),
+        }
+    cache_stats = None
+    if cfg.serving_cache:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/cache.json",
+                    timeout=30) as resp:
+                tiers = json.loads(resp.read()).get("tiers") or {}
+            cache_stats = {
+                name: {"hits": t.get("hits"), "misses": t.get("misses"),
+                       "hitRatio": round(t.get("hitRatio", 0.0), 4)}
+                for name, t in tiers.items()}
+        except Exception as e:  # noqa: BLE001 — stats are advisory
+            cache_stats = {"error": str(e)[:200]}
     # scrape the server's own telemetry BEFORE shutdown (ISSUE 2): the
     # emitted bench line carries compilesSinceWarm + transfer-guard
     # violations so the perf trajectory captures recompile storms and
@@ -196,7 +257,7 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
             f"(first: {errors[0] if errors else 'none'}) — latency "
             f"numbers would describe a degraded load, refusing")
     arr = np.sort(np.asarray(lat)) * 1e3
-    return {
+    out = {
         "config": label,
         "n": len(arr),
         "p50_ms": round(float(np.percentile(arr, 50)), 2),
@@ -205,6 +266,13 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
         "qps": round(len(arr) / wall, 1),
         "telemetry": telemetry,
     }
+    if zipf is not None:
+        out["zipf"] = float(zipf)
+    if hot_hit is not None:
+        out["hot_hit"] = hot_hit
+    if cache_stats is not None:
+        out["cache"] = cache_stats
+    return out
 
 
 def standard_battery(n_items_dev: int, rank: int, n_req: int,
@@ -344,6 +412,31 @@ def bench_canary(model: ALSModel, candidate: ALSModel, fraction: float,
     }
 
 
+def bench_cached_pair(n_items_dev: int, rank: int, n_req: int,
+                      n_threads: int, zipf) -> list:
+    """The --cache view: the SAME Zipf-skewed workload against the
+    device per-query config with the serving cache off vs on, plus the
+    pure cache-hit probe — cached-vs-uncached p50/p99 side by side."""
+    dev_model = synth_model(50_000, n_items_dev, rank, device=True)
+    uncached = bench_config(
+        dev_model, ServerConfig(), n_req, n_threads,
+        "device_per_query_zipf", zipf=zipf)
+    cached_cfg = ServerConfig(
+        serving_cache=True, cache_ttl_sec=600.0,
+        hot_entities=512, hot_refresh_every=64)
+    cached = bench_config(
+        dev_model, cached_cfg, n_req, n_threads,
+        "device_per_query_cached", zipf=zipf,
+        hot_hit_probe=max(100, n_req // 4))
+    hit_p50 = (cached.get("hot_hit") or {}).get("p50_ms")
+    if hit_p50 is not None and uncached["p50_ms"]:
+        # the acceptance ratio: hot-query (cache-hit) p50 against the
+        # UNCACHED device per-query p50
+        cached["hit_vs_uncached_p50"] = round(
+            hit_p50 / uncached["p50_ms"], 4)
+    return [uncached, cached]
+
+
 def main() -> None:
     argv = sys.argv[1:]
     canary_fraction = None
@@ -351,6 +444,15 @@ def main() -> None:
         i = argv.index("--canary")
         canary_fraction = float(argv[i + 1])
         del argv[i:i + 2]
+    zipf_alpha = None
+    if "--zipf" in argv:
+        i = argv.index("--zipf")
+        zipf_alpha = float(argv[i + 1])
+        del argv[i:i + 2]
+    with_cache = False
+    if "--cache" in argv:
+        with_cache = True
+        argv.remove("--cache")
     sys.argv[1:] = argv
     n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -369,6 +471,9 @@ def main() -> None:
     hi = int(os.environ.get("SERVE_THREADS_HI", "256"))
     results = list(standard_battery(n_items_dev, rank, n_requests,
                                     n_threads, hi).values())
+    if with_cache:
+        results.extend(bench_cached_pair(n_items_dev, rank, n_requests,
+                                         n_threads, zipf_alpha))
     if canary_fraction is not None:
         dev_model = synth_model(50_000, n_items_dev, rank, device=True)
         cand_model = synth_model(50_000, n_items_dev, rank, device=True)

@@ -1,0 +1,30 @@
+"""Serving-path cache hierarchy (ISSUE 4).
+
+Three tiers between HTTP parse and device dispatch — an exact-key
+query-result cache (sharded LRU+TTL with singleflight), a feature/
+supplement cache for serving-time event-store reads, and a
+device-resident hot-entity tier — kept honest by an invalidation bus
+the event server publishes to on every ingest. See
+docs/serving-cache.md for semantics and tuning.
+
+Pure host-side code: importing this package never touches jax (the
+event server and storage-only CLI commands import it).
+"""
+
+from .bus import InvalidationBus, default_bus
+from .hierarchy import ServingCache, canonical_key, entity_tag
+from .hot import HotEntityTier
+from .lru import ShardedTTLCache, approx_bytes
+from .singleflight import SingleFlight
+
+__all__ = [
+    "HotEntityTier",
+    "InvalidationBus",
+    "ServingCache",
+    "ShardedTTLCache",
+    "SingleFlight",
+    "approx_bytes",
+    "canonical_key",
+    "default_bus",
+    "entity_tag",
+]

@@ -1,0 +1,154 @@
+"""Device-resident hot-entity tier: pin the Zipf head on the device.
+
+Production recommendation traffic is heavily skewed — a few percent of
+users produce most queries (the hot-entity skew Google's ads serving
+and ALX both exploit; PAPERS.md arxiv 2501.10546 / 2112.02194). This
+tier counts per-entity serve traffic and periodically **pins** the
+top-K hottest entities through a caller-supplied ``pin_fn`` — for the
+ALS templates that means gathering those users' factor rows into one
+small device-resident ``[K, rank]`` table
+(:meth:`~predictionio_tpu.templates.recommendation.ALSAlgorithm.pin_hot_entities`),
+so a known-hot user's query skips the host-side row gather + transfer
+and dispatches straight off HBM.
+
+The tier never blocks serving: ``record``/``lookup`` are dict lookups;
+the refresh (hit-stat ranking + device transfer) runs on a background
+thread, and the pinned map is swapped atomically. ``flush()`` (called
+on every rebind — promote/rollback/reload) drops pins AND hit stats so
+a new model never serves rows pinned from the old one.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HotEntityTier"]
+
+#: pin_fn signature: (entity_keys) -> ({entity: handle}, pinned_bytes)
+PinFn = Callable[[list], Tuple[Dict[str, Any], int]]
+
+
+class HotEntityTier:
+    def __init__(self, pin_fn: PinFn, capacity: int = 512,
+                 refresh_every: int = 256) -> None:
+        self.pin_fn = pin_fn
+        self.capacity = max(capacity, 1)
+        self.refresh_every = max(refresh_every, 1)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._pinned: Dict[str, Any] = {}
+        self._bytes = 0
+        self._records = 0
+        self._hits = 0
+        self._misses = 0
+        self._refreshes = 0
+        self._generation = 0  # bumped by flush(); stale refreshes drop
+        self._refreshing = False
+        self._refresh_done: Optional[threading.Event] = None
+
+    # -- hot path -----------------------------------------------------------
+    def record(self, key: str) -> None:
+        """Count one serve for ``key``; every ``refresh_every`` records
+        a background re-pin is scheduled."""
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._records += 1
+            due = self._records % self.refresh_every == 0
+            # bound the stat map: keep the head, drop the long tail
+            if len(self._counts) > 8 * self.capacity:
+                keep = sorted(self._counts.items(),
+                              key=lambda kv: kv[1],
+                              reverse=True)[:2 * self.capacity]
+                self._counts = dict(keep)
+        if due:
+            self.refresh(wait=False)
+
+    def lookup(self, key: str) -> Optional[Any]:
+        """The pinned handle for ``key``, or None (counts hit/miss)."""
+        with self._lock:
+            handle = self._pinned.get(key)
+            if handle is not None:
+                self._hits += 1
+            else:
+                self._misses += 1
+        return handle
+
+    # -- refresh ------------------------------------------------------------
+    def refresh(self, wait: bool = True) -> None:
+        """Re-rank the hit stats and re-pin the top-K. ``wait=False``
+        runs it on a daemon thread (the serving-path mode); at most one
+        refresh runs at a time — ``wait=True`` against an in-flight
+        refresh blocks until THAT one lands instead of skipping."""
+        start = False
+        with self._lock:
+            if not self._refreshing:
+                self._refreshing = True
+                self._refresh_done = threading.Event()
+                start = True
+            done = self._refresh_done
+        if start:
+            if wait:
+                self._refresh_now()
+            else:
+                threading.Thread(target=self._refresh_now, daemon=True,
+                                 name="hot-tier-refresh").start()
+        elif wait and done is not None:
+            done.wait(timeout=120)
+
+    def _refresh_now(self) -> None:
+        try:
+            with self._lock:
+                gen = self._generation
+                top = sorted(self._counts.items(), key=lambda kv: kv[1],
+                             reverse=True)[:self.capacity]
+                keys = [k for k, _ in top]
+            if not keys:
+                return
+            handles, nbytes = self.pin_fn(keys)
+            with self._lock:
+                if gen != self._generation:
+                    return  # flushed (rebind) while we were pinning
+                self._pinned = dict(handles)
+                self._bytes = int(nbytes)
+                self._refreshes += 1
+        except Exception as e:  # noqa: BLE001 — a failed pin only
+            log.warning("hot-entity pin refresh failed: %s", e)  # loses
+        finally:                                  # the fast path, never
+            with self._lock:                      # breaks serving
+                self._refreshing = False
+                if self._refresh_done is not None:
+                    self._refresh_done.set()
+
+    def flush(self) -> int:
+        """Drop pins and hit stats (model rebind / operator flush)."""
+        with self._lock:
+            n = len(self._pinned)
+            self._pinned = {}
+            self._counts = {}
+            self._bytes = 0
+            self._generation += 1
+        return n
+
+    # -- observability ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            hits, misses = self._hits, self._misses
+            out = {
+                "entries": len(self._pinned),
+                "bytes": self._bytes,
+                "capacity": self.capacity,
+                "hits": hits,
+                "misses": misses,
+                "evictions": 0,
+                "invalidations": self._generation,
+                "records": self._records,
+                "refreshes": self._refreshes,
+                "trackedEntities": len(self._counts),
+            }
+        total = hits + misses
+        out["hitRatio"] = (hits / total) if total else 0.0
+        return out

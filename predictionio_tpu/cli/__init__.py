@@ -311,7 +311,12 @@ def cmd_deploy(args, storage: Storage) -> int:
         batching=args.batching,
         max_batch=args.max_batch,
         batch_window_ms=args.batch_window_ms,
-        batch_pipeline=args.batch_pipeline)
+        batch_pipeline=args.batch_pipeline,
+        serving_cache=args.cache,
+        cache_entries=args.cache_entries,
+        cache_ttl_sec=args.cache_ttl,
+        feature_ttl_sec=args.feature_ttl,
+        hot_entities=args.hot_entities)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -830,6 +835,44 @@ def cmd_release(args, storage: Storage) -> int:
     return 1
 
 
+def cmd_cache(args, storage: Storage) -> int:
+    """``ptpu cache`` — operate a running engine server's serving
+    cache hierarchy (ISSUE 4): per-tier stats, operator flush."""
+    sub = args.cache_command
+    if sub == "stats":
+        try:
+            payload = _server_call(args, "/cache.json")
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"engine server at {args.ip}:{args.port} unreachable: "
+                 f"{_http_err_detail(e)}")
+            return 1
+        if not (payload or {}).get("enabled"):
+            _out("Serving cache is OFF on this server "
+                 "(deploy with --cache).")
+            return 0
+        _out(json.dumps(payload, indent=2))
+        tiers = payload.get("tiers") or {}
+        for name, t in tiers.items():
+            total = t.get("hits", 0) + t.get("misses", 0)
+            _out(f"{name}: {t.get('entries', 0)} entries, "
+                 f"{t.get('hitRatio', 0) * 100:.1f}% hit ratio over "
+                 f"{total} lookups, {t.get('invalidations', 0)} "
+                 f"invalidations")
+        return 0
+    if sub == "flush":
+        try:
+            payload = _server_call(args, "/cache/flush", method="POST")
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"cache flush failed: {_http_err_detail(e)}")
+            return 1
+        removed = (payload or {}).get("removed") or {}
+        _out("Flushed: " + ", ".join(f"{k}={v}"
+                                     for k, v in removed.items()))
+        return 0
+    _err(f"Unknown cache subcommand {sub!r}")
+    return 1
+
+
 def _http_err_detail(e: Exception) -> str:
     """Surface the server's JSON error message instead of a bare
     'HTTP Error 409'."""
@@ -1132,6 +1175,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="wait for a lone query before serving it solo")
     s.add_argument("--batch-pipeline", type=int, default=4,
                    help="concurrent batch dispatches in flight")
+    s.add_argument("--cache", action="store_true",
+                   help="serving cache hierarchy: query-result + "
+                        "feature caches and the device-resident "
+                        "hot-entity tier (docs/serving-cache.md)")
+    s.add_argument("--cache-entries", type=int, default=8192,
+                   help="query-result cache capacity (entries)")
+    s.add_argument("--cache-ttl", type=float, default=30.0,
+                   help="query-result staleness bound (seconds)")
+    s.add_argument("--feature-ttl", type=float, default=5.0,
+                   help="serving-time event-store read staleness "
+                        "bound (seconds)")
+    s.add_argument("--hot-entities", type=int, default=512,
+                   help="hottest entities pinned on device (0 off)")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -1194,6 +1250,19 @@ def build_parser() -> argparse.ArgumentParser:
         "status", help="live /release.json from the engine server "
                        "(falls back to storage state)")
     add_release_flags(r, server=True)
+
+    s = sub.add_parser(
+        "cache", help="serving cache: per-tier stats, operator flush")
+    cache_sub = s.add_subparsers(dest="cache_command", required=True)
+    for name, helptext in (("stats", "per-tier hit/miss/eviction/"
+                                     "invalidation stats"),
+                           ("flush", "flush every cache tier")):
+        c = cache_sub.add_parser(name, help=helptext)
+        c.add_argument("--ip", default="127.0.0.1")
+        c.add_argument("--port", type=int, default=8000)
+        c.add_argument("--accesskey", default="")
+        c.add_argument("--https", action="store_true")
+        c.add_argument("--insecure", action="store_true")
 
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)
@@ -1297,6 +1366,7 @@ COMMANDS = {
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
     "release": cmd_release,
+    "cache": cmd_cache,
     "batchpredict": cmd_batchpredict,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

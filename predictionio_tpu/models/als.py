@@ -29,7 +29,7 @@ from __future__ import annotations
 import functools
 import threading
 from dataclasses import dataclass, field
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1440,6 +1440,44 @@ def ensure_device_resident(model: ALSModel,
             user_factors=jax.device_put(model.user_factors),
             item_factors=jax.device_put(model.item_factors))
     return model
+
+
+def pin_user_rows(model: ALSModel, user_indices: Sequence[int],
+                  capacity: int) -> Tuple[Optional[jax.Array], int]:
+    """Hot-entity tier (ISSUE 4): gather the given users' factor rows
+    into ONE device-resident ``[capacity, rank]`` table. The table is
+    padded to the FIXED capacity so its serving program compiles once
+    per process — refreshes that re-rank the hot set reuse the same
+    compiled shape instead of paying a post-warm trace per refresh.
+
+    Returns ``(pinned_table, nbytes)``; ``(None, 0)`` for host-served
+    models (the host fast path has no gather/transfer to skip)."""
+    if _serve_on_host(model, batch=1) or not len(user_indices):
+        return None, 0
+    cap = max(int(capacity), 1)
+    idx = np.zeros(cap, dtype=np.int64)
+    n = min(len(user_indices), cap)
+    idx[:n] = np.asarray(list(user_indices)[:n], dtype=np.int64)
+    rows = np.asarray(model.user_factors)[idx]  # one host gather per
+    pinned = jax.device_put(rows)               # refresh, not per query
+    pinned.block_until_ready()
+    return pinned, int(rows.nbytes)
+
+
+def recommend_pinned(model: ALSModel, pinned: jax.Array, slot: int,
+                     k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k for one PINNED hot user: the row gather runs against the
+    small HBM-resident pinned table instead of the full ``[U, rank]``
+    factor matrix (which, for a re-materialized host-resident model,
+    would cost a host gather + device transfer on every query)."""
+    k_dev = _compiled_k(k, model.n_items)
+    scores, ids = _serve_topk(
+        pinned, jnp.asarray(model.item_factors),
+        jnp.asarray(np.asarray([slot], dtype=np.int64)),
+        k=k_dev, n_items=model.n_items)
+    k = min(k, model.n_items)
+    ids, scores = jax.device_get((ids, scores))
+    return ids[0][:k], scores[0][:k]
 
 
 def recommend_products(model: ALSModel, user_index: int, k: int

@@ -103,6 +103,26 @@ class ServerConfig:
     #: errors (canary deployments); "allow"/"off"/None disables. Applied
     #: only once warmup is done: warmup itself legitimately transfers.
     transfer_guard: Optional[str] = "log"
+    #: Serving cache hierarchy (ISSUE 4): an exact-key query-result
+    #: cache consulted BEFORE the micro-batcher (hot queries skip
+    #: supplement/dispatch entirely, singleflight dedups concurrent
+    #: identical misses), a feature cache for serving-time event-store
+    #: reads, and a device-resident hot-entity tier — all invalidated
+    #: by the event server's ingest bus and flushed on every rebind.
+    #: Off by default: turning result caching on is a staleness
+    #: decision the operator must make (see docs/serving-cache.md).
+    serving_cache: bool = False
+    cache_entries: int = 8192          # query-tier LRU capacity
+    #: query-result staleness BOUND: the bus usually invalidates far
+    #: sooner; this TTL is the ceiling when ingest happens in another
+    #: process (no in-process bus delivery)
+    cache_ttl_sec: float = 30.0
+    feature_cache_entries: int = 8192
+    feature_ttl_sec: float = 5.0       # event-store read staleness bound
+    #: hottest entities whose factor rows stay pinned on device
+    #: (0 disables the tier)
+    hot_entities: int = 512
+    hot_refresh_every: int = 256       # re-rank/re-pin cadence (serves)
 
 
 @dataclass
@@ -145,6 +165,9 @@ class QueryServer:
                     f"feedback app {app_name!r} does not exist")
         self.plugins = plugins or EngineServerPlugins()
         self._lock = threading.RLock()
+        # serving cache hierarchy (ISSUE 4): built BEFORE the first
+        # _bind so the bind can wire the feature tier into algorithms
+        self.cache = self._make_cache()
         self._bind(engine_params, models, instance)
         # bookkeeping (CreateServer.scala:415-417)
         self.start_time = utcnow()
@@ -219,6 +242,14 @@ class QueryServer:
             "pio_serving_warm",
             "1 once the serving shapes are pre-compiled",
             fn=lambda: 1.0 if self.warm_done.is_set() else 0.0)
+        if self.cache is not None:
+            self.cache.register_metrics(self.metrics)
+        # the micro-batcher lives on the server (not build_app) so the
+        # cached serve() path and direct embedders share one batcher
+        self.batcher = (MicroBatcher(self, self.config.batch_window_ms,
+                                     self.config.max_batch,
+                                     pipeline=self.config.batch_pipeline)
+                        if self.config.batching else None)
         self._warm_gen = 0  # stale warm threads must not set the event
         if self.config.warm_start:
             threading.Thread(target=self._warm_serving, args=(0,),
@@ -256,11 +287,17 @@ class QueryServer:
     def _bind(self, engine_params: EngineParams, models: List[Any],
               instance: EngineInstance) -> None:
         with self._lock:
+            if self.cache is not None:
+                # FULL flush on every rebind (deploy/reload/promote):
+                # a new model must never serve results — or pinned
+                # factor rows — computed by the old one (ISSUE 4)
+                self.cache.flush_all()
             self.engine_params = engine_params
             self.instance = instance
             self.algorithms = self.engine.make_algorithms(engine_params)
             for algo in self.algorithms:
                 algo.bind_serving(self.ctx)
+                self._bind_feature_cache(algo)
             # fix device placement ONCE at bind (deploy/reload), not
             # per query — a re-materialized model holds numpy factors
             bind_batch = self.config.max_batch if self.config.batching \
@@ -268,6 +305,43 @@ class QueryServer:
             self.models = [a.prepare_serving_model(m, bind_batch)
                            for a, m in zip(self.algorithms, models)]
             self.serving = self.engine.make_serving(engine_params)
+
+    def _bind_feature_cache(self, algo: Any) -> None:
+        """Hand the feature tier to algorithms that cache serving-time
+        event-store reads (e.g. the e-commerce template's seen/
+        unavailable/weighted/recent lookups)."""
+        if self.cache is None:
+            return
+        bind = getattr(algo, "bind_feature_cache", None)
+        if bind is not None:
+            bind(self.cache.features)
+
+    def _make_cache(self):
+        cfg = self.config
+        if not cfg.serving_cache:
+            return None
+        from ..cache import ServingCache
+
+        return ServingCache(
+            query_entries=cfg.cache_entries,
+            query_ttl_sec=cfg.cache_ttl_sec,
+            feature_entries=cfg.feature_cache_entries,
+            feature_ttl_sec=cfg.feature_ttl_sec,
+            hot_capacity=cfg.hot_entities,
+            hot_refresh_every=cfg.hot_refresh_every,
+            pin_fn=self._pin_hot)
+
+    def _pin_hot(self, entity_keys: List[str]):
+        """Hot-tier pin callback: delegate to the (single) algorithm's
+        ``pin_hot_entities`` against the CURRENT stable binding."""
+        with self._lock:
+            algorithms, models = self.algorithms, self.models
+        if len(algorithms) != 1:
+            return {}, 0  # multi-algo serving blends predictions;
+        pin = getattr(algorithms[0], "pin_hot_entities", None)  # a
+        if pin is None:                  # single-algo pin would skew
+            return {}, 0
+        return pin(models[0], entity_keys)
 
     def _transfer_guard(self):
         """Post-warmup queries run under ``jax.transfer_guard`` so any
@@ -310,6 +384,31 @@ class QueryServer:
         futures = [pool.submit(a.predict, m, supplemented)
                    for a, m in zip(algorithms, models)]
         return [f.result() for f in futures]
+
+    def _dispatch_predictions(self, algorithms: List[Any],
+                              models: List[Any],
+                              supplemented: Any) -> List[Any]:
+        """Per-query dispatch with the hot-entity fast path (ISSUE 4):
+        a known-hot user's prediction runs off the pinned device-
+        resident row table (``predict_pinned``), skipping the full
+        factor-table gather; anything unusual falls back to the normal
+        path — the tier is an accelerator, never a correctness
+        dependency."""
+        cache = self.cache
+        if (cache is not None and cache.hot is not None
+                and len(algorithms) == 1):
+            entity = getattr(supplemented, "user", None)
+            handle = (cache.hot.lookup(str(entity))
+                      if entity is not None else None)
+            pinned = getattr(algorithms[0], "predict_pinned", None)
+            if handle is not None and pinned is not None:
+                try:
+                    return [pinned(models[0], supplemented, handle)]
+                except Exception as e:  # noqa: BLE001 — e.g. a pin
+                    log.warning(        # raced a rebind; serve normally
+                        "pinned hot-path serve failed, falling "
+                        "back: %s", e)
+        return self._predict_all(algorithms, models, supplemented)
 
     def _record_phases(self, phases: dict) -> None:
         for phase, sec in phases.items():
@@ -369,6 +468,115 @@ class QueryServer:
             if r is not None:
                 out["query (end-to-end)"] = r
         return out
+
+    # -- cached serving entrypoints (ISSUE 4) --------------------------------
+    @staticmethod
+    def _entity_of(query_json: Any) -> Optional[str]:
+        """The query's primary entity (the cache-tag / hot-tier key).
+        Every bundled template keys queries by ``user``; entity-less
+        queries cache fine but can't be invalidated per-entity (the
+        TTL bound covers them)."""
+        if isinstance(query_json, dict):
+            entity = query_json.get("user")
+            if entity is not None:
+                return str(entity)
+        return None
+
+    def _record_cache_hit(self, arm: str, t0: float,
+                          obs: Optional[dict]) -> None:
+        dt = time.monotonic() - t0
+        self._latency_hist.observe(dt)
+        self._observe_release(arm, dt, error=False)
+        if obs is not None:
+            obs["cache"] = "hit"
+        with self._lock:
+            self.last_serving_sec = dt
+            self.avg_serving_sec = (
+                (self.avg_serving_sec * self.request_count + dt)
+                / (self.request_count + 1))
+            self.request_count += 1
+
+    def _compute_stable(self, query_json: Any,
+                        obs: Optional[dict]) -> Any:
+        """The uncached stable pipeline: micro-batcher when configured,
+        else the per-query path. Returns the jsonable result or an
+        ``HTTPError`` instance (the batcher's slot contract); the
+        per-query path raises instead — callers handle both."""
+        if self.batcher is not None:
+            return self.batcher.submit(query_json, obs=obs)
+        return self.query(query_json, obs=obs)
+
+    def serve(self, query_json: Any, obs: Optional[dict] = None) -> Any:
+        """The stable-arm serving entry ``/queries.json`` uses: query
+        cache → singleflight → batcher/per-query compute → cache fill.
+        A cache hit skips supplement and device dispatch entirely;
+        concurrent identical misses compute ONCE. Returns the result
+        or an ``HTTPError`` instance; may also raise ``HTTPError``."""
+        cache = self.cache
+        if cache is None:
+            return self._compute_stable(query_json, obs)
+        from ..cache import canonical_key, entity_tag
+
+        t0 = time.monotonic()
+        key = (self.instance.id, canonical_key(query_json))
+        entity = self._entity_of(query_json)
+        if entity is not None and cache.hot is not None:
+            cache.hot.record(entity)
+        found, value = cache.query.lookup(key)
+        if found:
+            self._record_cache_hit(ARM_STABLE, t0, obs)
+            return value
+        tag = entity_tag("user", entity) if entity is not None else None
+
+        def compute() -> Any:
+            # epoch BEFORE the pipeline runs: an ingest that lands
+            # mid-compute moves it, and the fill is dropped instead of
+            # caching a result the invalidation already condemned
+            token = cache.epoch_token(tag)
+            result = self._compute_stable(query_json, obs)
+            if not isinstance(result, HTTPError):
+                cache.put_query_fresh(
+                    key, result, (tag,) if tag else (), token)
+            return result
+
+        result, leader = cache.flight.do(key, compute)
+        if obs is not None and not leader:
+            obs["cache"] = "coalesced"
+        return result
+
+    def serve_candidate(self, query_json: Any,
+                        obs: Optional[dict] = None) -> Any:
+        """The candidate-arm serving entry: same cache discipline as
+        :meth:`serve` under the CANDIDATE instance's namespace — the
+        two arms can never serve each other's cached results. Raises
+        like :meth:`query_candidate`."""
+        cache = self.cache
+        with self._lock:
+            cand = self._candidate
+        if cache is None or cand is None:
+            return self.query_candidate(query_json, obs=obs)
+        from ..cache import canonical_key, entity_tag
+
+        t0 = time.monotonic()
+        key = (cand.instance.id, canonical_key(query_json))
+        found, value = cache.query.lookup(key)
+        if found:
+            self._record_cache_hit(ARM_CANDIDATE, t0, obs)
+            return value
+        entity = self._entity_of(query_json)
+        tag = entity_tag("user", entity) if entity is not None else None
+
+        def compute() -> Any:
+            token = cache.epoch_token(tag)
+            result = self.query_candidate(query_json, obs=obs)
+            cache.put_query_fresh(key, result, (tag,) if tag else (),
+                                  token)
+            return result
+
+        result, leader = cache.flight.do(key, compute)
+        if obs is not None and not leader:
+            obs["cache"] = "coalesced"
+        return result
 
     # -- batched hot path ---------------------------------------------------
     def query_batch(self, query_jsons: List[Any],
@@ -476,8 +684,8 @@ class QueryServer:
                 supplemented = serving.supplement(query)
                 t2 = time.monotonic()
                 phases["supplement"] = t2 - t1
-                predictions = self._predict_all(algorithms, models,
-                                                supplemented)
+                predictions = self._dispatch_predictions(
+                    algorithms, models, supplemented)
                 t3 = time.monotonic()
                 phases["dispatch"] = t3 - t2
                 # by design: serve sees the original query
@@ -561,6 +769,7 @@ class QueryServer:
         algorithms = self.engine.make_algorithms(ep)
         for algo in algorithms:
             algo.bind_serving(self.ctx)
+            self._bind_feature_cache(algo)
         prepared = [a.prepare_serving_model(m, 1)
                     for a, m in zip(algorithms, models)]
         binding = CandidateBinding(
@@ -591,7 +800,12 @@ class QueryServer:
 
     def drop_candidate(self) -> None:
         with self._lock:
+            cand = self._candidate
             self._candidate = None
+        if cand is not None and self.cache is not None:
+            # rollback: the dead arm's cached results must die with it
+            # (stable's namespace — still serving — is left intact)
+            self.cache.flush_namespace(cand.instance.id)
 
     @property
     def candidate_instance_id(self) -> Optional[str]:
@@ -810,9 +1024,6 @@ class QueryServer:
 def build_app(server: QueryServer) -> HTTPApp:
     app = HTTPApp("engineserver")
     cfg = server.config
-    batcher = (MicroBatcher(server, cfg.batch_window_ms, cfg.max_batch,
-                            pipeline=cfg.batch_pipeline)
-               if cfg.batching else None)
 
     _auth = make_key_auth(cfg.accesskey)
 
@@ -846,6 +1057,16 @@ def build_app(server: QueryServer) -> HTTPApp:
                      if active else ""),
             "fraction": rollout.splitter.fraction if active else 0.0,
         }
+
+    def _cache_line() -> str:
+        if server.cache is None:
+            return ""
+        tiers = server.cache.stats()["tiers"]
+        parts = [f"{name} {t['hitRatio'] * 100:.0f}% of "
+                 f"{t['hits'] + t['misses']}"
+                 for name, t in tiers.items()]
+        return ("<li>cache hit ratio: " + html.escape(", ".join(parts))
+                + " (<a href='/cache.json'>cache.json</a>)</li>")
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -908,6 +1129,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
+{_cache_line()}
 </ul>{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
@@ -931,8 +1153,33 @@ def build_app(server: QueryServer) -> HTTPApp:
             "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
             "hbm": hbm_stats(),
+            "cache": (server.cache.stats() if server.cache is not None
+                      else {"enabled": False}),
             **_phase_table(),
         })
+
+    # -- serving cache operations (ISSUE 4) ----------------------------------
+    @app.route("GET", "/cache.json")
+    def cache_json(req: Request) -> Response:
+        """Per-tier hit/miss/eviction/invalidation stats (what
+        ``ptpu cache stats`` prints)."""
+        if server.cache is None:
+            return json_response({"enabled": False,
+                                  "hint": "deploy with --cache (or "
+                                          "ServerConfig(serving_cache="
+                                          "True)) to enable the "
+                                          "serving cache hierarchy"})
+        return json_response(server.cache.stats())
+
+    @app.route("POST", "/cache/flush")
+    def cache_flush(req: Request) -> Response:
+        """Operator flush of every tier (``ptpu cache flush``);
+        key-guarded like the other control routes."""
+        _auth(req)
+        if server.cache is None:
+            raise HTTPError(409, "serving cache is not enabled")
+        return json_response({"message": "Flushed.",
+                              "removed": server.cache.flush_all()})
 
     @app.route("POST", "/queries.json")
     def queries(req: Request) -> Response:
@@ -951,19 +1198,19 @@ def build_app(server: QueryServer) -> HTTPApp:
                     server.mirror_to_candidate(query_json)
                 else:
                     try:
-                        return json_response(server.query_candidate(
+                        return json_response(server.serve_candidate(
                             query_json, obs=req.obs))
                     except HTTPError as e:
                         if e.status != 503:
                             raise
                         # the candidate unbound mid-flight (rollback
                         # won the race) — the stable arm serves below
-            if batcher is not None:
-                result = batcher.submit(query_json, obs=req.obs)
-                if isinstance(result, HTTPError):
-                    raise result
-                return json_response(result)
-            return json_response(server.query(query_json, obs=req.obs))
+            # the cached stable entry: query cache → singleflight →
+            # micro-batcher / per-query pipeline (ISSUE 4)
+            result = server.serve(query_json, obs=req.obs)
+            if isinstance(result, HTTPError):
+                raise result
+            return json_response(result)
         except HTTPError as e:
             # batch-wide failures are logged ONCE by the batcher, not by
             # each of the coalesced handler threads

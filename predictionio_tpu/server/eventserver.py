@@ -16,6 +16,7 @@ import logging
 from dataclasses import dataclass
 from typing import List, Optional
 
+from ..cache.bus import InvalidationBus, default_bus
 from ..data.event import Event, EventValidationError, parse_iso
 from ..data.storage.base import EventFilter, ANY
 from ..data.storage.registry import Storage, get_storage
@@ -84,11 +85,16 @@ def _allowed(auth: AuthData, event_name: str) -> bool:
 
 
 def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
-              plugins: Optional[EventServerPlugins] = None) -> HTTPApp:
+              plugins: Optional[EventServerPlugins] = None,
+              bus: Optional[InvalidationBus] = None) -> HTTPApp:
     st = storage if storage is not None else get_storage()
     collector = StatsCollector() if stats else None
     plug = plugins or EventServerPlugins()
     app = HTTPApp("eventserver")
+    # serving-cache invalidation (ISSUE 4): every accepted ingest is
+    # published so a cached result contradicted by this event dies NOW
+    # (same-process engine servers) instead of at the TTL bound
+    inval_bus = bus if bus is not None else default_bus()
 
     # telemetry (ISSUE 2): event-ingest counters + the shared runtime
     # series; /metrics and an enriched /status.json via mount_metrics
@@ -99,6 +105,20 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
     ingested = registry.counter(
         "pio_events_ingested_total",
         "Events accepted into the store, by ingest route")
+    invalidations_pub = registry.counter(
+        "pio_cache_bus_published_total",
+        "Ingested events published to the serving-cache invalidation "
+        "bus (deliveries = publishes × live subscribers)")
+
+    def _publish(app_id: int, event: Event) -> None:
+        """Best-effort bus publish: ingest NEVER fails because a cache
+        subscriber did."""
+        try:
+            inval_bus.publish(app_id, event.entity_type,
+                              event.entity_id, event.event)
+            invalidations_pub.inc()
+        except Exception as e:  # noqa: BLE001
+            log.error("invalidation publish failed: %s", e)
     mount_metrics(app, registry, server_name="eventserver",
                   status=lambda: {"status": "alive",
                                   "statsEnabled": bool(collector)})
@@ -145,6 +165,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
         plug.process_input(auth.app_id, auth.channel_id, event)
         event_id = st.events().insert(event, auth.app_id, auth.channel_id)
         ingested.labels(route="events").inc()
+        _publish(auth.app_id, event)
         if collector:
             collector.bookkeeping(auth.app_id, 201, event)
         return json_response({"eventId": event_id}, 201)
@@ -222,6 +243,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                 for (pos, event), eid in zip(valid, ids):
                     results[pos] = {"status": 201, "eventId": eid}
                     ingested.labels(route="batch").inc()
+                    _publish(auth.app_id, event)
                     if collector:
                         collector.bookkeeping(auth.app_id, 201, event)
             else:
@@ -231,6 +253,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                                                  auth.channel_id)
                         results[pos] = {"status": 201, "eventId": eid}
                         ingested.labels(route="batch").inc()
+                        _publish(auth.app_id, event)
                         if collector:
                             collector.bookkeeping(auth.app_id, 201, event)
                     except Exception as e:  # noqa: BLE001
@@ -287,6 +310,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
             raise HTTPError(400, str(e))
         event_id = st.events().insert(event, auth.app_id, auth.channel_id)
         ingested.labels(route="webhook").inc()
+        _publish(auth.app_id, event)
         if collector:
             collector.bookkeeping(auth.app_id, 201, event)
         return json_response({"eventId": event_id}, 201)

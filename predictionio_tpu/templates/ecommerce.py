@@ -214,12 +214,32 @@ class ECommAlgorithm(Algorithm):
         # was deployed against, not the process-global default
         self._serving_store = ctx.event_store
 
+    def bind_feature_cache(self, cache) -> None:
+        """Engine-server hook (ISSUE 4): serving-time filter reads go
+        through this :class:`~..cache.ShardedTTLCache` tier — a hot
+        user's seen/recent sets and the app-wide constraint reads stop
+        hitting storage once per query. Entries are tagged with the
+        entity they derive from, so the invalidation bus clears them
+        the moment a contradicting event is ingested."""
+        self._feature_cache = cache
+
     def _ctx_store(self):
         store = getattr(self, "_serving_store", None)
         if store is not None:
             return store
         from ..data.store import event_store
         return event_store
+
+    def _cached_read(self, key: tuple, tags: Tuple[str, ...], fn):
+        cache = getattr(self, "_feature_cache", None)
+        if cache is None:
+            return fn()
+        found, value = cache.lookup(key)
+        if found:
+            return value
+        value = fn()
+        cache.put(key, value, tags=tags)
+        return value
 
     def gen_black_list(self, query: Query, app_name: str) -> Set[str]:
         """query.blackList + seen items + unavailableItems constraint
@@ -228,68 +248,111 @@ class ECommAlgorithm(Algorithm):
         p = self.params
         seen: Set[str] = set()
         if p.unseen_only:
+            def read_seen() -> Set[str]:
+                out: Set[str] = set()
+                try:
+                    for e in self._ctx_store().find_by_entity(
+                            app_name, "user", query.user,
+                            event_names=list(p.seen_events),
+                            target_entity_type="item",
+                            timeout_ms=p.timeout_ms):
+                        if e.target_entity_id:
+                            out.add(e.target_entity_id)
+                except Exception as err:
+                    log.error("error reading seen events: %s", err)
+                return out
+
+            seen = self._cached_read(
+                ("ecomm-seen", app_name, query.user, p.seen_events),
+                (f"user:{query.user}",), read_seen)
+
+        def read_unavailable() -> Set[str]:
             try:
-                for e in self._ctx_store().find_by_entity(
-                        app_name, "user", query.user,
-                        event_names=list(p.seen_events),
-                        target_entity_type="item",
-                        timeout_ms=p.timeout_ms):
-                    if e.target_entity_id:
-                        seen.add(e.target_entity_id)
+                evs = self._ctx_store().find_by_entity(
+                    app_name, "constraint", "unavailableItems",
+                    event_names=["$set"], limit=1, latest=True,
+                    timeout_ms=p.timeout_ms)
+                if evs:
+                    return set(evs[0].properties.get("items") or ())
             except Exception as err:
-                log.error("error reading seen events: %s", err)
-        unavailable: Set[str] = set()
-        try:
-            evs = self._ctx_store().find_by_entity(
-                app_name, "constraint", "unavailableItems",
-                event_names=["$set"], limit=1, latest=True,
-                timeout_ms=p.timeout_ms)
-            if evs:
-                unavailable = set(evs[0].properties.get("items") or ())
-        except Exception as err:
-            log.error("error reading unavailableItems: %s", err)
+                log.error("error reading unavailableItems: %s", err)
+            return set()
+
+        unavailable = self._cached_read(
+            ("ecomm-unavailable", app_name),
+            ("constraint:unavailableItems",), read_unavailable)
         return set(query.black_list or ()) | seen | unavailable
 
     def weighted_items(self, app_name: str) -> List[Tuple[Set[str], float]]:
         """Latest ``weightedItems`` constraint → weight groups
         (``weightedItems`` :399-425)."""
         p = self.params
-        try:
-            evs = self._ctx_store().find_by_entity(
-                app_name, "constraint", "weightedItems",
-                event_names=["$set"], limit=1, latest=True,
-                timeout_ms=p.timeout_ms)
-            if evs:
-                return [(set(g["items"]), float(g["weight"]))
-                        for g in (evs[0].properties.get("weights") or ())]
-        except Exception as err:
-            log.error("error reading weightedItems: %s", err)
-        return []
+
+        def read_weighted() -> List[Tuple[Set[str], float]]:
+            try:
+                evs = self._ctx_store().find_by_entity(
+                    app_name, "constraint", "weightedItems",
+                    event_names=["$set"], limit=1, latest=True,
+                    timeout_ms=p.timeout_ms)
+                if evs:
+                    return [(set(g["items"]), float(g["weight"]))
+                            for g in (evs[0].properties.get("weights")
+                                      or ())]
+            except Exception as err:
+                log.error("error reading weightedItems: %s", err)
+            return []
+
+        return self._cached_read(("ecomm-weighted", app_name),
+                                 ("constraint:weightedItems",),
+                                 read_weighted)
 
     def get_recent_items(self, query: Query, app_name: str) -> Set[str]:
         """Latest 10 similar-events targets (``getRecentItems`` :427-462)."""
         p = self.params
-        try:
-            return {e.target_entity_id for e in self._ctx_store()
-                    .find_by_entity(
-                        app_name, "user", query.user,
-                        event_names=list(p.similar_events),
-                        target_entity_type="item", limit=10, latest=True,
-                        timeout_ms=p.timeout_ms)
-                    if e.target_entity_id}
-        except Exception as err:
-            log.error("error reading recent events: %s", err)
-            return set()
+
+        def read_recent() -> Set[str]:
+            try:
+                return {e.target_entity_id for e in self._ctx_store()
+                        .find_by_entity(
+                            app_name, "user", query.user,
+                            event_names=list(p.similar_events),
+                            target_entity_type="item", limit=10,
+                            latest=True, timeout_ms=p.timeout_ms)
+                        if e.target_entity_id}
+            except Exception as err:
+                log.error("error reading recent events: %s", err)
+                return set()
+
+        return self._cached_read(
+            ("ecomm-recent", app_name, query.user, p.similar_events),
+            (f"user:{query.user}",), read_recent)
 
     # -- predict ---------------------------------------------------------------
     def _weights_vector(self, model: ECommModel,
                         app_name: str) -> np.ndarray:
+        """The per-item weight vector, computed ONCE per (model,
+        app_name, weights-constraint) generation. The old code rebuilt
+        an O(n_items) vector with a Python loop on EVERY predict; the
+        weight groups change only when a new ``weightedItems`` $set
+        lands, so the vector is memoized against the groups' content
+        (and a weakref to the model — new model means new item index
+        space) and rebuilt only when either changes."""
+        import weakref
+
+        groups = self.weighted_items(app_name)
+        sig = tuple(sorted((weight, tuple(sorted(items)))
+                           for items, weight in groups))
+        memo = getattr(self, "_weights_memo", None)
+        if (memo is not None and memo[0]() is model
+                and memo[1] == app_name and memo[2] == sig):
+            return memo[3]
         w = np.ones(len(model.item_ids), dtype=np.float64)
-        for items, weight in self.weighted_items(app_name):
-            for it in items:
-                idx = model.item_ids.get(it)
-                if idx is not None:
-                    w[idx] = weight
+        for items, weight in groups:
+            idx = [model.item_ids[it] for it in items
+                   if it in model.item_ids]
+            if idx:
+                w[idx] = weight
+        self._weights_memo = (weakref.ref(model), app_name, sig, w)
         return w
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:

@@ -233,6 +233,10 @@ class ALSAlgorithm(Algorithm):
                         params=self.params)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        return self._predict_impl(model, query, pinned=None)
+
+    def _predict_impl(self, model: ALSModel, query: Query,
+                      pinned) -> PredictedResult:
         uidx = model.user_ids.get(query.user) if model.user_ids else None
         if uidx is None:
             return PredictedResult()  # unknown user (reference returns empty)
@@ -241,13 +245,57 @@ class ALSAlgorithm(Algorithm):
         # over-fetch by the blacklist size, then filter (the variant's
         # recommendProductsWithFilter, blacklist-items ALSAlgorithm.scala:
         # 102-104)
-        ids, scores = recommend_products(model, int(uidx),
-                                         query.num + len(black))
+        if pinned is not None:
+            from ..models.als import recommend_pinned
+
+            table, slot = pinned
+            ids, scores = recommend_pinned(model, table, slot,
+                                           query.num + len(black))
+        else:
+            ids, scores = recommend_products(model, int(uidx),
+                                             query.num + len(black))
         inv = model.item_ids.inverse
         out = [(int(i), float(s)) for i, s in zip(ids, scores)
                if int(i) not in black][: query.num]
         return PredictedResult(tuple(
             ItemScore(item=inv[i], score=s) for i, s in out))
+
+    # -- hot-entity tier hooks (ISSUE 4) ------------------------------------
+    def pin_hot_entities(self, model: ALSModel,
+                         entity_keys: Sequence[str]):
+        """Pin the hottest users' factor rows as ONE device-resident
+        table (:func:`~..models.als.pin_user_rows`); returns
+        ``({user: (table, slot)}, nbytes)``. Host-served models return
+        empty — there is no transfer to skip. The pinned table is
+        padded to a pow2 capacity and its k-ladder warmed here (on the
+        refresh thread), so the first hot-path query after a refresh
+        never pays a compile."""
+        from ..models.als import pin_user_rows, recommend_pinned
+
+        known = [(e, int(model.user_ids[e])) for e in entity_keys
+                 if model.user_ids and e in model.user_ids]
+        if not known:
+            return {}, 0
+        cap = 1
+        while cap < len(known):
+            cap *= 2
+        table, nbytes = pin_user_rows(model, [u for _, u in known], cap)
+        if table is None:
+            return {}, 0
+        ks, k = [], 8
+        while k <= min(128, model.n_items):
+            ks.append(k)
+            k *= 2
+        for k in ks or [min(8, model.n_items)]:
+            recommend_pinned(model, table, 0, k)
+        return {e: (table, slot)
+                for slot, (e, _) in enumerate(known)}, nbytes
+
+    def predict_pinned(self, model: ALSModel, query: Query,
+                       handle) -> PredictedResult:
+        """Serve one query off a pinned hot-user row (the device-
+        resident hot tier's fast path)."""
+        return self._predict_impl(model, query, pinned=handle)
 
     def prepare_serving_model(self, model: ALSModel,
                               max_batch: int = 1) -> ALSModel:

@@ -56,12 +56,28 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
     supplemented: List[Any] = []
     live: List[int] = []
     t0 = time.monotonic()
-    for i, q in enumerate(queries):
-        try:
-            supplemented.append(serving.supplement(q))
-            live.append(i)
-        except Exception as e:  # noqa: BLE001 — isolate to this query
-            out[i] = e
+    if len(queries) > 1:
+        # supplement CONCURRENTLY on the shared dispatch pool: for
+        # templates whose supplement reads the event store (seen/
+        # constraint lookups), the serial loop made a 128-query batch
+        # pay 128 sequential storage round trips before the device saw
+        # anything. Futures are drained in query order, so result order
+        # and per-query error slots are exactly the serial loop's.
+        pool = _algo_pool()
+        futures = [pool.submit(serving.supplement, q) for q in queries]
+        for i, f in enumerate(futures):
+            try:
+                supplemented.append(f.result())
+                live.append(i)
+            except Exception as e:  # noqa: BLE001 — isolate per query
+                out[i] = e
+    else:
+        for i, q in enumerate(queries):
+            try:
+                supplemented.append(serving.supplement(q))
+                live.append(i)
+            except Exception as e:  # noqa: BLE001 — isolate per query
+                out[i] = e
     t1 = time.monotonic()
     if timings is not None:
         timings["supplement"] = timings.get("supplement", 0.0) + (t1 - t0)

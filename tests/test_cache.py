@@ -1,0 +1,803 @@
+"""Serving cache hierarchy tests (ISSUE 4): tier units, singleflight
+dedup, invalidation-bus correctness (concurrent ingest + query stress —
+no stale result past the staleness bound), flush on promote/rollback/
+reload, the hot-entity tier, metrics exposition, and the operator
+surface (/cache.json, /cache/flush, ``ptpu cache``)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cache import (
+    InvalidationBus,
+    ServingCache,
+    ShardedTTLCache,
+    SingleFlight,
+    canonical_key,
+)
+from predictionio_tpu.controller import Context
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.data.storage.base import (
+    STATUS_COMPLETED,
+    EngineInstance,
+    Model,
+)
+from predictionio_tpu.server.engineserver import (
+    QueryServer,
+    ServerConfig,
+    create_engine_server,
+)
+from predictionio_tpu.templates.recommendation import (
+    default_engine_params,
+    recommendation_engine,
+)
+from predictionio_tpu.workflow import persistence
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ctype
+                                 else raw.decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+# ---------------------------------------------------------------------------
+# unit: sharded LRU + TTL + tags
+# ---------------------------------------------------------------------------
+
+class TestShardedTTLCache:
+    def test_hit_miss_ttl(self):
+        t = [0.0]
+        c = ShardedTTLCache(max_entries=16, ttl_sec=10.0,
+                            clock=lambda: t[0])
+        assert c.lookup("k") == (False, None)
+        c.put("k", {"v": 1})
+        assert c.lookup("k") == (True, {"v": 1})
+        t[0] = 10.1  # past the TTL: the staleness BOUND holds
+        assert c.lookup("k") == (False, None)
+        s = c.stats()
+        assert s["hits"] == 1 and s["misses"] == 2
+        assert s["expirations"] == 1 and s["entries"] == 0
+
+    def test_lru_eviction_bounded(self):
+        c = ShardedTTLCache(max_entries=8, ttl_sec=100.0, shards=2)
+        for i in range(100):
+            c.put(("ns", i), i)
+        assert len(c) <= 8
+        assert c.stats()["evictions"] >= 92
+        # most-recent entries survive within their shard
+        assert any(c.lookup(("ns", i))[0] for i in range(96, 100))
+
+    def test_tag_invalidation_is_surgical(self):
+        c = ShardedTTLCache(max_entries=64, ttl_sec=100.0)
+        c.put(("ns", "a"), 1, tags=("user:u1",))
+        c.put(("ns", "b"), 2, tags=("user:u1", "user:u2"))
+        c.put(("ns", "c"), 3, tags=("user:u3",))
+        assert c.invalidate_tag("user:u1") == 2
+        assert c.lookup(("ns", "a"))[0] is False
+        assert c.lookup(("ns", "b"))[0] is False
+        assert c.lookup(("ns", "c")) == (True, 3)
+        assert c.stats()["invalidations"] == 2
+        # re-putting after invalidation works and tag index is clean
+        c.put(("ns", "a"), 9, tags=("user:u1",))
+        assert c.invalidate_tag("user:u1") == 1
+
+    def test_namespace_flush(self):
+        c = ShardedTTLCache(max_entries=64, ttl_sec=100.0)
+        c.put(("armA", "q1"), 1)
+        c.put(("armA", "q2"), 2)
+        c.put(("armB", "q1"), 3)
+        assert c.flush("armA") == 2
+        assert c.lookup(("armB", "q1")) == (True, 3)
+        assert c.flush() == 1  # full flush takes the rest
+        assert len(c) == 0
+
+    def test_bytes_accounting(self):
+        c = ShardedTTLCache(max_entries=8, ttl_sec=100.0)
+        c.put("k", {"itemScores": [{"item": "i1", "score": 0.5}]})
+        assert c.bytes > 0
+        c.flush()
+        assert c.bytes == 0
+
+    def test_canonical_key_order_insensitive(self):
+        assert canonical_key({"user": "u1", "num": 3}) \
+            == canonical_key({"num": 3, "user": "u1"})
+        assert canonical_key({"user": "u1", "num": 3}) \
+            != canonical_key({"user": "u1", "num": 4})
+
+
+class TestSingleFlight:
+    def test_concurrent_misses_compute_once(self):
+        sf = SingleFlight()
+        calls = []
+        gate = threading.Event()
+
+        def compute():
+            calls.append(1)
+            gate.wait(5)
+            return "value"
+
+        results = []
+
+        def run():
+            results.append(sf.do("k", compute))
+
+        threads = [threading.Thread(target=run) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # let followers pile onto the flight
+        gate.set()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1
+        assert all(v == "value" for v, _ in results)
+        assert sum(1 for _, leader in results if leader) == 1
+        assert sf.coalesced == 7
+        # the flight is gone: a later miss recomputes
+        sf.do("k", lambda: calls.append(1) or "again")
+        assert len(calls) == 2
+
+    def test_exception_reaches_all_waiters_then_clears(self):
+        sf = SingleFlight()
+        with pytest.raises(RuntimeError):
+            sf.do("k", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+        assert sf.do("k", lambda: 7) == (7, True)
+
+
+class TestInvalidationBus:
+    def test_publish_reaches_subscriber_and_weakref_cleans_up(self):
+        bus = InvalidationBus()
+
+        class Sub:
+            def __init__(self):
+                self.seen = []
+
+            def on_event(self, app_id, etype, eid, name):
+                self.seen.append((app_id, etype, eid, name))
+
+        sub = Sub()
+        bus.subscribe(sub)
+        assert bus.publish(1, "user", "u1", "view") == 1
+        assert sub.seen == [(1, "user", "u1", "view")]
+        del sub
+        import gc
+        gc.collect()
+        assert bus.publish(1, "user", "u2", "view") == 0
+        assert bus.subscriber_count() == 0
+
+    def test_failing_subscriber_never_breaks_publish(self):
+        bus = InvalidationBus()
+
+        class Bad:
+            def on_event(self, *a):
+                raise RuntimeError("boom")
+
+        class Good:
+            def __init__(self):
+                self.n = 0
+
+            def on_event(self, *a):
+                self.n += 1
+
+        bad, good = Bad(), Good()
+        bus.subscribe(bad)
+        bus.subscribe(good)
+        bus.publish(1, "user", "u1", "view")
+        assert good.n == 1
+
+
+class TestServingCacheUnit:
+    def test_on_event_invalidates_tagged_and_constraint_flushes(self):
+        bus = InvalidationBus()
+        sc = ServingCache(bus=bus)
+        sc.query.put(("ns", "q-u1"), 1, tags=("user:u1",))
+        sc.query.put(("ns", "q-u2"), 2, tags=("user:u2",))
+        sc.features.put(("seen", "u1"), {"i1"}, tags=("user:u1",))
+        bus.publish(0, "user", "u1", "view")
+        assert sc.query.lookup(("ns", "q-u1"))[0] is False
+        assert sc.query.lookup(("ns", "q-u2"))[0] is True
+        assert sc.features.lookup(("seen", "u1"))[0] is False
+        # a constraint $set reshapes every result: whole query tier dies
+        bus.publish(0, "constraint", "unavailableItems", "$set")
+        assert sc.query.lookup(("ns", "q-u2"))[0] is False
+
+    def test_metrics_registered(self):
+        from predictionio_tpu.obs import MetricsRegistry
+
+        sc = ServingCache(bus=InvalidationBus())
+        reg = MetricsRegistry()
+        sc.register_metrics(reg)
+        sc.query.put(("ns", "a"), 1)
+        sc.query.lookup(("ns", "a"))
+        text = reg.render()
+        for name in ("pio_cache_hits", "pio_cache_misses",
+                     "pio_cache_evictions", "pio_cache_invalidations",
+                     "pio_cache_entries", "pio_cache_bytes",
+                     "pio_cache_hit_ratio"):
+            assert name in text, name
+        assert 'tier="query"' in text and 'tier="feature"' in text
+        snap = reg.snapshot()
+        assert snap["pio_cache_hits"]['tier=query'] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# integration: the engine server's cached serving path
+# ---------------------------------------------------------------------------
+
+def _synth_als_model(seed: int, n_users: int = 24, n_items: int = 24,
+                     rank: int = 4):
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_factors=rng.standard_normal(
+            (n_users, rank)).astype(np.float32),
+        item_factors=rng.standard_normal(
+            (n_items, rank)).astype(np.float32),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+
+
+@pytest.fixture()
+def two_releases():
+    """Two COMPLETED instances with persisted blobs (the
+    promote/rollback/reload substrate), plus a per-test bus."""
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "cacheapp"))
+    ctx = Context(app_name="cacheapp", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("cacheapp", rank=4)
+    for i, (iid, seed) in enumerate((("ca1", 1), ("ca2", 2))):
+        start = T0 + timedelta(minutes=i)
+        storage.engine_instances().insert(EngineInstance(
+            id=iid, status=STATUS_COMPLETED, start_time=start,
+            end_time=start, engine_id="cache", engine_version="1",
+            engine_variant="engine.json", engine_factory="synthetic"))
+        storage.models().insert(Model(
+            id=iid,
+            models=persistence.dumps_models([_synth_als_model(seed)])))
+    return ctx, engine, ep
+
+
+def _cache_server(two_releases, iid="ca1", bus=None, **cfg_kw):
+    from predictionio_tpu.workflow.core import load_models_for_deploy
+
+    ctx, engine, ep = two_releases
+    inst = ctx.storage.engine_instances().get(iid)
+    models = load_models_for_deploy(ctx, engine, inst, ep)
+    cfg = ServerConfig(warm_start=False, serving_cache=True, **cfg_kw)
+    qs = QueryServer(ctx, engine, ep, models, inst, cfg)
+    if bus is not None:
+        # rewire onto the per-test bus (the default is process-global)
+        qs.cache.bus = bus
+        bus.subscribe(qs.cache)
+    return qs
+
+
+class TestCachedServing:
+    def test_hit_skips_pipeline_and_matches(self, two_releases):
+        qs = _cache_server(two_releases)
+        r1 = qs.serve({"user": "u1", "num": 3})
+        count_after_miss = qs.request_count
+        r2 = qs.serve({"user": "u1", "num": 3})
+        assert r1 == r2
+        st = qs.cache.stats()["tiers"]["query"]
+        assert st["hits"] == 1 and st["misses"] == 1
+        # the hit still counts as a served request (bookkeeping parity)
+        assert qs.request_count == count_after_miss + 1
+        # key-order-insensitive exact match
+        qs.serve({"num": 3, "user": "u1"})
+        assert qs.cache.stats()["tiers"]["query"]["hits"] == 2
+
+    def test_errors_are_never_cached(self, two_releases):
+        from predictionio_tpu.server.engineserver import HTTPError
+
+        qs = _cache_server(two_releases)
+        for _ in range(2):
+            with pytest.raises(HTTPError):
+                qs.serve({"bogus": 1})
+        assert len(qs.cache.query) == 0
+
+    def test_singleflight_dedups_concurrent_identical_misses(
+            self, two_releases):
+        qs = _cache_server(two_releases)
+        algo = qs.algorithms[0]
+        calls = []
+        orig = algo.predict
+
+        def slow_predict(model, query):
+            calls.append(1)
+            time.sleep(0.3)
+            return orig(model, query)
+
+        algo.predict = slow_predict
+        results = []
+
+        def run():
+            results.append(qs.serve({"user": "u4", "num": 3}))
+
+        threads = [threading.Thread(target=run) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1, "identical misses must compute once"
+        assert all(r == results[0] for r in results)
+        assert qs.cache.flight.coalesced >= 5
+
+    def test_bus_invalidation_no_stale_serve(self, two_releases):
+        bus = InvalidationBus()
+        qs = _cache_server(two_releases, bus=bus)
+        versions = {}
+        algo = qs.algorithms[0]
+        orig = algo.predict
+
+        def versioned_predict(model, query):
+            r = orig(model, query)
+            versions.setdefault(query.user, 0)
+            return type(r)(r.item_scores[:versions[query.user] + 1])
+
+        algo.predict = versioned_predict
+        r = qs.serve({"user": "u1", "num": 3})
+        assert len(r["itemScores"]) == 1
+        versions["u1"] = 1  # the world changed...
+        assert qs.serve({"user": "u1", "num": 3}) == r, \
+            "sanity: without an event the cached result serves"
+        bus.publish(0, "user", "u1", "view")  # ...and the event landed
+        r2 = qs.serve({"user": "u1", "num": 3})
+        assert len(r2["itemScores"]) == 2, \
+            "post-ingest query served the pre-ingest cached result"
+
+    def test_concurrent_ingest_query_stress_staleness_bound(
+            self, two_releases):
+        """The acceptance stress: writers bump an entity's version and
+        publish; a version older than the publish floor must NEVER be
+        served FROM THE CACHE (bus delivery is synchronous and fills
+        racing an invalidation are epoch-dropped). A reader may
+        transiently share an in-flight compute that began pre-publish
+        — that result is not cached, so serving must converge to the
+        floor as soon as that flight drains."""
+        bus = InvalidationBus()
+        qs = _cache_server(two_releases, bus=bus)
+        committed = {f"u{i}": 0 for i in range(8)}
+        published = {f"u{i}": 0 for i in range(8)}
+        lock = threading.Lock()
+        algo = qs.algorithms[0]
+        orig = algo.predict
+
+        def versioned_predict(model, query):
+            r = orig(model, query)
+            with lock:
+                v = committed[query.user]
+            d = r.to_json()
+            d["version"] = v
+            return d
+
+        algo.predict = versioned_predict
+        stop = threading.Event()
+        violations = []
+
+        def writer(user):
+            while not stop.is_set():
+                with lock:
+                    committed[user] += 1
+                bus.publish(0, "user", user, "view")
+                with lock:
+                    published[user] = committed[user]
+                time.sleep(0.002)
+
+        def reader(user):
+            while not stop.is_set():
+                with lock:
+                    floor = published[user]
+                obs = {}
+                out = qs.serve({"user": user, "num": 2}, obs=obs)
+                if out["version"] >= floor:
+                    continue
+                if obs.get("cache") == "hit":
+                    violations.append(
+                        ("stale-from-cache", user, out["version"],
+                         floor))
+                    continue
+                # shared in-flight compute: must converge once the
+                # pre-publish flight drains (its fill was dropped)
+                for _ in range(50):
+                    out = qs.serve({"user": user, "num": 2})
+                    if out["version"] >= floor:
+                        break
+                    time.sleep(0.005)
+                else:
+                    violations.append(
+                        ("never-converged", user, out["version"],
+                         floor))
+
+        writers = [threading.Thread(target=writer, args=(f"u{i}",))
+                   for i in range(4)]
+        readers = [threading.Thread(target=reader, args=(f"u{i}",))
+                   for i in range(4)]
+        for t in writers + readers:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in writers + readers:
+            t.join()
+        assert not violations, violations[:5]
+        st = qs.cache.stats()["tiers"]["query"]
+        assert st["invalidations"] > 0, "stress never invalidated"
+        assert st["hits"] + st["misses"] > 100
+
+
+class TestFlushOnRebind:
+    def test_reload_flushes_all_tiers(self, two_releases):
+        qs = _cache_server(two_releases)
+        qs.serve({"user": "u1", "num": 3})
+        assert len(qs.cache.query) == 1
+        qs.cache.features.put(("seen", "u1"), {"i1"})
+        qs.reload()
+        assert len(qs.cache.query) == 0
+        assert len(qs.cache.features) == 0
+
+    def test_promote_flushes_and_namespaces_differ(self, two_releases):
+        ctx, engine, ep = two_releases
+        qs = _cache_server(two_releases, iid="ca1")
+        stable_r = qs.serve({"user": "u1", "num": 3})
+        qs.bind_candidate(ctx.storage.engine_instances().get("ca2"))
+        cand_r = qs.serve_candidate({"user": "u1", "num": 3})
+        # per-arm namespaces: same query cached once per arm
+        keys = {k for shard in qs.cache.query._shards
+                for k in shard.entries}
+        namespaces = {k[0] for k in keys}
+        assert namespaces == {"ca1", "ca2"}
+        assert stable_r != cand_r  # different models, different answers
+        # candidate hit comes from the candidate namespace
+        assert qs.serve_candidate({"user": "u1", "num": 3}) == cand_r
+        qs.promote_candidate()
+        assert len(qs.cache.query) == 0, \
+            "promote must flush — the new stable must recompute"
+        post = qs.serve({"user": "u1", "num": 3})
+        assert post == cand_r  # ca2 now serves stable, fresh compute
+        keys = {k[0] for shard in qs.cache.query._shards
+                for k in shard.entries}
+        assert keys == {"ca2"}
+
+    def test_rollback_flushes_candidate_namespace_only(
+            self, two_releases):
+        ctx, engine, ep = two_releases
+        qs = _cache_server(two_releases, iid="ca1")
+        qs.serve({"user": "u1", "num": 3})
+        qs.bind_candidate(ctx.storage.engine_instances().get("ca2"))
+        qs.serve_candidate({"user": "u1", "num": 3})
+        qs.drop_candidate()  # the rollback path
+        keys = {k[0] for shard in qs.cache.query._shards
+                for k in shard.entries}
+        assert keys == {"ca1"}, \
+            "rollback must flush the dead arm and keep stable's"
+
+
+class TestHotEntityTier:
+    def test_pin_refresh_lookup_and_flush(self, two_releases,
+                                          monkeypatch):
+        from predictionio_tpu.models import als as als_mod
+
+        monkeypatch.setattr(als_mod, "HOST_SERVE_WORK", 16)
+        qs = _cache_server(two_releases, hot_entities=4,
+                           hot_refresh_every=4)
+        for _ in range(6):
+            qs.serve({"user": "u2", "num": 3})
+        qs.cache.hot.refresh(wait=True)
+        st = qs.cache.hot.stats()
+        assert st["entries"] >= 1 and st["refreshes"] >= 1
+        handle = qs.cache.hot.lookup("u2")
+        assert handle is not None
+        # pinned fast path answers EXACTLY like the normal path
+        from predictionio_tpu.utils.jsonutil import from_jsonable
+
+        algo = qs.algorithms[0]
+        q = from_jsonable(algo.query_class, {"user": "u2", "num": 3})
+        assert algo.predict_pinned(qs.models[0], q, handle) \
+            == algo.predict(qs.models[0], q)
+        # serve() consults the pin once the query cache is cold
+        qs.cache.query.flush()
+        before = qs.cache.hot.stats()["hits"]
+        r = qs.serve({"user": "u2", "num": 3})
+        assert r["itemScores"]
+        assert qs.cache.hot.stats()["hits"] > before
+        # rebind flushes pins AND hit stats
+        qs.reload()
+        assert qs.cache.hot.stats()["entries"] == 0
+        assert qs.cache.hot.lookup("u2") is None
+
+    def test_host_served_models_skip_pinning(self, two_releases):
+        qs = _cache_server(two_releases, hot_entities=4,
+                           hot_refresh_every=2)
+        for _ in range(4):
+            qs.serve({"user": "u3", "num": 2})
+        qs.cache.hot.refresh(wait=True)
+        # tiny host-served model: nothing to pin, nothing breaks
+        assert qs.cache.hot.stats()["entries"] == 0
+        assert qs.serve({"user": "u3", "num": 2})["itemScores"]
+
+
+# ---------------------------------------------------------------------------
+# E2E over HTTP: ingest through the REAL event server invalidates the
+# REAL engine server's cache; /cache.json + /cache/flush; ptpu cache
+# ---------------------------------------------------------------------------
+
+class TestHTTPEndToEnd:
+    def test_ingest_invalidates_and_routes_work(self, two_releases):
+        from predictionio_tpu.data.storage.base import AccessKey
+        from predictionio_tpu.server.eventserver import (
+            build_app as build_event_app,
+        )
+        from predictionio_tpu.server.http import AppServer
+
+        ctx, engine, ep = two_releases
+        bus = InvalidationBus()
+        qs = _cache_server(two_releases, bus=bus)
+        srv = create_engine_server(qs, "127.0.0.1", 0).start_background()
+        ctx.storage.access_keys().insert(
+            AccessKey(key="CK", app_id=0, events=()))
+        ev_srv = AppServer(build_event_app(ctx.storage, bus=bus),
+                           "127.0.0.1", 0).start_background()
+        try:
+            status, r1 = call(srv.port, "POST", "/queries.json",
+                              {"user": "u1", "num": 3})
+            assert status == 200
+            call(srv.port, "POST", "/queries.json",
+                 {"user": "u1", "num": 3})
+            status, cj = call(srv.port, "GET", "/cache.json")
+            assert status == 200 and cj["enabled"]
+            assert cj["tiers"]["query"]["hits"] >= 1
+
+            # ingest an event for u1 through the REAL event server
+            status, _ = call(
+                ev_srv.port, "POST", "/events.json?accessKey=CK",
+                {"event": "view", "entityType": "user",
+                 "entityId": "u1", "targetEntityType": "item",
+                 "targetEntityId": "i5"})
+            assert status == 201
+            status, cj = call(srv.port, "GET", "/cache.json")
+            assert cj["tiers"]["query"]["invalidations"] >= 1, \
+                "ingest did not invalidate the engine server's cache"
+
+            # operator flush
+            call(srv.port, "POST", "/queries.json",
+                 {"user": "u2", "num": 3})
+            status, fl = call(srv.port, "POST", "/cache/flush")
+            assert status == 200 and "query" in fl["removed"]
+            status, cj = call(srv.port, "GET", "/cache.json")
+            assert cj["tiers"]["query"]["entries"] == 0
+
+            # /status.json and /metrics carry the cache series
+            status, sj = call(srv.port, "GET", "/status.json")
+            assert sj["cache"]["enabled"]
+            status, text = call(srv.port, "GET", "/metrics")
+            assert "pio_cache_hits" in text
+        finally:
+            srv.shutdown()
+            ev_srv.shutdown()
+
+    def test_cache_json_when_disabled(self, two_releases):
+        from predictionio_tpu.workflow.core import (
+            load_models_for_deploy,
+        )
+
+        ctx, engine, ep = two_releases
+        inst = ctx.storage.engine_instances().get("ca1")
+        models = load_models_for_deploy(ctx, engine, inst, ep)
+        qs = QueryServer(ctx, engine, ep, models, inst,
+                         ServerConfig(warm_start=False))
+        srv = create_engine_server(qs, "127.0.0.1",
+                                   0).start_background()
+        try:
+            status, body = call(srv.port, "GET", "/cache.json")
+            assert status == 200 and body["enabled"] is False
+            status, _ = call(srv.port, "POST", "/cache/flush")
+            assert status == 409
+        finally:
+            srv.shutdown()
+
+    def test_ptpu_cache_cli(self, two_releases, capsys):
+        from predictionio_tpu.cli import main as cli_main
+
+        qs = _cache_server(two_releases)
+        srv = create_engine_server(qs, "127.0.0.1",
+                                   0).start_background()
+        try:
+            qs.serve({"user": "u1", "num": 3})
+            qs.serve({"user": "u1", "num": 3})
+            rc = cli_main(["cache", "stats", "--ip", "127.0.0.1",
+                           "--port", str(srv.port)],
+                          storage=qs.ctx.storage)
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "hit ratio" in out and "query" in out
+            rc = cli_main(["cache", "flush", "--ip", "127.0.0.1",
+                           "--port", str(srv.port)],
+                          storage=qs.ctx.storage)
+            assert rc == 0
+            assert "Flushed" in capsys.readouterr().out
+            assert len(qs.cache.query) == 0
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellites: ecommerce feature cache + weights memo, batched supplement
+# ---------------------------------------------------------------------------
+
+class TestEcommerceFeatureCache:
+    def _algo_with_counting_store(self):
+        from predictionio_tpu.templates.ecommerce import (
+            ECommAlgorithm,
+            ECommAlgorithmParams,
+        )
+
+        calls = []
+
+        class CountingStore:
+            def find_by_entity(self, app_name, etype, eid, **kw):
+                calls.append((etype, eid))
+                return []
+
+        algo = ECommAlgorithm(ECommAlgorithmParams(
+            app_name="shop", unseen_only=True))
+        algo._serving_store = CountingStore()
+        return algo, calls
+
+    def test_reads_cached_and_invalidated(self):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        algo, calls = self._algo_with_counting_store()
+        cache = ShardedTTLCache(max_entries=64, ttl_sec=100.0)
+        algo.bind_feature_cache(cache)
+        q = Query(user="u1", num=3)
+        algo.gen_black_list(q, "shop")
+        n_first = len(calls)
+        assert n_first == 2  # seen + unavailable
+        algo.gen_black_list(q, "shop")
+        assert len(calls) == n_first, "second query must hit the cache"
+        # an event for u1 invalidates the seen read only
+        cache.invalidate_tag("user:u1")
+        algo.gen_black_list(q, "shop")
+        assert len(calls) == n_first + 1  # seen re-read, constraint hit
+        # constraint invalidation forces the unavailable re-read
+        cache.invalidate_tag("constraint:unavailableItems")
+        algo.gen_black_list(q, "shop")
+        assert len(calls) == n_first + 2
+
+    def test_recent_and_weighted_cached(self):
+        algo, calls = self._algo_with_counting_store()
+        cache = ShardedTTLCache(max_entries=64, ttl_sec=100.0)
+        algo.bind_feature_cache(cache)
+        from predictionio_tpu.templates.ecommerce import Query
+
+        q = Query(user="u2", num=3)
+        algo.get_recent_items(q, "shop")
+        algo.get_recent_items(q, "shop")
+        algo.weighted_items("shop")
+        algo.weighted_items("shop")
+        assert len(calls) == 2  # one recent read + one weighted read
+
+    def test_works_without_cache(self):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        algo, calls = self._algo_with_counting_store()
+        q = Query(user="u1", num=3)
+        algo.gen_black_list(q, "shop")
+        algo.gen_black_list(q, "shop")
+        assert len(calls) == 4  # uncached: every query re-reads
+
+
+class TestWeightsVectorMemo:
+    def test_computed_once_per_generation(self):
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.templates.ecommerce import (
+            ECommAlgorithm,
+            ECommAlgorithmParams,
+            ECommModel,
+        )
+
+        algo = ECommAlgorithm(ECommAlgorithmParams(app_name="shop"))
+        groups = [[({"i1", "i2"}, 2.0)]]
+
+        algo.weighted_items = lambda app_name: groups[0]
+
+        def model(n=6):
+            ids = BiMap({f"i{i}": i for i in range(n)})
+            return ECommModel(
+                app_name="shop", rank=2,
+                user_factors=np.zeros((2, 2), np.float32),
+                has_user=np.ones(2, bool),
+                item_factors=np.zeros((n, 2), np.float32),
+                has_item=np.ones(n, bool),
+                popular_count=np.zeros(n, np.int64),
+                user_ids=BiMap({"u0": 0, "u1": 1}),
+                item_ids=ids, items={})
+
+        m = model()
+        w1 = algo._weights_vector(m, "shop")
+        assert w1[1] == 2.0 and w1[0] == 1.0
+        # same (model, app, weights) generation: the SAME vector object
+        assert algo._weights_vector(m, "shop") is w1
+        # the weights constraint changed → recompute
+        groups[0] = [({"i3"}, 0.5)]
+        w2 = algo._weights_vector(m, "shop")
+        assert w2 is not w1 and w2[3] == 0.5 and w2[1] == 1.0
+        # a NEW model (new item index space) → recompute
+        m2 = model()
+        assert algo._weights_vector(m2, "shop") is not w2
+
+
+class TestParallelSupplement:
+    def test_order_and_error_slots_preserved(self):
+        from predictionio_tpu.workflow.batch_predict import (
+            predict_serve_batch,
+        )
+
+        class Query:
+            def __init__(self, user):
+                self.user = user
+
+        class Serving:
+            def supplement(self, q):
+                if q.user == "bad":
+                    raise ValueError("poison supplement")
+                time.sleep(0.01)
+                return q
+
+            def serve(self, q, preds):
+                return preds[0]
+
+        class Algo:
+            def batch_predict(self, model, queries):
+                return [f"pred-{q.user}" for q in queries]
+
+        queries = [Query(f"u{i}") for i in range(16)]
+        queries[5] = Query("bad")
+        out = predict_serve_batch([Algo()], [None], Serving(), queries)
+        assert isinstance(out[5], ValueError)
+        for i, r in enumerate(out):
+            if i != 5:
+                assert r == f"pred-u{i}", (i, r)
+
+    def test_single_query_stays_pool_free(self):
+        from predictionio_tpu.workflow.batch_predict import (
+            predict_serve_batch,
+        )
+
+        main_thread = threading.current_thread().name
+        seen = []
+
+        class Serving:
+            def supplement(self, q):
+                seen.append(threading.current_thread().name)
+                return q
+
+            def serve(self, q, preds):
+                return preds[0]
+
+        class Algo:
+            def batch_predict(self, model, queries):
+                return list(queries)
+
+        predict_serve_batch([Algo()], [None], Serving(), ["q"])
+        assert seen == [main_thread]
