@@ -1,16 +1,26 @@
-"""``ptpu check`` — JAX-aware static analysis for serving code.
+"""``ptpu check`` — JAX-aware + concurrency static analysis.
 
 Public surface:
 
 - :func:`run_check` / :func:`check_source` — run the rule suite over
-  paths or a source blob, returning :class:`Finding`\\ s.
-- :data:`RULES` — the rule registry (name → :class:`Rule`).
+  paths or a source blob, returning :class:`Finding`\\ s. Module rules
+  run per file; project rules (the cross-file lock-order graph) run
+  once over the whole parsed set.
+- :data:`RULES` — the rule registry (name → :class:`Rule`): five JAX
+  rules plus the concurrency family (:mod:`.concurrency`).
+- :func:`findings_to_json` / :func:`findings_to_sarif` — machine
+  output (:mod:`.report`); SARIF feeds GitHub code-scanning.
+- :func:`write_baseline` / :func:`load_baseline` /
+  :func:`new_findings` — gate CI on *no new findings*
+  (:mod:`.baseline`).
 - ``# ptpu: allow[rule] — why`` pragmas suppress a finding on that line
-  or the line below the comment.
+  or via the comment block directly above; ``# ptpu: guarded-by[lock]``
+  is the lock-contract annotation ``unguarded-shared-state`` honors.
 
 See ``docs/static-analysis.md`` for the operator-facing rule catalogue.
 """
 
+from .baseline import load_baseline, new_findings, write_baseline
 from .core import (
     CheckContext,
     Finding,
@@ -19,6 +29,7 @@ from .core import (
     iter_py_files,
     run_check,
 )
+from .report import findings_to_json, findings_to_sarif
 from .rules import RULES, Rule
 
 __all__ = [
@@ -28,6 +39,11 @@ __all__ = [
     "Rule",
     "check_source",
     "default_context",
+    "findings_to_json",
+    "findings_to_sarif",
     "iter_py_files",
+    "load_baseline",
+    "new_findings",
     "run_check",
+    "write_baseline",
 ]

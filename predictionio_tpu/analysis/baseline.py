@@ -1,0 +1,87 @@
+"""Finding baselines: gate CI on *no new findings*.
+
+Turning a new rule on over a living codebase surfaces legacy findings
+that can't all be fixed in the enabling PR. The baseline workflow
+burns them down without blocking the gate:
+
+- ``ptpu check --baseline findings.json --write-baseline`` records the
+  current findings;
+- ``ptpu check --baseline findings.json`` then fails ONLY on findings
+  not in the baseline — pre-existing debt passes, regressions don't;
+- as debt is paid down, re-write the baseline (shrinking it is always
+  safe; CI can diff the file to prove the burn-down is monotone).
+
+Findings are keyed by ``(path, rule, message)`` — deliberately NOT by
+line, so unrelated edits that shift code don't resurrect baselined
+findings. Each key carries a count: a second instance of an already-
+baselined finding in the same file still fails.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+Key = Tuple[str, str, str]
+
+
+def _key(f: Finding) -> Key:
+    return (f.path.replace("\\", "/"), f.rule, f.message)
+
+
+def _counts(findings: Sequence[Finding]) -> Dict[Key, int]:
+    out: Dict[Key, int] = {}
+    for f in findings:
+        out[_key(f)] = out.get(_key(f), 0) + 1
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> int:
+    """Persist the current findings as the accepted debt; returns how
+    many entries were recorded."""
+    counts = _counts(findings)
+    doc = {
+        "version": BASELINE_VERSION,
+        "entries": [
+            {"path": p, "rule": r, "message": m, "count": c}
+            for (p, r, m), c in sorted(counts.items())],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(counts)
+
+
+def load_baseline(path: str) -> Dict[Key, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) \
+            or doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: not a ptpu check baseline (expected version "
+            f"{BASELINE_VERSION})")
+    out: Dict[Key, int] = {}
+    for e in doc.get("entries", []):
+        out[(e["path"], e["rule"], e["message"])] = int(
+            e.get("count", 1))
+    return out
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[Key, int]) -> List[Finding]:
+    """Findings beyond the baseline's per-key budget, in input order
+    (the first ``count`` instances of a baselined key pass; extras and
+    unknown keys fail)."""
+    budget = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        k = _key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out

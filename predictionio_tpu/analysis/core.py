@@ -33,6 +33,14 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set
 #: sentence), and the justification is free-form prose
 PRAGMA_RE = re.compile(r"#.*?ptpu:\s*allow\[([^\]]*)\]")
 
+#: ``# ptpu: guarded-by[lock] — justification``: the concurrency
+#: contract annotation (see rule ``unguarded-shared-state``). On an
+#: ``__init__`` attribute assignment it DECLARES the attribute
+#: lock-guarded; on a ``def`` line it asserts every caller holds the
+#: lock; on an access line it asserts that access is safe (caller
+#: holds the lock, or a justified benign racy read).
+GUARDED_RE = re.compile(r"#.*?ptpu:\s*guarded-by\[([^\]]*)\]")
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -67,6 +75,7 @@ class ModuleInfo:
         self.lines = source.splitlines()
         self.aliases = _collect_aliases(tree)
         self.pragmas = _collect_pragmas(self.lines)
+        self.guards = _collect_guards(self.lines)
 
     def resolve(self, node: ast.AST) -> Optional[str]:
         """Dotted name of a Name/Attribute chain with import aliases
@@ -81,21 +90,35 @@ class ModuleInfo:
         parts.append(head)
         return ".".join(reversed(parts))
 
+    def _covering_lines(self, line: int) -> List[int]:
+        """``line`` itself plus the contiguous comment block directly
+        above it — the lines whose markers cover a statement at
+        ``line`` (a multi-line justification can carry the marker on
+        any of its lines)."""
+        candidates = [line]
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].strip().startswith("#"):
+            candidates.append(ln)
+            ln -= 1
+        return candidates
+
     def suppressed(self, finding: Finding) -> bool:
         """A pragma suppresses a finding on its own line, or anywhere in
-        the contiguous comment block directly above the finding line (so
-        a multi-line justification can carry the marker on any line)."""
-        candidates = [finding.line]
-        line = finding.line - 1
-        while 1 <= line <= len(self.lines) \
-                and self.lines[line - 1].strip().startswith("#"):
-            candidates.append(line)
-            line -= 1
-        for ln in candidates:
+        the contiguous comment block directly above the finding line."""
+        for ln in self._covering_lines(finding.line):
             allowed = self.pragmas.get(ln)
             if allowed and ("*" in allowed or finding.rule in allowed):
                 return True
         return False
+
+    def guards_at(self, line: int) -> Set[str]:
+        """Lock names asserted by ``# ptpu: guarded-by[...]`` markers
+        covering ``line`` (same placement rules as pragmas)."""
+        out: Set[str] = set()
+        for ln in self._covering_lines(line):
+            out |= self.guards.get(ln, set())
+        return out
 
 
 def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
@@ -125,6 +148,16 @@ def _collect_pragmas(lines: Sequence[str]) -> Dict[int, Set[str]]:
             pragmas[i] = {r.strip() for r in m.group(1).split(",")
                           if r.strip()}
     return pragmas
+
+
+def _collect_guards(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    guards: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = GUARDED_RE.search(line)
+        if m:
+            guards[i] = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+    return guards
 
 
 # ---------------------------------------------------------------------------
@@ -221,27 +254,44 @@ def iter_py_files(paths: Iterable[str]) -> List[str]:
     return out
 
 
+def _run_rules(mods: List[ModuleInfo],
+               rule_names: Optional[Sequence[str]],
+               ctx: CheckContext) -> List[Finding]:
+    """Module-scoped rules per file, then project-scoped rules over the
+    whole parsed set (the cross-file lock-order graph); pragma
+    suppression is resolved against the module each finding points at."""
+    from .rules import RULES
+
+    by_path = {m.path: m for m in mods}
+    findings: List[Finding] = []
+    for name, rule in RULES.items():
+        if rule_names and name not in rule_names:
+            continue
+        if rule.project:
+            findings.extend(rule.fn(mods, ctx))
+        else:
+            for mod in mods:
+                findings.extend(rule.fn(mod, ctx))
+    surviving = [f for f in findings
+                 if f.path not in by_path
+                 or not by_path[f.path].suppressed(f)]
+    return sorted(surviving,
+                  key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
 def check_source(source: str, path: str = "<string>",
                  rule_names: Optional[Sequence[str]] = None,
                  ctx: Optional[CheckContext] = None) -> List[Finding]:
     """Run the (selected) rules over one source blob — the test and
-    single-file entry point. Pragma suppression applies."""
-    from .rules import RULES
-
+    single-file entry point. Pragma suppression applies; project rules
+    see a one-module project."""
     ctx = ctx or default_context()
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding("parse-error", path, e.lineno or 1, 0,
                         f"cannot parse: {e.msg}")]
-    mod = ModuleInfo(path, source, tree)
-    findings: List[Finding] = []
-    for name, rule in RULES.items():
-        if rule_names and name not in rule_names:
-            continue
-        findings.extend(rule.fn(mod, ctx))
-    return sorted((f for f in findings if not mod.suppressed(f)),
-                  key=lambda f: (f.path, f.line, f.col, f.rule))
+    return _run_rules([ModuleInfo(path, source, tree)], rule_names, ctx)
 
 
 def run_check(paths: Sequence[str],
@@ -259,6 +309,7 @@ def run_check(paths: Sequence[str],
     ctx = CheckContext(declared_axes=extract_mesh_axes(mesh_src)
                        if mesh_src else set())
     findings: List[Finding] = []
+    mods: List[ModuleInfo] = []
     for f in files:
         try:
             with open(f, "r", encoding="utf-8") as fh:
@@ -266,6 +317,12 @@ def run_check(paths: Sequence[str],
         except (OSError, UnicodeDecodeError) as e:
             findings.append(Finding("parse-error", f, 1, 0, str(e)))
             continue
-        findings.extend(check_source(src, path=f, rule_names=rule_names,
-                                     ctx=ctx))
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", f, e.lineno or 1, 0,
+                                    f"cannot parse: {e.msg}"))
+            continue
+        mods.append(ModuleInfo(f, src, tree))
+    findings.extend(_run_rules(mods, rule_names, ctx))
     return findings

@@ -1,6 +1,12 @@
 """The JAX-specific rule catalogue behind ``ptpu check``.
 
-Five rules, each an AST pass over one :class:`~.core.ModuleInfo`:
+This module holds the five JAX rules and assembles the full registry
+(:data:`RULES`), which also includes the concurrency rule family from
+:mod:`.concurrency` (``unguarded-shared-state``,
+``lock-order-inversion``, ``blocking-under-lock``,
+``callback-under-lock``).
+
+The JAX rules, each an AST pass over one :class:`~.core.ModuleInfo`:
 
 - ``host-sync-in-hot-path`` — device→host landings (``np.asarray``,
   ``.item()``, ``.tolist()``, ``jax.device_get``,
@@ -48,6 +54,10 @@ class Rule:
     name: str
     description: str
     fn: RuleFn
+    #: project-scoped rules run ONCE over the whole parsed module set
+    #: (cross-file facts like the lock-order graph); their ``fn`` takes
+    #: ``(mods: List[ModuleInfo], ctx)`` instead of one module
+    project: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -523,8 +533,15 @@ def rule_config_drift(mod: ModuleInfo, ctx: CheckContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# registry
+# registry (JAX rules here; concurrency rule family in .concurrency)
 # ---------------------------------------------------------------------------
+
+from .concurrency import (  # noqa: E402 — registry assembly
+    rule_blocking_under_lock,
+    rule_callback_under_lock,
+    rule_lock_order_inversion,
+    rule_unguarded_shared_state,
+)
 
 RULES: Dict[str, Rule] = {r.name: r for r in (
     Rule("host-sync-in-hot-path",
@@ -545,4 +562,20 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
     Rule("config-drift",
          "jax.config.update outside utils/platform.py",
          rule_config_drift),
+    Rule("unguarded-shared-state",
+         "reads/writes of a class's lock-guarded attributes outside "
+         "the lock (honors # ptpu: guarded-by[lock])",
+         rule_unguarded_shared_state),
+    Rule("lock-order-inversion",
+         "cycles in the cross-file static lock-acquisition graph "
+         "built from nested with-lock scopes",
+         rule_lock_order_inversion, project=True),
+    Rule("blocking-under-lock",
+         "device dispatch, HTTP/storage I/O, sleep, join/wait/result "
+         "inside a held-lock region in server/, cache/, or rollout/",
+         rule_blocking_under_lock),
+    Rule("callback-under-lock",
+         "bus/plugin callbacks invoked while holding the publisher's "
+         "lock (re-entrancy deadlock)",
+         rule_callback_under_lock),
 )}

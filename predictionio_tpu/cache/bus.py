@@ -23,6 +23,8 @@ from __future__ import annotations
 import logging
 import threading
 import weakref
+
+from ..concurrency import new_lock
 from typing import Any, Callable, List, Optional
 
 log = logging.getLogger(__name__)
@@ -35,7 +37,7 @@ Subscriber = Callable[[Optional[int], str, str, str], Any]
 
 class InvalidationBus:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("InvalidationBus._lock")
         self._subs: List[weakref.ref] = []
         self._published = 0
         self._delivered = 0
@@ -95,7 +97,7 @@ class InvalidationBus:
 
 
 _default: Optional[InvalidationBus] = None
-_default_lock = threading.Lock()
+_default_lock = threading.Lock()  # import-time; predates any instrumentation flip
 
 
 def default_bus() -> InvalidationBus:

@@ -26,9 +26,9 @@ the whole query tier — every cached result may now be wrong.
 from __future__ import annotations
 
 import json
-import threading
 from typing import Any, Dict, Iterable, Optional, Tuple
 
+from ..concurrency import new_lock
 from .bus import InvalidationBus, default_bus
 from .hot import HotEntityTier, PinFn
 from .lru import ShardedTTLCache
@@ -72,7 +72,10 @@ class ServingCache:
                                   refresh_every=hot_refresh_every)
                     if pin_fn is not None and hot_capacity > 0 else None)
         self.flight = SingleFlight()
-        self._flush_lock = threading.Lock()
+        #: guards the flat counters below — bus deliveries arrive on
+        #: whatever thread accepted the ingest, so even `x += 1` is a
+        #: read-modify-write race without it
+        self._counter_lock = new_lock("ServingCache._counter_lock")
         self._flushes = 0
         self._bus_events = 0
         # invalidation epochs: a query computed CONCURRENTLY with an
@@ -82,7 +85,7 @@ class ServingCache:
         # global one) BEFORE removing entries; fill paths snapshot the
         # epoch pre-compute and drop their put if it moved (see
         # put_query_fresh).
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = new_lock("ServingCache._epoch_lock")
         self._global_epoch = 0
         self._tag_epochs: Dict[str, int] = {}
         self._stale_put_drops = 0
@@ -141,7 +144,8 @@ class ServingCache:
     # -- invalidation (the bus calls this on every ingest) ------------------
     def on_event(self, app_id: Optional[int], entity_type: str,
                  entity_id: str, event_name: str = "") -> None:
-        self._bus_events += 1
+        with self._counter_lock:
+            self._bus_events += 1
         tag = entity_tag(entity_type, entity_id)
         self._bump_tag(tag)  # BEFORE removal: in-flight fills must see
         self.query.invalidate_tag(tag)          # the moved epoch
@@ -163,7 +167,7 @@ class ServingCache:
         """Full flush — every rebind (deploy/reload/promote/rollback)
         and the ``/cache/flush`` operator route take this path: a new
         model must never serve results computed by the old one."""
-        with self._flush_lock:
+        with self._counter_lock:
             self._flushes += 1
         self._bump_global()
         out = {"query": self.query.flush(),
@@ -180,9 +184,11 @@ class ServingCache:
             yield "hot", self.hot
 
     def stats(self) -> Dict[str, Any]:
+        with self._counter_lock:
+            flushes, bus_events = self._flushes, self._bus_events
         out: Dict[str, Any] = {"enabled": True,
-                               "flushes": self._flushes,
-                               "busEvents": self._bus_events,
+                               "flushes": flushes,
+                               "busEvents": bus_events,
                                "singleflightCoalesced":
                                    self.flight.coalesced,
                                "stalePutDrops": self._stale_put_drops,
@@ -231,4 +237,7 @@ class ServingCache:
         registry.gauge(
             "pio_cache_flushes",
             "Full cache flushes (rebind or operator, monotonic)",
+            # ptpu: guarded-by[_counter_lock] — scrape-time snapshot of
+            # a monotonic int; a torn read is impossible in CPython and
+            # an off-by-one scrape is harmless
             fn=lambda: self._flushes)

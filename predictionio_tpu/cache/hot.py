@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from ..concurrency import new_lock
 from typing import Any, Callable, Dict, Optional, Tuple
 
 log = logging.getLogger(__name__)
@@ -38,7 +40,7 @@ class HotEntityTier:
         self.pin_fn = pin_fn
         self.capacity = max(capacity, 1)
         self.refresh_every = max(refresh_every, 1)
-        self._lock = threading.Lock()
+        self._lock = new_lock("HotEntityTier._lock")
         self._counts: Dict[str, int] = {}
         self._pinned: Dict[str, Any] = {}
         self._bytes = 0

@@ -14,16 +14,17 @@ cost one supplement + one dispatch, not N.
 
 from __future__ import annotations
 
-import threading
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Hashable, Tuple
+
+from ..concurrency import new_lock
 
 __all__ = ["SingleFlight"]
 
 
 class SingleFlight:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("SingleFlight._lock")
         self._flights: Dict[Hashable, Future] = {}
         self._coalesced = 0  # followers served by a leader's flight
 
@@ -52,7 +53,8 @@ class SingleFlight:
     @property
     def coalesced(self) -> int:
         """How many callers were deduplicated onto another's flight."""
-        return self._coalesced
+        with self._lock:
+            return self._coalesced
 
     def in_flight(self) -> int:
         with self._lock:

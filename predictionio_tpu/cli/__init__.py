@@ -316,7 +316,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         cache_entries=args.cache_entries,
         cache_ttl_sec=args.cache_ttl,
         feature_ttl_sec=args.feature_ttl,
-        hot_entities=args.hot_entities)
+        hot_entities=args.hot_entities,
+        debug_locks=args.debug_locks)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -1030,10 +1031,21 @@ def cmd_run(args, storage: Storage) -> int:
 
 
 def cmd_check(args) -> int:
-    """``ptpu check`` — the JAX-aware static-analysis pass (pure AST, no
-    jax/storage import: safe on any host, fast enough for a pre-commit
-    hook). Non-zero exit on findings; see docs/static-analysis.md."""
-    from ..analysis import RULES, run_check
+    """``ptpu check`` — JAX-aware + concurrency static analysis (pure
+    AST, no jax/storage import: safe on any host, fast enough for a
+    pre-commit hook). Non-zero exit on findings — or, with
+    ``--baseline``, on findings NOT in the baseline. ``--format
+    json|sarif`` for machines (sarif feeds GitHub code-scanning PR
+    annotations); see docs/static-analysis.md."""
+    from ..analysis import (
+        RULES,
+        findings_to_json,
+        findings_to_sarif,
+        load_baseline,
+        new_findings,
+        run_check,
+        write_baseline,
+    )
 
     if args.list_rules:
         for name, rule in sorted(RULES.items()):
@@ -1045,13 +1057,42 @@ def cmd_check(args) -> int:
     except ValueError as e:
         _err(str(e))
         return 2
-    for f in findings:
-        _out(f.format())
-    if findings:
-        _err(f"ptpu check: {len(findings)} finding(s). Fix them or "
-             f"suppress with '# ptpu: allow[rule] — justification'.")
+    if args.write_baseline:
+        if not args.baseline:
+            _err("--write-baseline requires --baseline FILE")
+            return 2
+        n = write_baseline(args.baseline, findings)
+        _err(f"ptpu check: wrote {n} baseline entr"
+             f"{'y' if n == 1 else 'ies'} "
+             f"({len(findings)} finding(s)) to {args.baseline}.")
+        return 0
+    gating = findings
+    baselined = 0
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            _err(f"ptpu check: cannot read baseline: {e}")
+            return 2
+        gating = new_findings(findings, baseline)
+        baselined = len(findings) - len(gating)
+    if args.format == "json":
+        _out(findings_to_json(gating))
+    elif args.format == "sarif":
+        _out(findings_to_sarif(gating, RULES))
+    else:
+        for f in gating:
+            _out(f.format())
+    suffix = (f" ({baselined} baselined finding(s) not counted)"
+              if baselined else "")
+    if gating:
+        _err(f"ptpu check: {len(gating)} "
+             f"{'new ' if args.baseline else ''}finding(s){suffix}. "
+             f"Fix them or suppress with "
+             f"'# ptpu: allow[rule] — justification'.")
         return 1
-    _out("ptpu check: clean.")
+    if args.format == "text":
+        _out(f"ptpu check: clean.{suffix}")
     return 0
 
 
@@ -1188,6 +1229,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "bound (seconds)")
     s.add_argument("--hot-entities", type=int, default=512,
                    help="hottest entities pinned on device (0 off)")
+    s.add_argument("--debug-locks", action="store_true",
+                   help="instrument every serving-stack lock: live "
+                        "lock-order/re-entry detection, pio_lock_* "
+                        "series, deadlock watchdog (staging tool; "
+                        "PTPU_DEBUG_LOCKS=1 works too)")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -1337,15 +1383,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--channel", default="")
     s.add_argument("--input", required=True)
 
-    s = sub.add_parser("check", help="JAX-aware static analysis "
-                       "(host-sync, recompile, donation, sharding, "
-                       "config lints)")
+    s = sub.add_parser("check", help="JAX-aware + concurrency static "
+                       "analysis (host-sync, recompile, donation, "
+                       "sharding, config, lock-discipline lints)")
     s.add_argument("paths", nargs="*",
                    help="files/dirs to check (default: predictionio_tpu)")
     s.add_argument("--rule", action="append", default=[],
                    help="run only the named rule (repeatable)")
     s.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
+    s.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="output format (sarif for GitHub code-scanning "
+                        "PR annotations)")
+    s.add_argument("--baseline", default="",
+                   help="baseline file: exit 1 only on findings NOT "
+                        "recorded in it (legacy-debt burn-down)")
+    s.add_argument("--write-baseline", action="store_true",
+                   help="record current findings into --baseline FILE "
+                        "and exit 0")
 
     sub.add_parser("template", help="list bundled engine templates")
     sub.add_parser("shell", help="interactive shell with storage preloaded")

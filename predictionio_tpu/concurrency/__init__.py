@@ -1,0 +1,47 @@
+"""Runtime concurrency-correctness layer (the ``ptpu check`` complement).
+
+Static analysis (:mod:`..analysis`'s concurrency rule family) proves
+lock discipline at review time; this package verifies it live:
+
+- :func:`new_lock` / :func:`new_rlock` — the serving stack's only lock
+  constructors. Plain stdlib locks when instrumentation is off (zero
+  overhead); :class:`DebugLock` when on.
+- :class:`DebugLock` / :class:`LockRegistry` — acquisition-order graph,
+  live lock-order-inversion and same-thread-re-entry detection,
+  wait/hold/contention telemetry.
+- :func:`register_lock_metrics` — the ``pio_lock_*`` series (see
+  docs/observability.md).
+- :func:`dump_all_stacks` — the deadlock watchdog's all-thread stack
+  dump into the access log.
+
+Enable with ``ServerConfig(debug_locks=True)``, ``ptpu deploy
+--debug-locks``, or ``PTPU_DEBUG_LOCKS=1`` (see docs/operations.md for
+the staging runbook).
+"""
+
+from .locks import (
+    DebugLock,
+    LockRegistry,
+    instrument_locks,
+    lock_registry,
+    locks_instrumented,
+    new_lock,
+    new_rlock,
+    register_lock_metrics,
+    watchdog_threshold_sec,
+)
+from .watchdog import dump_all_stacks, format_all_stacks
+
+__all__ = [
+    "DebugLock",
+    "LockRegistry",
+    "dump_all_stacks",
+    "format_all_stacks",
+    "instrument_locks",
+    "lock_registry",
+    "locks_instrumented",
+    "new_lock",
+    "new_rlock",
+    "register_lock_metrics",
+    "watchdog_threshold_sec",
+]

@@ -62,12 +62,19 @@ class TransferGuardCounter(logging.Handler):
     def install(cls) -> "TransferGuardCounter":
         """Attach one shared handler to the ``jax`` logger and the root
         logger (idempotent)."""
+        # constructed BEFORE taking _lock: Handler.__init__ acquires
+        # logging's module lock, and nesting foreign locks under our
+        # own is exactly what ptpu check's lock-order rule forbids
+        handler = cls(level=logging.DEBUG)
         with cls._lock:
             if cls._installed:
-                return cls._shared
-            cls._installed = True
-            handler = cls(level=logging.DEBUG)
-            cls._shared = handler
+                installed = cls._shared
+            else:
+                cls._installed = True
+                cls._shared = installed = handler
+        if installed is not handler:
+            handler.close()  # lost the race: drop the spare
+            return installed
         for name in ("jax", None):
             logger = logging.getLogger(name)
             if handler not in logger.handlers:

@@ -91,19 +91,23 @@ class StreamingHistogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     @property
     def max(self) -> float:
-        return self._max if self._count else 0.0
+        with self._lock:
+            return self._max if self._count else 0.0
 
     @property
     def min(self) -> float:
-        return self._min if self._count else 0.0
+        with self._lock:
+            return self._min if self._count else 0.0
 
     def bucket_counts(self) -> List[Tuple[float, int]]:
         """Cumulative ``(le, count)`` pairs, ending with ``(inf, n)`` —

@@ -29,6 +29,7 @@ import logging
 import threading
 from typing import Any, Dict, Optional
 
+from ..concurrency import new_lock
 from .policy import ArmWindow, Decision, HealthPolicy, window_quantile
 from .registry import ReleaseRegistry
 from .splitter import ARM_CANDIDATE, ARM_STABLE, TrafficSplitter
@@ -54,7 +55,7 @@ class RolloutController:
                           else (1.0 if shadow else self.policy.ramp[0]))
         self.splitter = TrafficSplitter(start_fraction, shadow=shadow)
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = new_lock("RolloutController._lock")
         self.active = True
         self.outcome = ""      # "" while live; "promoted" | "rolled_back"
         self.windows = 0
@@ -73,14 +74,19 @@ class RolloutController:
             "pio_release_canary_fraction",
             "Traffic fraction routed (canary) or mirrored (shadow) to "
             "the candidate release",
+            # ptpu: guarded-by[_lock] — scrape-time gauge snapshot of a
+            # bool flag: the read is atomic in CPython and a stale
+            # sample for one scrape interval is what a gauge tolerates
             fn=lambda: self.splitter.fraction if self.active else 0.0)
         reg.gauge(
             "pio_release_rollout_active",
             "1 while a candidate release is bound and health-gated",
+            # ptpu: guarded-by[_lock] — same scrape-snapshot argument
             fn=lambda: 1.0 if self.active else 0.0)
         reg.gauge(
             "pio_release_shadow_mode",
             "1 when the live rollout mirrors instead of splitting",
+            # ptpu: guarded-by[_lock] — same scrape-snapshot argument
             fn=lambda: 1.0 if (self.active and self.shadow) else 0.0)
         self._promotions = reg.counter(
             "pio_release_promotions_total",
@@ -102,7 +108,8 @@ class RolloutController:
 
     def stop(self) -> None:
         """Stop the loop without touching bindings (server shutdown)."""
-        self.active = False
+        with self._lock:
+            self.active = False
         self._stop.set()
 
     def _run(self) -> None:
@@ -134,6 +141,7 @@ class RolloutController:
             candidate = self._arm_window(ARM_CANDIDATE)
             decision = self.policy.evaluate(stable, candidate)
             self.windows += 1
+            windows = self.windows
             self.last_decision = decision
             self.last_windows = {"stable": stable.to_json(),
                                  "candidate": candidate.to_json()}
@@ -146,7 +154,7 @@ class RolloutController:
                 # record the healthy window; the operator promotes
                 self.registry.record(
                     "shadow-window", self.instance_id, self.actor,
-                    decision.reason, windows=self.windows)
+                    decision.reason, windows=windows)
                 self._reset_baseline()
                 return True
             nxt = self.policy.next_fraction(self.splitter.fraction)

@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import hashlib
 import json
-import threading
+
+from ..concurrency import new_rlock
 from dataclasses import asdict, dataclass, field
 from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional, Tuple
@@ -95,7 +96,7 @@ class ReleaseRegistry:
         self.engine_id = engine_id
         self.engine_version = engine_version
         self.engine_variant = engine_variant
-        self._lock = threading.RLock()
+        self._lock = new_rlock("ReleaseRegistry._lock")
 
     # -- persistence --------------------------------------------------------
     @property

@@ -26,6 +26,12 @@ import time
 from dataclasses import dataclass
 from typing import Any, List, Optional
 
+from ..concurrency import (
+    instrument_locks,
+    locks_instrumented,
+    new_rlock,
+    register_lock_metrics,
+)
 from ..controller.context import Context
 from ..controller.engine import Engine
 from ..controller.params import EngineParams
@@ -123,6 +129,15 @@ class ServerConfig:
     #: (0 disables the tier)
     hot_entities: int = 512
     hot_refresh_every: int = 256       # re-rank/re-pin cadence (serves)
+    #: Instrument every lock in the serving stack with the
+    #: concurrency package's DebugLock: live lock-order-inversion and
+    #: re-entry detection, pio_lock_* wait/hold/contention series, and
+    #: a deadlock watchdog that dumps all thread stacks to the access
+    #: log when a wait exceeds PTPU_LOCK_WATCHDOG_SEC. Off by default:
+    #: disabled means plain threading locks — zero overhead. The
+    #: PTPU_DEBUG_LOCKS=1 env var enables it without a config change
+    #: (the staging runbook path, docs/operations.md).
+    debug_locks: bool = False
 
 
 @dataclass
@@ -164,7 +179,12 @@ class QueryServer:
                 raise ValueError(
                     f"feedback app {app_name!r} does not exist")
         self.plugins = plugins or EngineServerPlugins()
-        self._lock = threading.RLock()
+        if self.config.debug_locks and not locks_instrumented():
+            # flip the factories BEFORE any serving-stack lock exists
+            # so the cache/rollout/batcher locks built below are all
+            # DebugLocks feeding one process order graph
+            instrument_locks(True)
+        self._lock = new_rlock("QueryServer._lock")
         # serving cache hierarchy (ISSUE 4): built BEFORE the first
         # _bind so the bind can wire the feature tier into algorithms
         self.cache = self._make_cache()
@@ -244,6 +264,8 @@ class QueryServer:
             fn=lambda: 1.0 if self.warm_done.is_set() else 0.0)
         if self.cache is not None:
             self.cache.register_metrics(self.metrics)
+        if locks_instrumented():
+            register_lock_metrics(self.metrics)
         # the micro-batcher lives on the server (not build_app) so the
         # cached serve() path and direct embedders share one batcher
         self.batcher = (MicroBatcher(self, self.config.batch_window_ms,
@@ -267,7 +289,11 @@ class QueryServer:
         deploy-time thread flipping ``warm_done`` while a post-reload
         re-warm (newer generation) is still compiling new shapes."""
         max_b = self.config.max_batch if self.config.batching else 1
-        for algo, model in zip(self.algorithms, self.models):
+        with self._lock:
+            # snapshot: a concurrent reload/promote must not swap the
+            # lists out from under the zip mid-warm
+            algorithms, models = self.algorithms, self.models
+        for algo, model in zip(algorithms, models):
             warm = getattr(algo, "warm_serving", None)
             if warm is None:
                 continue
@@ -518,7 +544,9 @@ class QueryServer:
         from ..cache import canonical_key, entity_tag
 
         t0 = time.monotonic()
-        key = (self.instance.id, canonical_key(query_json))
+        with self._lock:
+            instance_id = self.instance.id
+        key = (instance_id, canonical_key(query_json))
         entity = self._entity_of(query_json)
         if entity is not None and cache.hot is not None:
             cache.hot.record(entity)
@@ -762,7 +790,9 @@ class QueryServer:
         its serving shapes in the background."""
         from ..workflow import core as wf
 
-        ep = engine_params or self.engine_params
+        with self._lock:
+            stable_params = self.engine_params
+        ep = engine_params or stable_params
         if models is None:
             models = wf.load_models_for_deploy(self.ctx, self.engine,
                                                instance, ep)
@@ -795,8 +825,9 @@ class QueryServer:
                          name="candidate-warmup").start()
         with self._lock:
             self._candidate = binding
+            stable_id = self.instance.id
         log.info("candidate release %s bound alongside stable %s",
-                 instance.id, self.instance.id)
+                 instance.id, stable_id)
 
     def drop_candidate(self) -> None:
         with self._lock:
@@ -809,7 +840,8 @@ class QueryServer:
 
     @property
     def candidate_instance_id(self) -> Optional[str]:
-        cand = self._candidate
+        with self._lock:
+            cand = self._candidate
         return cand.instance.id if cand is not None else None
 
     def promote_candidate(self) -> str:
@@ -850,7 +882,9 @@ class QueryServer:
             raise HTTPError(
                 400, f"instance {instance_id!r} is {inst.status}, "
                      f"not {STATUS_COMPLETED}")
-        if inst.id == self.instance.id:
+        with self._lock:
+            stable_id = self.instance.id
+        if inst.id == stable_id:
             raise HTTPError(
                 400, f"instance {instance_id!r} is already the "
                      f"serving stable")
@@ -943,8 +977,10 @@ class QueryServer:
             return
         import urllib.request
 
+        with self._lock:
+            instance_id = self.instance.id
         payload = (self.config.log_prefix + json.dumps({
-            "engineInstance": self.instance.id,
+            "engineInstance": instance_id,
             "message": message})).encode("utf-8")
 
         def ship():
@@ -991,6 +1027,9 @@ class QueryServer:
         except Exception as e:  # noqa: BLE001 — registry must never
             log.error(          # make a model unreloadable
                 "release registry read failed; reloading latest: %s", e)
+        with self._lock:
+            serving_instance = self.instance
+            engine_params = self.engine_params
         if pinned:
             latest = instances.get(pinned)
             if latest is None or latest.status != STATUS_COMPLETED:
@@ -999,12 +1038,12 @@ class QueryServer:
                          f"COMPLETED engine instance (unpin or re-pin)")
         else:
             latest = instances.get_latest_completed(
-                self.instance.engine_id, self.instance.engine_version,
-                self.instance.engine_variant)
+                serving_instance.engine_id,
+                serving_instance.engine_version,
+                serving_instance.engine_variant)
             if latest is None:
                 raise HTTPError(
                     404, "no COMPLETED engine instance to reload")
-        engine_params = self.engine_params
         models = wf.load_models_for_deploy(self.ctx, self.engine, latest,
                                            engine_params)
         self._bind(engine_params, models, latest)

@@ -21,6 +21,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..concurrency import new_lock
+
 __all__ = ["Request", "Response", "HTTPApp", "AppServer", "json_response",
            "mount_metrics"]
 
@@ -122,7 +124,7 @@ class SessionAuth:
         #: a cookie-less poller (curl health check) must not wholesale
         #: log out live browser sessions; values are monotonic expiry times
         self._tokens: "Dict[str, float]" = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("SessionKeyAuth._lock")
 
     #: sessions expire after 24h; a captured cookie does not authenticate
     #: for the life of the server process

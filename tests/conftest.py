@@ -56,3 +56,34 @@ def start_sqlite_backed_storage_server(tmp_path, secret=None):
                                 secret=secret)
     srv.start_background()
     return srv, backing
+
+
+@pytest.fixture(autouse=True)
+def _fail_on_lock_inversions():
+    """Instrumented-lock CI mode: when the suite runs with
+    PTPU_DEBUG_LOCKS=1 (the separate workflow step that re-runs the
+    cache/rollout stress tests), any lock-order inversion or
+    non-reentrant re-entry the DebugLock registry records during a test
+    fails THAT test — an ordering regression dies in CI, not in
+    production. A no-op (plain locks, no registry reads) otherwise."""
+    from predictionio_tpu.concurrency import (
+        lock_registry,
+        locks_instrumented,
+    )
+
+    if not locks_instrumented():
+        yield
+        return
+    reg = lock_registry()
+    before_inv = len(reg.inversions)
+    before_re = len(reg.reentries)
+    yield
+    inversions = reg.inversions[before_inv:]
+    reentries = reg.reentries[before_re:]
+    problems = [f"lock-order inversion: acquiring {i['acquiring']!r} "
+                f"while holding {i['held']!r} at {i['site']} "
+                f"(prior order established at {i['prior_site']})"
+                for i in inversions]
+    problems += [f"same-thread re-entry on {r['lock']!r} at {r['site']}"
+                 for r in reentries]
+    assert not problems, "\n".join(problems)

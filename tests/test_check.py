@@ -349,7 +349,9 @@ class TestRepoWide:
     def test_rule_catalogue_complete(self):
         assert set(RULES) == {
             "host-sync-in-hot-path", "recompile-hazard",
-            "missing-donation", "sharding-mismatch", "config-drift"}
+            "missing-donation", "sharding-mismatch", "config-drift",
+            "unguarded-shared-state", "lock-order-inversion",
+            "blocking-under-lock", "callback-under-lock"}
 
     def test_parse_error_is_reported_not_raised(self):
         findings = check_source("def broken(:", path=COLD)
@@ -390,6 +392,574 @@ class TestCheckCLI:
         assert main(["check", str(bad), "--rule", "config-drift"]) == 1
         assert main(["check", "--list-rules"]) == 0
         assert "config-drift" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# concurrency rule family (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+UNGUARDED = src("""
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def inc(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            return self._n
+""")
+
+
+class TestUnguardedSharedState:
+    def test_positive_read_outside_lock(self):
+        findings = check_source(UNGUARDED, path=COLD)
+        assert rules_of(findings) == ["unguarded-shared-state"]
+        assert "`self._n`" in findings[0].message
+        assert "_lock" in findings[0].message
+
+    def test_positive_write_outside_lock(self):
+        code = UNGUARDED.replace("return self._n", "self._n = 0")
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["unguarded-shared-state"]
+        assert "written" in findings[0].message
+
+    def test_negative_init_is_exempt_and_locked_access_clean(self):
+        code = src("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_unlocked_attrs_are_not_tracked(self):
+        # attrs never written under a lock have no inferred guard
+        code = src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.hits = 0
+
+                def bump(self):
+                    self.hits += 1
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_guarded_by_on_access_line_suppresses(self):
+        code = UNGUARDED.replace(
+            "return self._n",
+            "# ptpu: guarded-by[_lock] — caller holds it\n"
+            "            return self._n")
+        assert check_source(code, path=COLD) == []
+
+    def test_guarded_by_on_def_line_covers_whole_method(self):
+        code = UNGUARDED.replace(
+            "def read(self):",
+            "def read(self):  # ptpu: guarded-by[_lock] — private "
+            "helper, every caller locks")
+        assert check_source(code, path=COLD) == []
+
+    def test_guarded_by_declaration_in_init_tracks_attr(self):
+        # _gen is NEVER written under a with-lock, but the declaration
+        # annotation forces it into the guarded set
+        code = src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._gen = 0  # ptpu: guarded-by[_lock]
+
+                def read(self):
+                    return self._gen
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["unguarded-shared-state"]
+        assert "`self._gen`" in findings[0].message
+
+    def test_guarded_by_wrong_lock_does_not_suppress(self):
+        code = UNGUARDED.replace(
+            "return self._n",
+            "# ptpu: guarded-by[_other_lock] — wrong lock on purpose\n"
+            "            return self._n")
+        assert rules_of(check_source(code, path=COLD)) == [
+            "unguarded-shared-state"]
+
+    def test_nested_function_resets_lock_context(self):
+        # a closure defined under the lock runs later, unlocked
+        code = src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def set(self):
+                    with self._lock:
+                        self._n = 1
+
+                def deferred(self):
+                    with self._lock:
+                        def later():
+                            return self._n
+                        return later
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["unguarded-shared-state"]
+        assert "deferred" in findings[0].message
+
+    def test_pragma_suppresses(self):
+        code = UNGUARDED.replace(
+            "return self._n",
+            "# ptpu: allow[unguarded-shared-state] — test justification\n"
+            "            return self._n")
+        assert check_source(code, path=COLD) == []
+
+
+LOCK_CYCLE = src("""
+    import threading
+
+    A_LOCK = threading.Lock()
+    B_LOCK = threading.Lock()
+
+    def f():
+        with A_LOCK:
+            with B_LOCK:
+                pass
+
+    def g():
+        with B_LOCK:
+            with A_LOCK:
+                pass
+""")
+
+
+class TestLockOrderInversion:
+    def test_positive_two_lock_cycle(self):
+        findings = check_source(LOCK_CYCLE, path=COLD)
+        assert rules_of(findings) == ["lock-order-inversion"]
+        assert "A_LOCK" in findings[0].message
+        assert "B_LOCK" in findings[0].message
+        assert "deadlock" in findings[0].message
+
+    def test_positive_cycle_across_classes(self):
+        code = src("""
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = None
+
+                def a(self):
+                    with self._lock:
+                        with self.q.qlock:
+                            pass
+
+            class Q:
+                def __init__(self):
+                    self.qlock = threading.Lock()
+                    self.p = None
+
+                def b(self):
+                    with self.qlock:
+                        with self.p.plock:
+                            pass
+        """)
+        # P._lock → mod:?.qlock and mod:self.qlock → mod:?.plock do
+        # not close a cycle (naming is conservative); make a real one:
+        code = src("""
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock_a = threading.Lock()
+                    self._lock_b = threading.Lock()
+
+                def a(self):
+                    with self._lock_a:
+                        with self._lock_b:
+                            pass
+
+                def b(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["lock-order-inversion"]
+        assert "P._lock_a" in findings[0].message
+
+    def test_negative_consistent_order(self):
+        code = src("""
+            import threading
+
+            A_LOCK = threading.Lock()
+            B_LOCK = threading.Lock()
+
+            def f():
+                with A_LOCK:
+                    with B_LOCK:
+                        pass
+
+            def g():
+                with A_LOCK:
+                    with B_LOCK:
+                        pass
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_sequential_not_nested(self):
+        code = src("""
+            import threading
+
+            A_LOCK = threading.Lock()
+            B_LOCK = threading.Lock()
+
+            def f():
+                with A_LOCK:
+                    pass
+                with B_LOCK:
+                    pass
+
+            def g():
+                with B_LOCK:
+                    pass
+                with A_LOCK:
+                    pass
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_multi_item_with_is_ordered(self):
+        code = src("""
+            import threading
+
+            A_LOCK = threading.Lock()
+            B_LOCK = threading.Lock()
+
+            def f():
+                with A_LOCK, B_LOCK:
+                    pass
+
+            def g():
+                with B_LOCK, A_LOCK:
+                    pass
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["lock-order-inversion"]
+
+    def test_pragma_suppresses_at_anchor_edge(self):
+        # the finding anchors at the cycle's first edge site — the
+        # inner `with B_LOCK` in f(); the pragma must cover that line
+        code = LOCK_CYCLE.replace(
+            "    with A_LOCK:\n        with B_LOCK:",
+            "    with A_LOCK:\n"
+            "        # ptpu: allow[lock-order-inversion] — test fixture\n"
+            "        with B_LOCK:")
+        assert check_source(code, path=COLD) == []
+
+
+class TestBlockingUnderLock:
+    HOT_SRV = "predictionio_tpu/server/hot.py"
+
+    def _code(self, body):
+        return src("""
+            import threading
+            import time
+            import urllib.request
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def m(self, dev, t, fut):
+                    with self._lock:
+                        {body}
+        """).replace("{body}", body)
+
+    def test_positive_sleep(self):
+        findings = check_source(self._code("time.sleep(1)"),
+                                path=self.HOT_SRV)
+        assert rules_of(findings) == ["blocking-under-lock"]
+        assert "S._lock" in findings[0].message
+
+    def test_positive_block_until_ready_and_join_and_http(self):
+        for body in ("dev.block_until_ready()", "t.join()",
+                     "urllib.request.urlopen('http://x')",
+                     "fut.result()"):
+            findings = check_source(self._code(body), path=self.HOT_SRV)
+            # block_until_ready also trips host-sync-in-hot-path (both
+            # rules are right: it is a sync AND it is under a lock)
+            assert "blocking-under-lock" in rules_of(findings), body
+
+    def test_positive_storage_io(self):
+        code = src("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.storage = None
+
+                def m(self, event, app_id):
+                    with self._lock:
+                        self.storage.events().insert(event, app_id)
+        """)
+        findings = check_source(code, path=self.HOT_SRV)
+        assert rules_of(findings) == ["blocking-under-lock"]
+
+    def test_negative_outside_lock_or_outside_serving_stack(self):
+        code = src("""
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def m(self):
+                    with self._lock:
+                        x = 1
+                    time.sleep(0.01)
+                    return x
+        """)
+        assert check_source(code, path=self.HOT_SRV) == []
+        # same blocking code outside server/cache/rollout: not flagged
+        assert check_source(self._code("time.sleep(1)"), path=COLD) == []
+
+    def test_negative_str_join_with_args_not_flagged(self):
+        findings = check_source(self._code("','.join(['a', 'b'])"),
+                                path=self.HOT_SRV)
+        assert findings == []
+
+    def test_negative_deferred_closure_not_flagged(self):
+        # defining a function under the lock is not calling it
+        body = ("def later():\n"
+                "                    time.sleep(1)")
+        assert check_source(self._code(body), path=self.HOT_SRV) == []
+
+    def test_pragma_suppresses(self):
+        body = ("# ptpu: allow[blocking-under-lock] — test fixture\n"
+                "            time.sleep(1)")
+        assert check_source(self._code(body), path=self.HOT_SRV) == []
+
+
+class TestCallbackUnderLock:
+    BUS = src("""
+        import threading
+
+        class Bus:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._subs = []
+
+            def publish(self, x):
+                with self._lock:
+                    for fn in self._subs:
+                        fn(x)
+    """)
+
+    def test_positive_loop_variable_callback(self):
+        findings = check_source(self.BUS, path=COLD)
+        assert rules_of(findings) == ["callback-under-lock"]
+        assert "`fn(…)`" in findings[0].message
+
+    def test_positive_param_callback(self):
+        code = src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self, hook):
+                    with self._lock:
+                        hook()
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["callback-under-lock"]
+
+    def test_positive_publish_method_under_lock(self):
+        code = src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.bus = None
+
+                def ingest(self, ev):
+                    with self._lock:
+                        self.bus.publish(ev)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["callback-under-lock"]
+        assert ".publish" in findings[0].message
+
+    def test_negative_snapshot_then_call_outside(self):
+        # the invalidation-bus pattern: copy under lock, call outside
+        code = src("""
+            import threading
+
+            class Bus:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._subs = []
+
+                def publish(self, x):
+                    with self._lock:
+                        subs = list(self._subs)
+                    for fn in subs:
+                        fn(x)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_nested_def_called_under_lock(self):
+        # a locally-defined function's body is statically known
+        code = src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    def helper():
+                        return 1
+
+                    with self._lock:
+                        return helper()
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = self.BUS.replace(
+            "fn(x)",
+            "# ptpu: allow[callback-under-lock] — test fixture\n"
+            "                    fn(x)")
+        assert check_source(code, path=COLD) == []
+
+
+class TestCheckFormatsAndBaseline:
+    BAD = src("""
+        import numpy as np
+
+        def handler(arr):
+            return np.asarray(arr)
+    """)
+
+    def _bad_dir(self, tmp_path):
+        d = tmp_path / "server"
+        d.mkdir()
+        (d / "bad.py").write_text(self.BAD)
+        return tmp_path
+
+    def test_format_json(self, tmp_path, capsys):
+        import json
+
+        target = self._bad_dir(tmp_path)
+        assert main(["check", str(target), "--format", "json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["count"] == 1
+        f = doc["findings"][0]
+        assert f["rule"] == "host-sync-in-hot-path"
+        assert f["line"] == 5 and f["path"].endswith("bad.py")
+
+    def test_format_sarif(self, tmp_path, capsys):
+        import json
+
+        target = self._bad_dir(tmp_path)
+        assert main(["check", str(target), "--format", "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "ptpu-check"
+        declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        from predictionio_tpu.analysis import RULES as rules
+
+        assert set(rules) <= declared
+        result = run["results"][0]
+        assert result["ruleId"] == "host-sync-in-hot-path"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] == 5
+        assert loc["artifactLocation"]["uri"].endswith("bad.py")
+
+    def test_sarif_clean_run_is_valid(self, tmp_path, capsys):
+        import json
+
+        good = tmp_path / "fine.py"
+        good.write_text("X = 1\n")
+        assert main(["check", str(good), "--format", "sarif"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["runs"][0]["results"] == []
+
+    def test_baseline_write_then_gate(self, tmp_path, capsys):
+        target = self._bad_dir(tmp_path)
+        bl = tmp_path / "baseline.json"
+        assert main(["check", str(target),
+                     "--baseline", str(bl), "--write-baseline"]) == 0
+        assert bl.exists()
+        # baselined finding no longer fails the gate
+        assert main(["check", str(target), "--baseline", str(bl)]) == 0
+        out = capsys.readouterr()
+        assert "baselined" in out.out
+        # a NEW finding still fails, and is the only one printed
+        (target / "server" / "bad2.py").write_text(self.BAD)
+        assert main(["check", str(target), "--baseline", str(bl)]) == 1
+        out = capsys.readouterr()
+        assert "bad2.py" in out.out
+        assert "bad.py:" not in out.out.replace("bad2.py:", "")
+        assert "new finding" in out.err
+
+    def test_baseline_counts_per_key(self, tmp_path):
+        # two identical findings in one file, baseline records both;
+        # a third instance of the same (path, rule, message) fails
+        d = tmp_path / "server"
+        d.mkdir()
+        two = ("import numpy as np\n\n"
+               "def handler(arr):\n"
+               "    a = np.asarray(arr)\n"
+               "    b = np.asarray(arr)\n"
+               "    return a, b\n")
+        (d / "bad.py").write_text(two)
+        bl = tmp_path / "bl.json"
+        assert main(["check", str(tmp_path),
+                     "--baseline", str(bl), "--write-baseline"]) == 0
+        assert main(["check", str(tmp_path), "--baseline", str(bl)]) == 0
+        three = two.replace("return a, b",
+                            "c = np.asarray(arr)\n    return a, b, c")
+        (d / "bad.py").write_text(three)
+        assert main(["check", str(tmp_path), "--baseline", str(bl)]) == 1
+
+    def test_missing_baseline_file_is_an_error(self, tmp_path, capsys):
+        good = tmp_path / "fine.py"
+        good.write_text("X = 1\n")
+        assert main(["check", str(good),
+                     "--baseline", str(tmp_path / "nope.json")]) == 2
+        assert "cannot read baseline" in capsys.readouterr().err
+
+    def test_write_baseline_requires_path(self, tmp_path, capsys):
+        good = tmp_path / "fine.py"
+        good.write_text("X = 1\n")
+        assert main(["check", str(good), "--write-baseline"]) == 2
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,452 @@
+"""Runtime concurrency layer: DebugLock order-graph/inversion/re-entry
+detection, the deadlock watchdog's stack dump, pio_lock_* metric
+emission, the zero-overhead disabled path, and an instrumented stress
+run over the real serving-cache stack."""
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+import pytest
+
+from predictionio_tpu.concurrency import (
+    DebugLock,
+    LockRegistry,
+    dump_all_stacks,
+    instrument_locks,
+    lock_registry,
+    locks_instrumented,
+    new_lock,
+    new_rlock,
+    register_lock_metrics,
+)
+from predictionio_tpu.concurrency.locks import _env_enabled
+
+
+@pytest.fixture()
+def restore_instrumentation():
+    """Save/restore the global instrumentation flag around tests that
+    flip it (the CI instrumented run has it ON for the whole suite)."""
+    was = locks_instrumented()
+    yield
+    instrument_locks(was)
+
+
+class TestFactories:
+    def test_disabled_returns_plain_stdlib_locks(
+            self, restore_instrumentation):
+        # the acceptance bar: disabled means the literal stdlib type —
+        # no wrapper, no overhead path at all
+        instrument_locks(False)
+        assert type(new_lock("x")) is type(threading.Lock())
+        assert type(new_rlock("x")) is type(threading.RLock())
+
+    def test_enabled_returns_debuglock(self, restore_instrumentation):
+        instrument_locks(True)
+        lock = new_lock("TestFactories.lock")
+        rlock = new_rlock("TestFactories.rlock")
+        assert isinstance(lock, DebugLock) and not lock.reentrant
+        assert isinstance(rlock, DebugLock) and rlock.reentrant
+
+    def test_env_flag_parsing(self, monkeypatch):
+        for val, expect in (("1", True), ("true", True), ("on", True),
+                            ("0", False), ("", False), ("no", False)):
+            monkeypatch.setenv("PTPU_DEBUG_LOCKS", val)
+            assert _env_enabled() is expect, val
+
+
+class TestInversionDetection:
+    def _cross(self, reg):
+        """Two threads acquiring {A, B} in opposite orders, staggered
+        so both acquisitions succeed (the graph, not an actual
+        deadlock, must catch it)."""
+        a = DebugLock("A", registry=reg, watchdog_sec=30)
+        b = DebugLock("B", registry=reg, watchdog_sec=30)
+        done = threading.Event()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+            done.set()
+
+        def t2():
+            done.wait(timeout=10)  # strictly after t1 finished
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start()
+        th2.start()
+        th1.join(timeout=10)
+        th2.join(timeout=10)
+
+    def test_intentional_inversion_detected(self):
+        reg = LockRegistry()
+        self._cross(reg)
+        assert len(reg.inversions) == 1
+        inv = reg.inversions[0]
+        assert inv["held"] == "B" and inv["acquiring"] == "A"
+        assert inv["prior_site"] != "?"
+
+    def test_inversion_reported_once_per_pair(self):
+        reg = LockRegistry()
+        self._cross(reg)
+        self._cross(reg)
+        assert len(reg.inversions) == 1
+
+    def test_consistent_order_is_clean(self):
+        reg = LockRegistry()
+        a = DebugLock("A", registry=reg)
+        b = DebugLock("B", registry=reg)
+
+        def worker():
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert reg.inversions == []
+        report = reg.report()
+        assert report["acquisitions"] >= 400
+        assert report["edges"] == {"A": ["B"]}
+
+
+class TestReentry:
+    def test_nonreentrant_reentry_raises_and_is_recorded(self):
+        reg = LockRegistry()
+        lock = DebugLock("L", registry=reg)
+        with pytest.raises(RuntimeError, match="re-entry"):
+            with lock:
+                with lock:
+                    pass
+        assert len(reg.reentries) == 1
+        assert reg.reentries[0]["lock"] == "L"
+        # the failed inner acquire must not have corrupted the outer
+        # hold: the lock is released and reusable
+        with lock:
+            pass
+
+    def test_rlock_reentry_is_fine(self):
+        reg = LockRegistry()
+        lock = DebugLock("R", reentrant=True, registry=reg)
+        with lock:
+            with lock:
+                with lock:
+                    pass
+        assert reg.reentries == []
+        with lock:  # still usable, depth fully unwound
+            pass
+
+
+class TestWatchdog:
+    def test_long_wait_dumps_all_stacks_to_access_log(self, caplog):
+        reg = LockRegistry()
+        lock = DebugLock("W", registry=reg, watchdog_sec=0.15)
+        release = threading.Event()
+        held = threading.Event()
+
+        def holder():
+            with lock:
+                held.set()
+                release.wait(timeout=10)
+
+        th = threading.Thread(target=holder, name="wd-holder")
+        th.start()
+        held.wait(timeout=10)
+        with caplog.at_level(logging.ERROR, "predictionio_tpu.access"):
+            def waiter():
+                with lock:
+                    pass
+
+            tw = threading.Thread(target=waiter, name="wd-waiter")
+            tw.start()
+            time.sleep(0.4)  # > watchdog threshold while still blocked
+            release.set()
+            tw.join(timeout=10)
+        th.join(timeout=10)
+        assert reg.report()["watchdogDumps"] >= 1
+        dump = "\n".join(r.getMessage() for r in caplog.records
+                         if "lock watchdog" in r.getMessage())
+        assert "'W'" in dump
+        assert "wd-holder" in dump  # the holder's stack is in the dump
+        assert "release.wait" in dump  # ...down to the blocking line
+
+    def test_dump_all_stacks_returns_formatted_block(self):
+        block = dump_all_stacks(
+            reason="unit probe",
+            logger=logging.getLogger("tests.watchdog"))
+        assert "unit probe" in block
+        assert threading.current_thread().name in block
+
+    def test_timeout_acquire_still_honored(self):
+        reg = LockRegistry()
+        lock = DebugLock("T", registry=reg, watchdog_sec=0.1)
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                release.wait(timeout=10)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        assert lock.acquire(timeout=0.3) is False
+        assert 0.2 < time.monotonic() - t0 < 2.0
+        assert lock.acquire(blocking=False) is False
+        release.set()
+        th.join(timeout=10)
+
+
+class TestLockMetrics:
+    def test_pio_lock_series_emitted(self, restore_instrumentation):
+        from predictionio_tpu.obs import MetricsRegistry
+
+        instrument_locks(True)
+        reg = lock_registry()
+        lock = new_lock("TestLockMetrics.lock")
+        for _ in range(5):
+            with lock:
+                pass
+        metrics = MetricsRegistry()
+        register_lock_metrics(metrics)
+        text = metrics.render()
+        for series in ("pio_lock_instrumented 1",
+                       "pio_lock_acquisitions",
+                       "pio_lock_contention_total",
+                       "pio_lock_inversions_total",
+                       "pio_lock_reentries_total",
+                       "pio_lock_watchdog_dumps_total"):
+            assert series in text, series
+        assert 'pio_lock_wait_seconds_bucket{lock="TestLockMetrics.lock"' \
+            in text
+        assert 'pio_lock_hold_seconds_count{lock="TestLockMetrics.lock"}' \
+            in text
+        snapshot_count = [
+            line for line in text.splitlines()
+            if line.startswith("pio_lock_hold_seconds_count"
+                               '{lock="TestLockMetrics.lock"}')]
+        assert int(float(snapshot_count[0].split()[-1])) >= 5
+        assert reg.report()["acquisitions"] >= 5
+
+    def test_contention_counted(self):
+        reg = LockRegistry()
+        lock = DebugLock("C", registry=reg, watchdog_sec=30)
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                release.wait(timeout=10)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        time.sleep(0.05)
+
+        def contender():
+            with lock:
+                pass
+
+        tc = threading.Thread(target=contender)
+        tc.start()
+        time.sleep(0.05)
+        release.set()
+        tc.join(timeout=10)
+        th.join(timeout=10)
+        assert reg.report()["contended"] >= 1
+        assert reg.report()["contentionByLock"].get("C", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# instrumented serving-stack stress: the real cache hierarchy under
+# concurrent serve/ingest/flush traffic must record ZERO inversions
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _EchoQuery:
+    user: str = "u0"
+    v: int = 0
+
+
+class _EchoAlgo:
+    query_class = _EchoQuery
+
+    def bind_serving(self, ctx):
+        pass
+
+    def prepare_serving_model(self, model, max_batch):
+        return model
+
+    def predict(self, model, query):
+        return {"user": query.user, "doubled": query.v * 2}
+
+
+class _EchoServing:
+    def supplement(self, query):
+        return query
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class _EchoEngine:
+    def make_algorithms(self, engine_params):
+        return [_EchoAlgo()]
+
+    def make_serving(self, engine_params):
+        return _EchoServing()
+
+
+def _echo_server(**config_kwargs):
+    from predictionio_tpu.data.event import utcnow
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+    )
+
+    class _Ctx:
+        storage = None
+
+    now = utcnow()
+    instance = EngineInstance(id="i1", status="COMPLETED",
+                              start_time=now, end_time=now,
+                              engine_id="echo", engine_version="1",
+                              engine_variant="engine.json",
+                              engine_factory="tests:echo")
+    cfg = ServerConfig(warm_start=False, **config_kwargs)
+    return QueryServer(_Ctx(), _EchoEngine(), engine_params=None,
+                       models=[None], instance=instance, config=cfg)
+
+
+class TestInstrumentedServingStack:
+    def test_debug_locks_config_flag_instruments_the_stack(
+            self, restore_instrumentation):
+        instrument_locks(False)
+        server = _echo_server(debug_locks=True, serving_cache=True)
+        assert locks_instrumented()
+        assert isinstance(server._lock, DebugLock)
+        assert isinstance(server.cache.flight._lock, DebugLock)
+        assert isinstance(
+            server.cache.query._shards[0].lock, DebugLock)
+        # lock metrics are mounted on the server's registry
+        assert "pio_lock_instrumented 1" in server.metrics.render()
+
+    def test_stress_serve_ingest_flush_zero_inversions(
+            self, restore_instrumentation):
+        from predictionio_tpu.cache import InvalidationBus, ServingCache
+
+        instrument_locks(True)
+        reg = lock_registry()
+        base_inv = len(reg.inversions)
+        bus = InvalidationBus()
+        cache = ServingCache(query_entries=64, query_ttl_sec=5.0,
+                             hot_capacity=8, hot_refresh_every=4,
+                             pin_fn=lambda keys: ({k: 1 for k in keys},
+                                                  8 * len(keys)),
+                             bus=bus)
+        stop = threading.Event()
+        errors = []
+
+        def serve_loop(i):
+            try:
+                n = 0
+                while not stop.is_set():
+                    n += 1
+                    key = ("ns", f"q{i}-{n % 7}")
+                    token = cache.epoch_token(f"user:u{n % 5}")
+                    found, _ = cache.query.lookup(key)
+                    if not found:
+                        cache.put_query_fresh(
+                            key, {"n": n}, (f"user:u{n % 5}",), token)
+                    if cache.hot is not None:
+                        cache.hot.record(f"u{n % 5}")
+                        cache.hot.lookup(f"u{n % 5}")
+            except Exception as e:  # noqa: BLE001 — surface in-test
+                errors.append(e)
+
+        def ingest_loop():
+            try:
+                n = 0
+                while not stop.is_set():
+                    n += 1
+                    bus.publish(1, "user", f"u{n % 5}", "view")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def flush_loop():
+            try:
+                while not stop.is_set():
+                    cache.flush_all()
+                    cache.stats()
+                    time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = ([threading.Thread(target=serve_loop, args=(i,))
+                    for i in range(4)]
+                   + [threading.Thread(target=ingest_loop),
+                      threading.Thread(target=flush_loop)])
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert errors == []
+        assert reg.inversions[base_inv:] == []
+        assert reg.report()["acquisitions"] > 1000
+
+    def test_stress_query_server_promote_swap_zero_inversions(
+            self, restore_instrumentation):
+        instrument_locks(False)
+        server = _echo_server(debug_locks=True, serving_cache=True,
+                              hot_entities=8, hot_refresh_every=4)
+        reg = lock_registry()
+        base_inv = len(reg.inversions)
+        stop = threading.Event()
+        errors = []
+
+        def serve_loop(i):
+            try:
+                n = 0
+                while not stop.is_set():
+                    n += 1
+                    result = server.serve(
+                        {"user": f"u{n % 5}", "v": n % 11})
+                    assert result["doubled"] == (n % 11) * 2
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def rebind_loop():
+            # the promote-swap hot spot: _bind under the server lock
+            # flushes every cache tier (nested acquisition) while
+            # serve() traffic fills them in the other order of events
+            try:
+                while not stop.is_set():
+                    server._bind(server.engine_params, [None],
+                                 server.instance)
+                    time.sleep(0.02)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = ([threading.Thread(target=serve_loop, args=(i,))
+                    for i in range(4)]
+                   + [threading.Thread(target=rebind_loop)])
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert errors == []
+        assert reg.inversions[base_inv:] == []
+        assert reg.reentries == []

@@ -321,8 +321,15 @@ class TestCanaryLifecycle:
         ctx, engine, ep, iid1, iid2 = two_releases
         qs, srv = _serve(two_releases, iid1)
         try:
+            # p99_regression is effectively disabled: with a 3-query
+            # minimum sample, one scheduler hiccup on a candidate
+            # query under full-suite load flips the 2x default and
+            # rolls back a healthy canary (observed flake). This test
+            # exercises the ramp/promote mechanics; the latency gate
+            # has its own coverage in TestPolicy.
             policy = HealthPolicy(window_sec=0.15, min_queries=3,
-                                  ramp=(0.25, 1.0))
+                                  ramp=(0.25, 1.0),
+                                  p99_regression=1000.0)
             ctl = qs.start_canary(iid2, policy=policy, actor="test",
                                   reason="healthy retrain")
             assert ctl.splitter.fraction == 0.25  # first ramp step
