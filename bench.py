@@ -24,6 +24,7 @@ Honesty model (BASELINE.md "bench accounting"):
 
 import json
 import os
+import random
 import re
 import subprocess
 import sys
@@ -35,10 +36,28 @@ import numpy as np
 #: error strings before they land in BENCH JSON, which must stay
 #: greppable plain text (BENCH_LASTGOOD.json carried raw `\x1b[2m`)
 _ANSI_RE = re.compile(r"\x1b\[[0-9;]*[A-Za-z]")
+#: stray escape FRAGMENTS a mid-sequence truncation leaves behind
+#: (BENCH_r05 race_errors ended in a bare `\x1b[2m<timestamp>`)
+_ANSI_FRAG_RE = re.compile(r"\x1b\[?[0-9;]*")
+#: log-line timestamps (ISO dates, times) — noise in a recorded error
+_TS_RE = re.compile(
+    r"\d{4}-\d{2}-\d{2}[T ]?(\d{2}:\d{2}(:\d{2}(\.\d+)?)?)?Z?")
 
 
 def _strip_ansi(s: str) -> str:
-    return _ANSI_RE.sub("", s)
+    return _ANSI_FRAG_RE.sub("", _ANSI_RE.sub("", s))
+
+
+def _clean_err(s: str, limit: int = 160) -> str:
+    """One BENCH-safe line out of an arbitrary exception string: ANSI
+    escapes (and truncation fragments) stripped, log timestamps
+    dropped, whitespace collapsed, bounded length. Raw multi-line jax
+    tracebacks previously leaked `\\n\\x1b[2m2026-07-31T20:57` tails
+    into the recorded race_errors (BENCH_r05)."""
+    s = _strip_ansi(str(s))
+    s = _TS_RE.sub("", s)
+    s = " ".join(s.split())
+    return s[:limit].rstrip()
 
 #: Headline peak matmul FLOP/s by TPU generation (bf16; public spec
 #: sheets). MFU is reported against this even though the bench runs f32 —
@@ -312,11 +331,15 @@ def main():
                               implicit_prefs=True, alpha=alpha, reg=reg,
                               seed=3, gram_mode=gm, gather_dtype=gd,
                               block_rows=br)
-            # retry-once on transient compile-service failures (round 4:
-            # three candidates died on `remote_compile: HTTP 500` and a
-            # 1-of-4 walkover "won" the race — a transient helper crash
-            # must not void a candidate's measurement)
-            for attempt in (0, 1):
+            # bounded exponential backoff with jitter on transient
+            # compile-service failures (BENCH_r05 race_errors: several
+            # candidates died on `remote_compile: HTTP 500` bursts from
+            # the shared tpu_compile_helper — a fixed single 10s retry
+            # re-collided with the same burst; jitter decorrelates and
+            # the cap bounds a dead helper's cost per candidate)
+            max_retries = int(os.environ.get("BENCH_COMPILE_RETRIES",
+                                             "3"))
+            for attempt in range(max_retries + 1):
                 try:
                     U, V = train_als(r_in, p_run, packed=p_in)  # warm
                     hard_sync(V)
@@ -336,13 +359,14 @@ def main():
                     # tunnel helper) must not kill candidates that work
                     transient = ("HTTP 500" in str(ce)
                                  or "remote_compile" in str(ce))
-                    if attempt == 0 and transient:
+                    if attempt < max_retries and transient:
                         retried += 1
-                        time.sleep(10.0)
+                        delay = min(5.0 * (2 ** attempt), 60.0)
+                        time.sleep(delay * random.uniform(0.5, 1.5))
                         continue
                     cand_errors.append(
                         f"{gm}/{gd}{f'/br{br}' if br else ''}: "
-                        f"{_strip_ansi(str(ce))[:120]}")
+                        f"{_clean_err(ce, 120)}")
                     f32_failed = f32_failed or gd == "float32"
                     break
         if best_params is None:
@@ -367,11 +391,22 @@ def main():
                 pass
         fl = als_flops_per_iter(p_in[0], p_in[1], best_params)
         ach = fl * iterations / best_dt  # raw; display-rounded once
+        # what gram_mode="auto" RESOLVES to for this rank (persistent
+        # shape-keyed table → defaults → heuristic) — reported beside
+        # the race's measured winner so a stale autotune entry is
+        # visible in the BENCH line, not silently trained against
+        try:
+            from predictionio_tpu.ops.gram_autotune import best_mode
+            autotune_pick = best_mode(
+                rank_r, device_kind=jax.devices()[0].device_kind)
+        except Exception:  # noqa: BLE001 — advisory only
+            autotune_pick = None
         out = {
             "value": round(n_in * iterations / best_dt, 1),
             "achieved_tflops": round(ach / 1e12, 2),
             "mfu": round(ach / peak, 4) if peak else None,
             "gram_mode": best_gm,
+            "autotune_pick": autotune_pick,
             "gather_dtype": best_params.gather_dtype,
             "_achieved_flops_raw": ach,
         }
@@ -425,7 +460,7 @@ def main():
                     cands_override=[(fb_gram, fb_gather)],
                     block_rows=1024)
                 rank128.pop("_achieved_flops_raw", None)
-                rank128.update(auto_block_error=str(e)[:160])
+                rank128.update(auto_block_error=_clean_err(e))
             except Exception as e_br:  # noqa: BLE001 — small blocks
                 # failed too: last resort is an 8M subsample, honestly
                 # labeled with its scale
@@ -446,10 +481,10 @@ def main():
                         cands_override=[(fb_gram, fb_gather)])
                     rank128.pop("_achieved_flops_raw", None)
                     rank128.update(nnz=sub_n, scaled=True,
-                                   full_scale_error=str(e)[:160],
-                                   small_blocks_error=str(e_br)[:160])
+                                   full_scale_error=_clean_err(e),
+                                   small_blocks_error=_clean_err(e_br))
                 except Exception as e2:  # noqa: BLE001
-                    rank128 = {"error": str(e2)[:300]}
+                    rank128 = {"error": _clean_err(e2, 300)}
 
     cpu_rps = cpu_als_baseline(
         n_users=max(int(n_users * cpu_scale), 64),
@@ -493,7 +528,35 @@ def main():
             serving = sb.standard_battery(n_cat, 64, n_req, 8,
                                           hi_threads)
         except Exception as e:  # noqa: BLE001 — report, don't die
-            serving = {"error": str(e)[:300]}
+            serving = {"error": _clean_err(e, 300)}
+
+    # per-mode device-scaling block (ISSUE 6): the same burst workload
+    # through the micro-batcher in single / replicated / sharded serving
+    # — replicated's scaling_x against the single lane is the
+    # near-linear-on-N-devices acceptance number (MULTICHIP_r05 shows 8
+    # healthy devices; one HBM held the whole model until now)
+    device_scaling = None
+    if os.environ.get("BENCH_MESH", "1") == "1":
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks"))
+            import serving_bench as sb_mesh
+
+            if len(jax.devices()) > 1:
+                n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "200"))
+                n_cat = int(os.environ.get("BENCH_SERVE_ITEMS",
+                                           "1200000"))
+                hi_threads = int(os.environ.get(
+                    "BENCH_SERVE_THREADS_HI", "256"))
+                device_scaling = sb_mesh.mesh_scaling_battery(
+                    n_cat, 64, n_req, hi_threads)
+            else:
+                device_scaling = {"devices": 1,
+                                  "note": "one device visible; no "
+                                          "fan-out to measure"}
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            device_scaling = {"error": _clean_err(e, 300)}
 
     # roofline accounting (VERDICT r4 weak #3: "memory-bound" was an
     # excuse, not a measurement): XLA's post-fusion bytes-accessed over
@@ -544,8 +607,10 @@ def main():
         "ndcg10": ndcg10,
         "rank": rank,
         "gram_mode": gram_used,
+        "autotune_pick": r64.get("autotune_pick"),
         "gather_dtype": r64.get("gather_dtype"),
         "rank128": rank128,
+        "device_scaling": device_scaling,
         "serving_p50_ms": (serving or {}).get(
             "per_query", {}).get("p50_ms"),
         "serving_p99_ms": (serving or {}).get(

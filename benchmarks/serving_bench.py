@@ -29,9 +29,16 @@ cache off vs on — and a trailing hot-query loop measures the pure
 cache-hit latency; the emitted row reports cached-vs-uncached p50/p99
 side by side plus the server's own /cache.json tier stats.
 
+With ``--mesh`` (ISSUE 6), a device-scaling battery runs the same
+burst workload against the micro-batcher in single mode, replicated
+fan-out (a full model copy per device, per-device lanes), and the
+row-sharded mesh — per-mode qps plus the replicated/single
+``scaling_x`` ratio.
+
 Usage: python benchmarks/serving_bench.py [n_items_device] [rank]
                                           [--canary FRACTION]
                                           [--zipf ALPHA] [--cache]
+                                          [--mesh]
 Env:   SERVE_THREADS (8), SERVE_REQUESTS (400 per config)
 """
 
@@ -304,6 +311,42 @@ def standard_battery(n_items_dev: int, rank: int, n_req: int,
     }
 
 
+def mesh_scaling_battery(n_items_dev: int, rank: int, n_req: int,
+                         hi_threads: int) -> dict:
+    """Per-mode device-scaling probe (ISSUE 6): the SAME burst workload
+    against the micro-batcher in single mode, replicated fan-out
+    (per-device lanes), and the row-sharded mesh — qps side by side
+    plus ``scaling_x`` (replicated qps over single-lane qps, the
+    near-linear-on-N-devices acceptance number). One device degrades
+    to the single row alone."""
+    import jax
+
+    n_dev = len(jax.devices())
+    dev_model = synth_model(50_000, n_items_dev, rank, device=True)
+    hi_req = max(n_req, 8 * hi_threads)
+    single = bench_config(
+        dev_model, ServerConfig(batching=True, max_batch=128,
+                                batch_window_ms=2.0),
+        hi_req, hi_threads, "mesh_single_microbatch")
+    out: dict = {"devices": n_dev, "single": single}
+    if n_dev > 1:
+        rep = bench_config(
+            dev_model, ServerConfig(batching=True, max_batch=128,
+                                    batch_window_ms=2.0,
+                                    serving_mode="replicated"),
+            hi_req, hi_threads, "mesh_replicated_microbatch")
+        if single.get("qps"):
+            rep["scaling_x"] = round(rep["qps"] / single["qps"], 2)
+        out["replicated"] = rep
+        sharded = bench_config(
+            dev_model, ServerConfig(batching=True, max_batch=128,
+                                    batch_window_ms=2.0,
+                                    serving_mode="sharded"),
+            n_req, min(hi_threads, 64), "mesh_sharded_microbatch")
+        out["sharded"] = sharded
+    return out
+
+
 def bench_canary(model: ALSModel, candidate: ALSModel, fraction: float,
                  n_requests: int, n_threads: int) -> dict:
     """Stable + candidate bound side by side: the canary splitter
@@ -453,6 +496,10 @@ def main() -> None:
     if "--cache" in argv:
         with_cache = True
         argv.remove("--cache")
+    with_mesh = False
+    if "--mesh" in argv:
+        with_mesh = True
+        argv.remove("--mesh")
     sys.argv[1:] = argv
     n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -471,6 +518,9 @@ def main() -> None:
     hi = int(os.environ.get("SERVE_THREADS_HI", "256"))
     results = list(standard_battery(n_items_dev, rank, n_requests,
                                     n_threads, hi).values())
+    if with_mesh:
+        scaling = mesh_scaling_battery(n_items_dev, rank, n_requests, hi)
+        results.append({"config": "mesh_scaling", **scaling})
     if with_cache:
         results.extend(bench_cached_pair(n_items_dev, rank, n_requests,
                                          n_threads, zipf_alpha))

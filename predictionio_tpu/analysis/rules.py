@@ -24,9 +24,13 @@ The JAX rules, each an AST pass over one :class:`~.core.ModuleInfo`:
   jitted function that does not donate the re-bound buffer: the old
   ``x`` stays alive across the step, doubling peak HBM for large
   factor/accumulator arrays.
-- ``sharding-mismatch`` — ``PartitionSpec`` axis-name literals that no
-  mesh builder in ``parallel/mesh.py`` declares; XLA only reports these
-  at trace time on a real mesh, usually mid-deploy.
+- ``sharding-mismatch`` — ``PartitionSpec`` axis-name literals (wherever
+  they appear: ``NamedSharding(mesh, P(...))`` annotations on entry
+  points, ``shard_map`` in/out specs, jit ``out_shardings``) and axis
+  names passed to ``lax`` collectives (``psum``/``all_gather``/
+  ``ppermute``/``axis_index``/…) that no mesh builder in
+  ``parallel/mesh.py`` declares; XLA only reports these at trace time
+  on a real mesh, usually mid-deploy.
 - ``config-drift`` — ``jax.config.update`` outside
   ``utils/platform.py``: scattered config flips make process behavior
   depend on import order (exactly the class of bug
@@ -481,27 +485,64 @@ def _axis_literals(node: ast.AST) -> List[str]:
     return out
 
 
+#: ``lax`` collectives whose axis-name argument must name a declared
+#: mesh axis — a typo'd axis here fails exactly like a bad
+#: PartitionSpec, at trace time on a real mesh. Maps dotted name →
+#: positional index of the axis argument.
+_COLLECTIVE_AXIS_ARG = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.pvary": 1,
+}
+
+
 def rule_sharding_mismatch(mod: ModuleInfo,
                            ctx: CheckContext) -> List[Finding]:
     axes = ctx.declared_axes
     if not axes:
         return []
     findings: List[Finding] = []
+
+    def check(node: ast.Call, arg: ast.AST, what: str) -> None:
+        for name in _axis_literals(arg):
+            if name not in axes:
+                findings.append(Finding(
+                    "sharding-mismatch", mod.path, node.lineno,
+                    node.col_offset,
+                    f"{what} axis {name!r} is not declared by "
+                    f"parallel/mesh.py (declared: {sorted(axes)}); "
+                    f"XLA will reject it at trace time on a real "
+                    f"mesh"))
+
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
-        if mod.resolve(node.func) != "jax.sharding.PartitionSpec":
+        resolved = mod.resolve(node.func)
+        if resolved == "jax.sharding.PartitionSpec":
+            # covers every NamedSharding-annotated entry point too:
+            # NamedSharding(mesh, P(...)), shard_map in/out specs, jit
+            # out_shardings — the axis names always ride a
+            # PartitionSpec call
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                check(node, arg, "PartitionSpec")
             continue
-        for arg in list(node.args) + [kw.value for kw in node.keywords]:
-            for name in _axis_literals(arg):
-                if name not in axes:
-                    findings.append(Finding(
-                        "sharding-mismatch", mod.path, node.lineno,
-                        node.col_offset,
-                        f"PartitionSpec axis {name!r} is not declared "
-                        f"by parallel/mesh.py (declared: "
-                        f"{sorted(axes)}); XLA will reject this spec "
-                        f"at trace time on a real mesh"))
+        pos = _COLLECTIVE_AXIS_ARG.get(resolved or "")
+        if pos is None:
+            continue
+        short = (resolved or "").rsplit(".", 1)[-1]
+        if pos < len(node.args):
+            check(node, node.args[pos], f"lax.{short}")
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                check(node, kw.value, f"lax.{short}")
     return findings
 
 
@@ -557,7 +598,8 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
          "re-bound buffer",
          rule_missing_donation),
     Rule("sharding-mismatch",
-         "PartitionSpec axis names not declared by parallel/mesh.py",
+         "PartitionSpec / NamedSharding / lax-collective axis names "
+         "not declared by parallel/mesh.py",
          rule_sharding_mismatch),
     Rule("config-drift",
          "jax.config.update outside utils/platform.py",

@@ -317,7 +317,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         cache_ttl_sec=args.cache_ttl,
         feature_ttl_sec=args.feature_ttl,
         hot_entities=args.hot_entities,
-        debug_locks=args.debug_locks)
+        debug_locks=args.debug_locks,
+        serving_mode=args.serving_mode)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -1234,6 +1235,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "lock-order/re-entry detection, pio_lock_* "
                         "series, deadlock watchdog (staging tool; "
                         "PTPU_DEBUG_LOCKS=1 works too)")
+    s.add_argument("--serving-mode", default="single",
+                   choices=["auto", "single", "replicated", "sharded"],
+                   help="mesh-wide serving (docs/sharded-serving.md): "
+                        "replicated = full model copy per device, "
+                        "micro-batches fan out per-device (~Nx qps); "
+                        "sharded = factor tables row-sharded over the "
+                        "(batch, model) mesh (models > one HBM); "
+                        "auto = sharded when the model exceeds the "
+                        "per-device HBM headroom, else replicated")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")

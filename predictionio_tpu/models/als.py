@@ -38,10 +38,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ragged import BucketedHistories, PaddedHistories, SplitHistories
 from ..ops.solve import gramian, solve_spd_batch
+from ..parallel.mesh import rows_spec
 from ..utils.platform import enable_compilation_cache
 
-#: PartitionSpec sharding rows over every mesh axis (ALS flattens the
-#: (data, model) mesh — factor rows spread across all devices).
+#: PartitionSpec sharding rows over every axis of the DEFAULT
+#: ``(data, model)`` training mesh. Mesh-parameterized code paths use
+#: :func:`~predictionio_tpu.parallel.mesh.rows_spec` instead, so the
+#: same layout lands on a ``(batch, model)`` serving mesh unchanged.
 ROWS = P(("data", "model"))
 
 
@@ -132,6 +135,11 @@ class ALSModel:
                                        metadata=dict(static=True))
     params: ALSParams = field(default_factory=ALSParams,
                               metadata=dict(static=True))
+    #: serving mesh when the factor tables are row-sharded
+    #: (:func:`shard_model`); None for host/single-device models. Set
+    #: at DEPLOY time only — persisted models never carry a mesh (a
+    #: Mesh binds to live devices and must not enter the blob store).
+    mesh: Optional[Mesh] = field(default=None, metadata=dict(static=True))
 
 
 @dataclass(frozen=True)
@@ -279,8 +287,9 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
     d, n_vper, L = sh["idx"].shape
     n_pad = sh["real_cnt"].shape[0]
     r = fixed.shape[-1]
-    A_acc = _zeros_sharded((n_pad, r, r), sh["mesh"], ROWS)
-    b_acc = _zeros_sharded((n_pad, r), sh["mesh"], ROWS)
+    A_acc = _zeros_sharded((n_pad, r, r), sh["mesh"],
+                           rows_spec(sh["mesh"]))
+    b_acc = _zeros_sharded((n_pad, r), sh["mesh"], rows_spec(sh["mesh"]))
     for s in range(0, n_vper, block_rows):
         e = min(s + block_rows, n_vper)
         A_acc, b_acc = _partials_block(
@@ -364,7 +373,8 @@ def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
     true row totals — rows are never split). Contraction depth per
     bucket = its L, so every einsum feeds the MXU a deep K."""
     r = fixed.shape[-1]
-    out0 = _zeros_sharded((bk["n_rows_padded"], r), bk["mesh"], ROWS)
+    out0 = _zeros_sharded((bk["n_rows_padded"], r), bk["mesh"],
+                          rows_spec(bk["mesh"]))
     return _bucket_half_step(
         fixed, out0, tuple(bk["buckets"]), params.reg, params.alpha,
         implicit=params.implicit_prefs,
@@ -501,12 +511,12 @@ def _init_factors_sharded(key: jax.Array, n: int, n_padded: int,
     so the sharding must come out of the compiled program itself."""
     if mesh is None:
         return _init_factors(key, n=n, n_padded=n_padded, rank=rank)
-    ck = tuple(mesh.devices.flat)  # jit's static-arg cache handles shapes
-    fn = _init_sharded_cache.get(ck)
+    ck = (tuple(mesh.devices.flat), mesh.axis_names)  # jit's static-arg
+    fn = _init_sharded_cache.get(ck)                  # cache handles shapes
     if fn is None:
         fn = jax.jit(_init_factors.__wrapped__,
                      static_argnames=("n", "n_padded", "rank"),
-                     out_shardings=NamedSharding(mesh, ROWS))
+                     out_shardings=NamedSharding(mesh, rows_spec(mesh)))
         _init_sharded_cache[ck] = fn
     return fn(key, n=n, n_padded=n_padded, rank=rank)
 
@@ -527,7 +537,7 @@ def _blocked(h: PaddedHistories, n_dev: int, mesh: Optional[Mesh]) -> dict:
     blocked layout and shard the leading axis over all mesh devices, so
     every row block spans every device."""
     n_per = h.n_rows // n_dev
-    spec = P(("data", "model"))
+    spec = rows_spec(mesh)
     return {
         "idx": _shard(h.indices.reshape(n_dev, n_per, h.max_len), mesh, spec),
         "val": _shard(h.values.reshape(n_dev, n_per, h.max_len), mesh, spec),
@@ -540,7 +550,7 @@ def _blocked_split(sh: SplitHistories, n_dev: int,
     """Split-mode device layout: virtual-row arrays blocked like
     :func:`_blocked`; real-row accumulator metadata stays flat+sharded."""
     n_vper = sh.n_virtual // n_dev
-    spec = P(("data", "model"))
+    spec = rows_spec(mesh)
     return {
         "mode": "split",
         "mesh": mesh,
@@ -550,7 +560,7 @@ def _blocked_split(sh: SplitHistories, n_dev: int,
                       mesh, spec),
         "cnt": _shard(sh.counts.reshape(n_dev, n_vper), mesh, spec),
         "rid": _shard(sh.row_ids.reshape(n_dev, n_vper), mesh, spec),
-        "real_cnt": _shard(sh.real_counts, mesh, ROWS),
+        "real_cnt": _shard(sh.real_counts, mesh, spec),
     }
 
 
@@ -562,7 +572,8 @@ def _blocked_bucket(bh: BucketedHistories, n_dev: int,
     einsum contracts over L, which GSPMD turns into per-device partial
     Gramians + an all-reduce, so even a single 10M-entry row spreads
     across the mesh."""
-    spec_rows = P(("data", "model"))
+    spec_rows = rows_spec(mesh)
+    all_axes = None if mesh is None else tuple(mesh.axis_names)
     buckets = []
     for b in bh.buckets:
         n_bk, L = b.indices.shape
@@ -573,16 +584,17 @@ def _blocked_bucket(bh: BucketedHistories, n_dev: int,
         if n_real >= n_dev or L % n_dev != 0:
             shape = (n_dev, n_bk // n_dev, L)
             spec = spec_rows
-            cnt_spec = P(("data", "model"))
+            cnt_spec = spec_rows
         else:  # row-axis thinner than the mesh: shard the history axis
             shape = (1, n_bk, L)
-            spec = P(None, None, ("data", "model"))
+            spec = P(None, None, all_axes)
             cnt_spec = P(None, None)
         buckets.append({
             "idx": _shard(b.indices.reshape(shape), mesh, spec),
             "val": _shard(b.values.reshape(shape), mesh, spec),
             "cnt": _shard(b.counts.reshape(shape[:2]), mesh, cnt_spec),
-            "rid": _shard(b.row_ids, mesh, ROWS if b.row_ids.shape[0]
+            "rid": _shard(b.row_ids, mesh,
+                          spec_rows if b.row_ids.shape[0]
                           % n_dev == 0 else P(None)),
         })
     return {
@@ -893,7 +905,7 @@ def pack_ratings_multihost(ratings, params: ALSParams,
                                n_rows=stop - start, max_len=L,
                                pad_rows_to=1)
         d_loc = len(mine)
-        sharding = NamedSharding(mesh, ROWS)
+        sharding = NamedSharding(mesh, rows_spec(mesh))
 
         def glob(arr, tail_shape):
             return jax.make_array_from_process_local_data(
@@ -1013,8 +1025,8 @@ def _pack_side_bucket_multihost(read_row_mask, counts: np.ndarray,
     flat_idx = np.asarray(flat_idx)
     flat_val = np.asarray(flat_val)
 
-    sharding_rows = NamedSharding(mesh, ROWS)
-    sharding_cnt = NamedSharding(mesh, P(("data", "model")))
+    sharding_rows = NamedSharding(mesh, rows_spec(mesh))
+    sharding_cnt = NamedSharding(mesh, rows_spec(mesh))
     buckets = []
     layout_buckets = []
     for L, rows_local, n_loc, off, rid_local in spans:
@@ -1178,8 +1190,8 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
         if steps:
             latest = max(steps)
             state = ckpt.restore(latest, like={"U": U, "V": V})
-            U = _shard(state["U"], mesh, ROWS)
-            V = _shard(state["V"], mesh, ROWS)
+            U = _shard(state["U"], mesh, rows_spec(mesh))
+            V = _shard(state["V"], mesh, rows_spec(mesh))
             start = int(latest)
 
     def _kind(h) -> str:
@@ -1194,7 +1206,8 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
             and start < params.num_iterations:
         # checkpoint-free runs compile the WHOLE training loop into one
         # dispatch, whatever mix of pad/bucket layouts auto resolved to
-        shard = None if mesh is None else NamedSharding(mesh, ROWS)
+        shard = None if mesh is None \
+            else NamedSharding(mesh, rows_spec(mesh))
 
         def _fused_args(kind, h, lay):
             if kind == "bucket":
@@ -1309,6 +1322,54 @@ def _serve_topk(user_factors: jax.Array, item_factors: jax.Array,
     return _topk_scores(vecs, item_factors, k=k, n_items=n_items)
 
 
+#: serializes SHARDED serving dispatches process-wide. The mesh program
+#: runs a collective (candidate all-gather) across every device: two
+#: host threads enqueueing it concurrently can interleave their
+#: per-device launches in different orders, and the collective
+#: rendezvous deadlocks (observed as stuck AllGather participants on
+#: the 8-device CPU mesh; the same launch-order hazard exists on real
+#: meshes). The mesh is ONE resource — throughput comes from the
+#: micro-batcher coalescing, not from concurrent mesh programs.
+_mesh_dispatch_lock = threading.Lock()
+
+
+def _is_row_sharded(arr) -> bool:
+    """True when ``arr`` is a jax array whose rows are spread across
+    more than one device (a :func:`shard_model` table) — its gathers
+    must be GSPMD-resolved, never a host ``np.asarray``."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except Exception:  # noqa: BLE001 — exotic shardings: assume local
+        return False
+
+
+@functools.lru_cache(maxsize=16)
+def _gather_rows_fn(mesh: Mesh):
+    """Compile-once row gather from a row-sharded factor table to a
+    REPLICATED [B, r] block: the GSPMD-inserted collective that
+    resolves a cross-shard user-row fetch (the ALX serving gather).
+    Output replicated so the per-shard ranking can consume it."""
+    return jax.jit(lambda table, idx: table[idx],
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+def _user_vecs(user_factors, user_indices: np.ndarray, mesh: Mesh):
+    """[B, r] query vectors for the sharded ranker, replicated over the
+    mesh. Row-sharded tables gather via GSPMD collectives (the table
+    never exists on one device); host/np tables gather locally. Host
+    inputs stay UNCOMMITTED numpy so the mesh program places them
+    itself — a ``jnp.asarray`` here would commit to device 0 and every
+    dispatch would pay (and the transfer guard would flag) a
+    device-to-device hop."""
+    idx = np.asarray(user_indices, dtype=np.int64)
+    if _is_row_sharded(user_factors):
+        return _gather_rows_fn(mesh)(user_factors, idx)
+    return np.asarray(user_factors)[idx]
+
+
 def recommend_batch_sharded(user_factors, item_factors,
                             user_indices: np.ndarray, k: int,
                             mesh: Mesh, n_items: int
@@ -1316,10 +1377,13 @@ def recommend_batch_sharded(user_factors, item_factors,
     """Serving top-k over a device mesh — the multi-chip form of the
     reference's serving moment (``CreateServer.scala:508-510``): item
     factors ROW-SHARDED over every mesh device (a pod-scale catalog
-    never lives on one chip), the query batch replicated. Each device
-    ranks its item shard locally ([B, n_local] matmul + local top_k),
-    then the per-shard candidates are all-gathered and reduced to the
-    global top-k — O(k·n_dev) gathered instead of O(n_items).
+    never lives on one chip), the query vectors replicated. User rows
+    are first resolved — by a GSPMD-inserted collective gather when the
+    user table is itself row-sharded (the >1-HBM regime), by a host
+    gather otherwise. Each device then ranks its item shard locally
+    ([B, n_local] matmul + local top_k) and the per-shard candidates
+    are all-gathered and reduced to the global top-k — O(k·n_dev)
+    cross-device traffic instead of O(n_items).
 
     Exact vs the single-device path for distinct scores (ties resolve
     by shard order rather than global index; float scores make exact
@@ -1329,14 +1393,22 @@ def recommend_batch_sharded(user_factors, item_factors,
     n_pad = item_factors.shape[0]
     if n_pad % n_dev:
         raise ValueError(f"item rows {n_pad} not divisible by mesh size "
-                         f"{n_dev}; pad factors to a device multiple")
+                         f"{n_dev}; pad factors to a device multiple "
+                         f"(shard_model does)")
     k_local = min(k, n_pad // n_dev)
     ranked = _sharded_rank_fn(mesh, k, k_local, n_items)
-    idx = jnp.asarray(np.asarray(user_indices, dtype=np.int64))
-    ids, scores = ranked(jnp.asarray(user_factors),
-                         jnp.asarray(item_factors), idx)
-    kk = min(k, n_items)
-    ids, scores = jax.device_get((ids, scores))
+    with _mesh_dispatch_lock:
+        vecs = _user_vecs(user_factors, user_indices, mesh)
+        # item_factors passes through UNPLACED when it is host data:
+        # the mesh program shards it per in_specs; an eager jnp.asarray
+        # would commit the whole table to device 0 first.
+        # ptpu: allow[callback-under-lock] — `ranked` is a compiled XLA
+        # executable (jit of shard_map), not user code: it cannot
+        # re-enter this lock, and serializing the launch is the lock's
+        # entire purpose (concurrent mesh-collective launches deadlock)
+        ids, scores = ranked(vecs, item_factors)
+        kk = min(k, n_items)
+        ids, scores = jax.device_get((ids, scores))
     return ids[:, :kk], scores[:, :kk]
 
 
@@ -1345,13 +1417,16 @@ def _sharded_rank_fn(mesh: Mesh, k: int, k_local: int, n_items: int):
     """Compile-once cache for the sharded serving program (a fresh
     closure per call would defeat the jit cache and recompile the mesh
     program on every serving batch). Keyed on (mesh, k, k_local,
-    n_items); shapes key the inner jit cache as usual."""
-    from jax.experimental.shard_map import shard_map
+    n_items); shapes key the inner jit cache as usual. Axis names come
+    from the mesh, so the same program serves a ``(data, model)``
+    training mesh and the ``(batch, model)`` serving mesh."""
+    from ..parallel.collectives import shard_map_compat
 
-    def local_rank(uf, itf_local, idx):
-        vecs = uf[idx]                       # [B, r] (replicated)
+    axes = tuple(mesh.axis_names)
+
+    def local_rank(vecs, itf_local):
         scores = vecs @ itf_local.T          # [B, n_local]
-        shard = jax.lax.axis_index(("data", "model"))
+        shard = jax.lax.axis_index(axes)
         base = shard * itf_local.shape[0]
         local_ids = base + jnp.arange(itf_local.shape[0])
         scores = jnp.where((local_ids < n_items)[None, :], scores,
@@ -1359,18 +1434,17 @@ def _sharded_rank_fn(mesh: Mesh, k: int, k_local: int, n_items: int):
         s, i = jax.lax.top_k(scores, k_local)
         gid = jnp.take(local_ids, i)
         # gather the candidate sets along the candidate axis
-        s_all = jax.lax.all_gather(s, ("data", "model"), axis=1,
+        s_all = jax.lax.all_gather(s, axes, axis=1,
                                    tiled=True)  # [B, k_local*n_dev]
-        g_all = jax.lax.all_gather(gid, ("data", "model"), axis=1,
-                                   tiled=True)
+        g_all = jax.lax.all_gather(gid, axes, axis=1, tiled=True)
         s2, pos = jax.lax.top_k(s_all, s_all.shape[1])
         return jnp.take_along_axis(g_all, pos, axis=1)[:, :k], \
             s2[:, :k]
 
-    return jax.jit(shard_map(
-        local_rank, mesh=mesh,
-        in_specs=(P(), ROWS, P()),
-        out_specs=(P(), P()), check_rep=False))
+    return jax.jit(shard_map_compat(
+        local_rank, mesh,
+        in_specs=(P(), rows_spec(mesh)),
+        out_specs=(P(), P()), check=False))
 
 
 def _compiled_k(k: int, n_items: int) -> int:
@@ -1442,6 +1516,57 @@ def ensure_device_resident(model: ALSModel,
     return model
 
 
+# -- mesh-wide serving placement (ISSUE 6) ----------------------------------
+
+def _pad_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad the row axis to a device multiple (even shards)."""
+    n = arr.shape[0]
+    n_pad = -(-n // multiple) * multiple
+    if n_pad == n:
+        return arr
+    out = np.zeros((n_pad,) + arr.shape[1:], dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+def shard_model(model: ALSModel, mesh: Mesh) -> ALSModel:
+    """SHARDED serving placement: both factor tables row-sharded over
+    every device of the ``(batch, model)`` serving mesh via
+    ``NamedSharding`` (ALX's row-sharded factor layout) — the table a
+    single HBM cannot hold exists only as per-device shards. Rows are
+    zero-padded to a device multiple; ``n_users``/``n_items`` keep the
+    real counts so padding can never be served."""
+    import dataclasses
+
+    n_dev = mesh.devices.size
+    spec = NamedSharding(mesh, rows_spec(mesh))
+    U = np.asarray(model.user_factors) \
+        if isinstance(model.user_factors, np.ndarray) \
+        else jax.device_get(model.user_factors)
+    V = np.asarray(model.item_factors) \
+        if isinstance(model.item_factors, np.ndarray) \
+        else jax.device_get(model.item_factors)
+    return dataclasses.replace(
+        model,
+        user_factors=jax.device_put(_pad_rows(np.asarray(U), n_dev), spec),
+        item_factors=jax.device_put(_pad_rows(np.asarray(V), n_dev), spec),
+        mesh=mesh)
+
+
+def replicate_model(model: ALSModel, device) -> ALSModel:
+    """REPLICATED serving placement: one full copy of the factor tables
+    committed to ``device`` — each replicated-mode lane owns a copy, so
+    its dispatches compile and run on its own chip with no cross-device
+    sync on the serve path."""
+    import dataclasses
+
+    return dataclasses.replace(
+        model,
+        user_factors=jax.device_put(model.user_factors, device),
+        item_factors=jax.device_put(model.item_factors, device),
+        mesh=None)
+
+
 def pin_user_rows(model: ALSModel, user_indices: Sequence[int],
                   capacity: int) -> Tuple[Optional[jax.Array], int]:
     """Hot-entity tier (ISSUE 4): gather the given users' factor rows
@@ -1451,29 +1576,98 @@ def pin_user_rows(model: ALSModel, user_indices: Sequence[int],
     compiled shape instead of paying a post-warm trace per refresh.
 
     Returns ``(pinned_table, nbytes)``; ``(None, 0)`` for host-served
-    models (the host fast path has no gather/transfer to skip)."""
+    models (the host fast path has no gather/transfer to skip).
+
+    Sharded models (``model.mesh`` set) pin a mesh-REPLICATED table:
+    the hot rows are fetched once through the GSPMD collective gather
+    (the full table never lands on the host) and the [K, rank] result —
+    tiny next to the sharded tables — is replicated so every device
+    ranks hot users without a per-query cross-shard fetch."""
     if _serve_on_host(model, batch=1) or not len(user_indices):
         return None, 0
     cap = max(int(capacity), 1)
     idx = np.zeros(cap, dtype=np.int64)
     n = min(len(user_indices), cap)
     idx[:n] = np.asarray(list(user_indices)[:n], dtype=np.int64)
+    mesh = getattr(model, "mesh", None)
+    if mesh is not None:
+        with _mesh_dispatch_lock:
+            rows_dev = _gather_rows_fn(mesh)(model.user_factors, idx)
+            rows_dev.block_until_ready()
+        return rows_dev, int(rows_dev.nbytes)
     rows = np.asarray(model.user_factors)[idx]  # one host gather per
     pinned = jax.device_put(rows)               # refresh, not per query
     pinned.block_until_ready()
     return pinned, int(rows.nbytes)
 
 
-def recommend_pinned(model: ALSModel, pinned: jax.Array, slot: int,
+def pin_user_rows_lanes(model: ALSModel, user_indices: Sequence[int],
+                        capacity: int, devices: Sequence
+                        ) -> Tuple[Optional[tuple], int]:
+    """Replicated-mode hot tier: the SAME pinned ``[capacity, rank]``
+    table committed once per lane device, so whichever lane serves a
+    hot query gathers from its local copy (per-device pinned shards —
+    no cross-device traffic on the pinned fast path). Returns
+    ``(tables_per_device, total_nbytes)`` or ``(None, 0)``."""
+    if _serve_on_host(model, batch=1) or not len(user_indices) \
+            or not len(devices):
+        return None, 0
+    cap = max(int(capacity), 1)
+    idx = np.zeros(cap, dtype=np.int64)
+    n = min(len(user_indices), cap)
+    idx[:n] = np.asarray(list(user_indices)[:n], dtype=np.int64)
+    rows = np.asarray(model.user_factors)[idx]
+    tables = tuple(jax.device_put(rows, d) for d in devices)
+    for t in tables:
+        t.block_until_ready()
+    return tables, int(rows.nbytes) * len(tables)
+
+
+def recommend_pinned(model: ALSModel, pinned, slot: int,
                      k: int) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k for one PINNED hot user: the row gather runs against the
     small HBM-resident pinned table instead of the full ``[U, rank]``
     factor matrix (which, for a re-materialized host-resident model,
-    would cost a host gather + device transfer on every query)."""
+    would cost a host gather + device transfer on every query).
+
+    ``pinned`` may be a tuple of per-device tables (replicated lanes,
+    :func:`pin_user_rows_lanes`) — the copy committed to the SAME
+    device as ``model``'s factors is used, so a lane-rotated model
+    (``QueryServer._dispatch_predictions``) serves hot queries fully
+    lane-local. Sharded models rank the pinned vector through the mesh
+    program (each device scores its item shard)."""
+    if isinstance(pinned, tuple):
+        chosen = pinned[0]
+        try:
+            devs = model.item_factors.devices()
+            for t in pinned:
+                if t.devices() == devs:
+                    chosen = t
+                    break
+        except Exception:  # noqa: BLE001 — host-resident factors place
+            pass           # with any copy; jit decides
+        pinned = chosen
+    mesh = getattr(model, "mesh", None)
+    if mesh is not None:
+        k_dev = _compiled_k(k, model.n_items)
+        n_pad = model.item_factors.shape[0]
+        k_local = min(k_dev, n_pad // mesh.devices.size)
+        ranked = _sharded_rank_fn(mesh, k_dev, k_local, model.n_items)
+        with _mesh_dispatch_lock:
+            # ptpu: allow[callback-under-lock] — compiled XLA
+            # executables (jitted gather + mesh ranker); they cannot
+            # re-enter, and the lock exists to serialize their launch
+            vec = _gather_rows_fn(mesh)(
+                pinned, np.asarray([slot], dtype=np.int64))  # [1, r]
+            # ptpu: allow[callback-under-lock] — same compiled ranker
+            ids, scores = ranked(vec, model.item_factors)
+            k = min(k, model.n_items)
+            ids, scores = jax.device_get((ids, scores))
+        return ids[0][:k], scores[0][:k]
     k_dev = _compiled_k(k, model.n_items)
     scores, ids = _serve_topk(
         pinned, jnp.asarray(model.item_factors),
-        jnp.asarray(np.asarray([slot], dtype=np.int64)),
+        np.asarray([slot], dtype=np.int64),
         k=k_dev, n_items=model.n_items)
     k = min(k, model.n_items)
     ids, scores = jax.device_get((ids, scores))
@@ -1486,15 +1680,21 @@ def recommend_products(model: ALSModel, user_index: int, k: int
     ``ALSModel.recommendProducts`` role (``ALSAlgorithm.scala:95-109``).
     Like the reference, asking for more than the catalog returns the whole
     catalog ranked, never padded rows."""
+    if getattr(model, "mesh", None) is not None:
+        ids, scores = recommend_batch(
+            model, np.asarray([user_index], dtype=np.int64), k)
+        return ids[0], scores[0]
     if _serve_on_host(model, batch=1):
         ids, scores = _host_topk(
             np.asarray(model.user_factors)[user_index][None, :],
             model.item_factors, k, model.n_items)
         return ids[0], scores[0]
     k_dev = _compiled_k(k, model.n_items)
+    # the index stays uncommitted numpy: jit places it beside the
+    # (possibly lane-committed) factors with no device-to-device hop
     scores, ids = _serve_topk(
         jnp.asarray(model.user_factors), jnp.asarray(model.item_factors),
-        jnp.asarray(np.asarray([user_index], dtype=np.int64)),
+        np.asarray([user_index], dtype=np.int64),
         k=k_dev, n_items=model.n_items)
     k = min(k, model.n_items)
     ids, scores = jax.device_get((ids, scores))
@@ -1510,7 +1710,34 @@ _TOPK_CHUNK = 2048
 def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Micro-batched top-k for many users (one device dispatch, or the
-    host path for small models + small batches)."""
+    host path for small models + small batches). Sharded models
+    (``model.mesh``) rank over the mesh: cross-shard user gather +
+    per-device item-shard top-k + candidate merge, with the batch axis
+    padded to the same pow2 ladder as the single-device path so the
+    micro-batcher's arbitrary batch sizes reuse O(log) compilations."""
+    if getattr(model, "mesh", None) is not None:
+        B = len(user_indices)
+        k = min(k, model.n_items)
+        if B == 0:
+            return (np.empty((0, k), np.int64),
+                    np.empty((0, k), np.float32))
+        if B > _TOPK_CHUNK:
+            parts = [recommend_batch(model,
+                                     user_indices[s:s + _TOPK_CHUNK], k)
+                     for s in range(0, B, _TOPK_CHUNK)]
+            return (np.concatenate([p[0] for p in parts], axis=0),
+                    np.concatenate([p[1] for p in parts], axis=0))
+        Bp = 1
+        while Bp < B:
+            Bp *= 2
+        idx_dev = np.empty(Bp, dtype=np.int64)
+        idx_dev[:B] = user_indices
+        idx_dev[B:] = user_indices[0]
+        k_dev = _compiled_k(k, model.n_items)
+        ids, scores = recommend_batch_sharded(
+            model.user_factors, model.item_factors, idx_dev, k_dev,
+            model.mesh, model.n_items)
+        return ids[:B, :k], scores[:B, :k]
     if _serve_on_host(model, batch=len(user_indices)):
         return _host_topk(
             np.asarray(model.user_factors)[np.asarray(user_indices)],
@@ -1544,7 +1771,7 @@ def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
     idx_dev[B:] = user_indices[0] if B else 0  # pad rows: any valid row
     scores, ids = _serve_topk(
         jnp.asarray(model.user_factors), jnp.asarray(model.item_factors),
-        jnp.asarray(idx_dev), k=k_dev, n_items=model.n_items)
+        idx_dev, k=k_dev, n_items=model.n_items)
     ids, scores = jax.device_get((ids, scores))
     return (ids[:B, :k], scores[:B, :k])
 

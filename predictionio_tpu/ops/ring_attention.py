@@ -47,9 +47,14 @@ def _ring_attention_local(q, k, v, kmask, *, axis_name: str,
     if hasattr(jax.lax, "pcast"):
         def _vary(x):
             return jax.lax.pcast(x, (axis_name,), to="varying")
-    else:  # pragma: no cover — pre-pcast jax
+    elif hasattr(jax.lax, "pvary"):
         def _vary(x):
             return jax.lax.pvary(x, (axis_name,))
+    else:
+        def _vary(x):
+            # pre-varying-type jax (check_rep-era shard_map): there is
+            # no per-axis replication typing to satisfy — identity
+            return x
     m0 = _vary(jnp.full((B, H, S_loc), -jnp.inf, jnp.float32))
     l0 = _vary(jnp.zeros((B, H, S_loc), jnp.float32))
     acc0 = _vary(jnp.zeros((B, S_loc, H, D), jnp.float32))
@@ -154,12 +159,14 @@ def _compiled(mesh, axis: str, causal: bool, scale: float):
                     key_valid=key_valid)
             fn = jax.jit(nodist)
         else:
+            from ..parallel.collectives import shard_map_compat
+
             spec = P(None, axis, None, None)
             km_spec = P(None, axis)
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map_compat(
                 functools.partial(_ring_attention_local, axis_name=axis,
                                   causal=causal, scale=scale),
-                mesh=mesh, in_specs=(spec, spec, spec, km_spec),
+                mesh, in_specs=(spec, spec, spec, km_spec),
                 out_specs=spec))
         _fn_cache[key] = fn
     return fn

@@ -278,7 +278,14 @@ def solve_spd_batch(A: jax.Array, b: jax.Array,
         return _xla(A, b)
     if mode == "pallas":
         return _pallas(A, b)
-    # "auto": pick per LOWERING platform (Mosaic lowers on TPU only)
+    # "auto": pick per LOWERING platform (Mosaic lowers on TPU only).
+    # A cpu-default process can never lower the Pallas branch anywhere,
+    # and this jax's platform_dependent still tries to when the call
+    # sits inside a fori_loop (the fused trainer) — short-circuit. The
+    # TPU-plugin-default host running a virtual CPU mesh (the dryrun
+    # topology the lowering-time gate exists for) keeps the deferral.
+    if jax.default_backend() == "cpu":
+        return _xla(A, b)
     return jax.lax.platform_dependent(A, b, tpu=_pallas, default=_xla)
 
 

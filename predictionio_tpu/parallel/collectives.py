@@ -23,6 +23,21 @@ from .mesh import MODEL_AXIS
 Axis = Union[str, Sequence[str]]
 
 
+def shard_map_compat(fn: Callable, mesh: Mesh, in_specs, out_specs,
+                     check: bool = False) -> Callable:
+    """``shard_map`` across jax versions: new jaxes expose
+    ``jax.shard_map(..., check_vma=)``, older ones only
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` — the
+    replication-check knob was renamed along the way."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check)
+
+
 def all_reduce_sum(x: jax.Array, axis: Axis = MODEL_AXIS) -> jax.Array:
     """``lax.psum`` — the Gramian/gradient all-reduce (NCCL allreduce
     role)."""
@@ -45,7 +60,10 @@ def ring_permute(x: jax.Array, axis: Axis = MODEL_AXIS,
     """Send each shard to its ring neighbor (``lax.ppermute``) — the
     building block for ring-structured algorithms (ring all-reduce,
     ring attention) on ICI."""
-    n = lax.axis_size(axis)
+    # psum of a python 1 folds to the static axis size on every jax
+    # this repo supports (lax.axis_size only exists on newer ones)
+    n = lax.psum(1, axis) if not hasattr(lax, "axis_size") \
+        else lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -64,8 +82,8 @@ def sharded(mesh: Mesh, in_specs, out_specs,
     """
 
     def deco(fn):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_vma)
+        return shard_map_compat(fn, mesh, in_specs, out_specs,
+                                check=check_vma)
 
     return deco
 
@@ -91,8 +109,8 @@ def sharded_top_k(scores: jax.Array, k: int, mesh: Mesh,
         mvals, mpos = lax.top_k(all_vals, k)
         return mpos, mvals, all_idx
 
-    fn = jax.shard_map(local_then_merge, mesh=mesh,
-                       in_specs=P(axis), out_specs=(P(), P(), P()),
-                       check_vma=False)
+    fn = shard_map_compat(local_then_merge, mesh,
+                          in_specs=P(axis), out_specs=(P(), P(), P()),
+                          check=False)
     mpos, mvals, all_idx = fn(scores)
     return jnp.take(all_idx, mpos), mvals

@@ -7,15 +7,24 @@ and let Spark place RDD partitions, this framework lays out a
 `NamedSharding`s; XLA inserts the collectives (psum/all_gather/…) that ride
 ICI within a slice and DCN across slices.
 
-Axis convention used throughout the framework:
-- ``data``  — batch/data parallelism (event shards, query micro-batches)
+Axis conventions used throughout the framework:
+- ``data``  — batch/data parallelism (event shards) on the TRAINING mesh
 - ``model`` — model parallelism (factor-matrix rows, embedding shards)
+- ``batch`` — query-batch parallelism on the SERVING mesh (the
+  ``(batch, model)`` GSPMD layout of SNIPPETS [3] / ALX): row-sharded
+  factor tables spread over every axis, query micro-batches fan out
+  along ``batch``
+
+ALS row-shards factor tables over EVERY axis of whichever mesh it is
+handed (:func:`rows_spec`), so the same training/serving code runs over
+a ``(data, model)`` training mesh and a ``(batch, model)`` serving mesh
+unchanged.
 """
 
 from __future__ import annotations
 
 from contextlib import contextmanager
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -23,29 +32,73 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+BATCH_AXIS = "batch"
+
+#: serving-mode names (ServerConfig.serving_mode / `ptpu deploy
+#: --serving-mode`): "single" is today's one-device path, "replicated"
+#: holds a full model copy per device and fans micro-batches out across
+#: per-device lanes, "sharded" row-shards the factor tables over the
+#: whole mesh (tables bigger than one HBM), "auto" picks by HBM sizing.
+SERVING_MODES = ("auto", "single", "replicated", "sharded")
+
+#: fraction of one device's HBM a model may occupy before "auto"
+#: switches from replicated to sharded — factors are not the only
+#: resident bytes (serving temps, pinned hot tier, XLA scratch), so a
+#: full-copy-per-device plan needs real headroom
+AUTO_SHARD_HBM_FRACTION = 0.6
+
+
+def _build_mesh(shape: Tuple[int, int], names: Tuple[str, str],
+                devices: Optional[Sequence[jax.Device]]) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    d0, d1 = shape
+    if d0 is None:
+        if n % d1 != 0:
+            raise ValueError(f"{n} devices not divisible by "
+                             f"{names[1]}={d1}")
+        d0 = n // d1
+    if d0 * d1 > n:
+        raise ValueError(f"mesh {d0}x{d1} needs {d0 * d1} devices, "
+                         f"have {n}")
+    dev = np.asarray(devices[: d0 * d1]).reshape(d0, d1)
+    return Mesh(dev, names)
 
 
 def make_mesh(data: Optional[int] = None, model: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a 2D ``(data, model)`` mesh over the devices.
+    """Build a 2D ``(data, model)`` TRAINING mesh over the devices.
 
     With no arguments, uses all devices on the data axis — the mesh-of-1
     case collapses to single-device jit, which is how the reference's
     L(local) controller variants map onto this framework (one API,
     mesh size 1..N).
     """
-    if devices is None:
-        devices = jax.devices()
-    n = len(devices)
-    if data is None:
-        if n % model != 0:
-            raise ValueError(f"{n} devices not divisible by model={model}")
-        data = n // model
-    if data * model > n:
-        raise ValueError(f"mesh {data}x{model} needs {data * model} devices, "
-                         f"have {n}")
-    dev = np.asarray(devices[: data * model]).reshape(data, model)
-    return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
+    return _build_mesh((data, model), (DATA_AXIS, MODEL_AXIS), devices)
+
+
+def make_serving_mesh(batch: Optional[int] = None, model: int = 1,
+                      devices: Optional[Sequence[jax.Device]] = None
+                      ) -> Mesh:
+    """Build the 2D ``(batch, model)`` SERVING mesh (SNIPPETS [3]).
+
+    Default: every device on the batch axis. The row-sharded factor
+    layout (:func:`rows_spec`) spreads rows over BOTH axes, so the
+    split between them only matters to code that addresses one axis
+    explicitly (e.g. batch fan-out with model-parallel ranking).
+    """
+    return _build_mesh((batch, model), (BATCH_AXIS, MODEL_AXIS), devices)
+
+
+def rows_spec(mesh: Optional[Mesh]) -> P:
+    """PartitionSpec sharding the leading (row) axis over EVERY axis of
+    ``mesh`` — the ALX factor-table layout, mesh-shape agnostic: the
+    same spec row-shards over a ``(data, model)`` training mesh and a
+    ``(batch, model)`` serving mesh."""
+    if mesh is None:
+        return P()
+    return P(tuple(mesh.axis_names))
 
 
 def single_device_mesh() -> Mesh:
@@ -69,6 +122,52 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def pad_to_multiple(n: int, k: int) -> int:
     """Smallest multiple of ``k`` that is >= ``n`` (shard-even padding)."""
     return ((n + k - 1) // k) * k
+
+
+def device_hbm_bytes(device: Optional[jax.Device] = None) -> Optional[int]:
+    """One device's HBM capacity in bytes via ``memory_stats()``; None
+    when the backend doesn't report it (CPU PJRT) — callers must treat
+    None as "sizing unknown", not "infinite"."""
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+        if not stats:
+            return None
+        limit = int(stats.get("bytes_limit", 0)
+                    or stats.get("bytes_reservable_limit", 0))
+        return limit or None
+    except Exception:  # noqa: BLE001 — sizing is advisory
+        return None
+
+
+def resolve_serving_mode(mode: str, model_bytes: Optional[int],
+                         n_devices: int,
+                         hbm_limit: Optional[int] = None,
+                         headroom: float = AUTO_SHARD_HBM_FRACTION) -> str:
+    """Concrete serving mode for ``ServerConfig.serving_mode``.
+
+    The HBM sizing math behind ``auto`` (docs/sharded-serving.md):
+    a model whose resident factor bytes exceed ``headroom`` × one
+    device's HBM cannot hold a full copy per device alongside serving
+    temps → ``sharded``; otherwise N healthy devices each take a full
+    copy for ~N× micro-batch throughput → ``replicated``; one device
+    (or an unsized model on an unsized backend) stays ``single``/
+    ``replicated`` conservatively.
+    """
+    if mode not in SERVING_MODES:
+        raise ValueError(f"serving_mode must be one of {SERVING_MODES}, "
+                         f"got {mode!r}")
+    if mode != "auto":
+        return mode
+    if n_devices <= 1:
+        return "single"
+    if hbm_limit is None:
+        hbm_limit = device_hbm_bytes()
+    if model_bytes is not None and hbm_limit is not None \
+            and model_bytes > headroom * hbm_limit:
+        return "sharded"
+    return "replicated"
 
 
 @contextmanager

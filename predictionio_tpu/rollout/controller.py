@@ -212,6 +212,14 @@ class RolloutController:
                 "outcome": self.outcome,
                 "candidateInstanceId": self.instance_id,
                 "mode": "shadow" if self.shadow else "canary",
+                # mesh-wide serving (ISSUE 6): which placement the
+                # candidate bound under — a sharded stable binds its
+                # candidate row-sharded too, and promote re-places
+                # through the server's normal _bind (warm-swap, never
+                # an inherited half-placement)
+                "servingMode": getattr(self.server,
+                                       "serving_mode_resolved",
+                                       "single"),
                 "fraction": self.splitter.fraction,
                 "windowsEvaluated": self.windows,
                 "lastDecision": (self.last_decision.to_json()

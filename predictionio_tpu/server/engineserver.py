@@ -138,6 +138,16 @@ class ServerConfig:
     #: PTPU_DEBUG_LOCKS=1 env var enables it without a config change
     #: (the staging runbook path, docs/operations.md).
     debug_locks: bool = False
+    #: Mesh-wide serving (ISSUE 6, docs/sharded-serving.md):
+    #: "single" — today's one-device path; "replicated" — a full model
+    #: copy per device, the micro-batcher fans micro-batches out
+    #: round-robin across per-device lanes (~N× qps on N chips, no
+    #: cross-device sync on the serve path); "sharded" — factor tables
+    #: row-sharded over the (batch, model) mesh via NamedSharding
+    #: (models bigger than one HBM; GSPMD resolves the gathers);
+    #: "auto" — sharded when the model's resident bytes exceed the
+    #: per-device HBM headroom, else replicated on >1 device.
+    serving_mode: str = "single"
 
 
 @dataclass
@@ -219,6 +229,26 @@ class QueryServer:
             bounds=POW2_COUNT_BOUNDS)
         self._query_errors = self.metrics.counter(
             "pio_query_errors_total", "Failed queries by status class")
+        # mesh-wide serving series (ISSUE 6): per-device lane depth /
+        # latency / dispatch counts while replicated fan-out is active,
+        # plus the resolved mode as a render-time gauge
+        self._lane_latency = self.metrics.histogram(
+            "pio_lane_batch_seconds",
+            "Per-lane micro-batch wall time (replicated fan-out; lane "
+            "label = device ordinal)",
+            bounds=DEFAULT_LATENCY_BOUNDS)
+        self._lane_depth = self.metrics.histogram(
+            "pio_lane_queue_depth",
+            "Batcher queue depth observed at each lane's batch pickup",
+            bounds=POW2_COUNT_BOUNDS)
+        self._lane_dispatches = self.metrics.counter(
+            "pio_lane_dispatches_total",
+            "Micro-batches dispatched per serving lane")
+        self.metrics.gauge(
+            "pio_serving_lanes",
+            "Per-device serving lanes active (0 = single/sharded "
+            "binding)",
+            fn=lambda: float(len(self.lane_models)))
         # progressive delivery (ISSUE 3): per-release-arm series the
         # rollout health gate windows over, the release registry this
         # server's deploy/reload/promote/rollback actions are recorded
@@ -267,11 +297,18 @@ class QueryServer:
         if locks_instrumented():
             register_lock_metrics(self.metrics)
         # the micro-batcher lives on the server (not build_app) so the
-        # cached serve() path and direct embedders share one batcher
+        # cached serve() path and direct embedders share one batcher.
+        # Replicated mode implies it: the batcher's drainer threads ARE
+        # the per-device lanes (round-robin fan-out), so a replicated
+        # binding without --batching still gets its N lanes.
+        lanes = len(self.lane_models) or 1
         self.batcher = (MicroBatcher(self, self.config.batch_window_ms,
                                      self.config.max_batch,
-                                     pipeline=self.config.batch_pipeline)
-                        if self.config.batching else None)
+                                     pipeline=max(
+                                         self.config.batch_pipeline,
+                                         lanes),
+                                     lanes=lanes)
+                        if (self.config.batching or lanes > 1) else None)
         self._warm_gen = 0  # stale warm threads must not set the event
         if self.config.warm_start:
             threading.Thread(target=self._warm_serving, args=(0,),
@@ -288,20 +325,26 @@ class QueryServer:
         cache is slow, not broken. ``gen`` guards against a stale
         deploy-time thread flipping ``warm_done`` while a post-reload
         re-warm (newer generation) is still compiling new shapes."""
-        max_b = self.config.max_batch if self.config.batching else 1
         with self._lock:
             # snapshot: a concurrent reload/promote must not swap the
             # lists out from under the zip mid-warm
             algorithms, models = self.algorithms, self.models
-        for algo, model in zip(algorithms, models):
-            warm = getattr(algo, "warm_serving", None)
-            if warm is None:
-                continue
-            try:
-                warm(model, max_b)
-            except Exception as e:  # noqa: BLE001 — warm the rest
-                log.warning("serving warmup failed for %s: %s",
-                            type(algo).__name__, e)
+            lane_models = list(self.lane_models)
+        max_b = self.config.max_batch \
+            if (self.config.batching or lane_models) else 1
+        # every lane warms its own copy: executables compile PER DEVICE,
+        # so warming lane 0 alone leaves lanes 1..N-1 paying cold
+        # compiles on first fan-out
+        for models_i in (lane_models or [models]):
+            for algo, model in zip(algorithms, models_i):
+                warm = getattr(algo, "warm_serving", None)
+                if warm is None:
+                    continue
+                try:
+                    warm(model, max_b)
+                except Exception as e:  # noqa: BLE001 — warm the rest
+                    log.warning("serving warmup failed for %s: %s",
+                                type(algo).__name__, e)
         # check+set under the lock: unsynchronized, a stale thread could
         # pass the gen check, lose the CPU to reload()'s clear+increment,
         # then set() — reporting warm while the re-warm still compiles
@@ -331,6 +374,89 @@ class QueryServer:
             self.models = [a.prepare_serving_model(m, bind_batch)
                            for a, m in zip(self.algorithms, models)]
             self.serving = self.engine.make_serving(engine_params)
+            # mesh-wide placement (ISSUE 6): resolve the serving mode
+            # against the live devices and the model's resident bytes,
+            # then either fan the binding out as per-device lane copies
+            # (replicated) or re-place it row-sharded over the serving
+            # mesh (sharded). Inside the same lock as the binding swap:
+            # a promote/reload swaps mode, mesh, lanes and models as
+            # one unit — queries never see a half-placed binding.
+            self._place_binding()
+
+    @staticmethod
+    def _models_nbytes(models: List[Any]) -> Optional[int]:
+        """Resident bytes of the bound models' array leaves — the
+        numerator of the auto-mode HBM sizing math. None when nothing
+        reports nbytes (sizing unknown ≠ sizing zero)."""
+        try:
+            import jax
+
+            total = 0
+            seen = False
+            for m in models:
+                for leaf in jax.tree_util.tree_leaves(m):
+                    nb = getattr(leaf, "nbytes", None)
+                    if nb is not None:
+                        total += int(nb)
+                        seen = True
+            return total if seen else None
+        except Exception:  # noqa: BLE001 — sizing is advisory
+            return None
+
+    # ptpu: guarded-by[_lock] — only ever called from _bind, which
+    # holds the (reentrant) binding lock around the whole placement
+    def _place_binding(self) -> None:
+        """Resolve ``ServerConfig.serving_mode`` and place the stable
+        binding accordingly. Called under ``self._lock`` from
+        :meth:`_bind`. Sets ``serving_mode_resolved``, ``serving_mesh``
+        (sharded), and ``lane_devices``/``lane_models`` (replicated:
+        one full model list per device, each committed to its own
+        chip)."""
+        self.serving_mesh = None
+        self.lane_devices: List[Any] = []
+        self.lane_models: List[List[Any]] = []
+        mode = self.config.serving_mode
+        if mode == "single":
+            self.serving_mode_resolved = "single"
+            return
+        import jax
+
+        from ..parallel.mesh import (
+            make_serving_mesh,
+            resolve_serving_mode,
+        )
+
+        devices = jax.devices()
+        resolved = resolve_serving_mode(
+            mode, self._models_nbytes(self.models), len(devices))
+        if resolved != "sharded" and len(devices) <= 1:
+            resolved = "single"
+        self.serving_mode_resolved = resolved
+        if resolved == "replicated":
+            self.lane_devices = list(devices)
+            for dev in devices:
+                lane = []
+                for a, m in zip(self.algorithms, self.models):
+                    rep = getattr(a, "replicate_serving_model", None)
+                    lane.append(rep(m, dev) if rep is not None else m)
+                self.lane_models.append(lane)
+        elif resolved == "sharded":
+            mesh = make_serving_mesh(devices=devices)
+            self.serving_mesh = mesh
+            self.models = self._shard_models(self.algorithms,
+                                             self.models, mesh)
+
+    @staticmethod
+    def _shard_models(algorithms: List[Any], models: List[Any],
+                      mesh) -> List[Any]:
+        """Row-shard every model whose algorithm supports it; models
+        without the hook keep their single-device placement (they
+        still serve — just not mesh-wide)."""
+        out = []
+        for a, m in zip(algorithms, models):
+            hook = getattr(a, "shard_serving_model", None)
+            out.append(hook(m, mesh) if hook is not None else m)
+        return out
 
     def _bind_feature_cache(self, algo: Any) -> None:
         """Hand the feature tier to algorithms that cache serving-time
@@ -359,14 +485,22 @@ class QueryServer:
 
     def _pin_hot(self, entity_keys: List[str]):
         """Hot-tier pin callback: delegate to the (single) algorithm's
-        ``pin_hot_entities`` against the CURRENT stable binding."""
+        ``pin_hot_entities`` against the CURRENT stable binding. Under
+        replicated fan-out the pin lands on EVERY lane device
+        (per-device pinned shards), so hot serves stay lane-local."""
         with self._lock:
             algorithms, models = self.algorithms, self.models
+            devices = list(self.lane_devices)
         if len(algorithms) != 1:
             return {}, 0  # multi-algo serving blends predictions;
         pin = getattr(algorithms[0], "pin_hot_entities", None)  # a
         if pin is None:                  # single-algo pin would skew
             return {}, 0
+        if devices:
+            try:
+                return pin(models[0], entity_keys, devices=devices)
+            except TypeError:
+                pass  # algorithm predates per-lane pinning
         return pin(models[0], entity_keys)
 
     def _transfer_guard(self):
@@ -470,6 +604,44 @@ class QueryServer:
                 "queries": int(queries), "errors": int(errors),
                 "latency": self._release_latency.labels(
                     arm=arm).snapshot()}
+        return out
+
+    def mesh_status(self) -> dict:
+        """Mesh-wide serving state for ``/status.json`` and the status
+        page (ISSUE 6): resolved mode, mesh shape, and — under
+        replicated fan-out — per-lane device / dispatch-count / batch
+        latency / queue-depth rows (the per-device occupancy view; the
+        per-device HBM gauges live in the sibling ``hbm`` block)."""
+        with self._lock:
+            mode = self.serving_mode_resolved
+            lane_devices = list(self.lane_devices)
+            mesh = self.serving_mesh
+        out: dict = {"mode": mode}
+        if mesh is not None:
+            out["meshShape"] = {str(ax): int(sz) for ax, sz
+                                in zip(mesh.axis_names,
+                                       mesh.devices.shape)}
+            out["devices"] = int(mesh.devices.size)
+        if lane_devices:
+            out["devices"] = len(lane_devices)
+            lanes = []
+            for i, dev in enumerate(lane_devices):
+                lat = self._lane_latency.labels(lane=str(i)).snapshot()
+                depth = self._lane_depth.labels(lane=str(i)).snapshot()
+                lanes.append({
+                    "lane": i,
+                    "device": str(dev),
+                    "deviceId": int(getattr(dev, "id", i)),
+                    "dispatches": int(self._lane_dispatches.labels(
+                        lane=str(i)).value),
+                    "batchP50Ms": (round(lat["p50"] * 1000, 3)
+                                   if lat.get("count") else None),
+                    "batchP99Ms": (round(lat["p99"] * 1000, 3)
+                                   if lat.get("count") else None),
+                    "queueDepthP50": (depth["p50"]
+                                      if depth.get("count") else None),
+                })
+            out["lanes"] = lanes
         return out
 
     def spans_summary(self) -> dict:
@@ -608,20 +780,32 @@ class QueryServer:
 
     # -- batched hot path ---------------------------------------------------
     def query_batch(self, query_jsons: List[Any],
-                    obs_list: Optional[List[dict]] = None) -> List[Any]:
+                    obs_list: Optional[List[dict]] = None,
+                    lane: Optional[int] = None) -> List[Any]:
         """Serve many queries with ONE ``batch_predict`` device dispatch
         per algorithm. Per-query errors come back as ``HTTPError``s in the
         result slots so one bad query never fails its batch-mates.
         ``obs_list`` (one dict per query, from the batcher) receives each
         query's access-log payload: the shared batch phase timings plus
-        its own readback/feedback time."""
+        its own readback/feedback time.
+
+        ``lane`` (replicated fan-out, ISSUE 6) selects that lane's
+        per-device model copies — the dispatch compiles and runs on the
+        lane's own chip, no cross-device sync. With no lanes bound the
+        argument is ignored (a stale drainer after a mode-changing
+        reload falls back to the stable binding, never a torn one)."""
         from ..workflow.batch_predict import predict_serve_batch
 
         t0 = time.monotonic()
         phases: dict = {}
         with self._lock:
-            algorithms, models, serving = \
-                self.algorithms, self.models, self.serving
+            algorithms, serving = self.algorithms, self.serving
+            if lane is not None and self.lane_models:
+                lane = lane % len(self.lane_models)
+                models = self.lane_models[lane]
+            else:
+                lane = None
+                models = self.models
             instance_id = self.instance.id
         query_cls = algorithms[0].query_class
         parsed: List[Any] = []
@@ -666,7 +850,12 @@ class QueryServer:
         dt = time.monotonic() - t0
         self._record_phases(phases)
         self._batch_occupancy.observe(len(query_jsons))
+        if lane is not None:
+            self._lane_latency.labels(lane=str(lane)).observe(dt)
+            self._lane_dispatches.labels(lane=str(lane)).inc()
         batch_obs = {"batchSize": len(query_jsons)}
+        if lane is not None:
+            batch_obs["lane"] = lane
         batch_obs.update({f"{k}Ms": round(v * 1000, 3)
                           for k, v in phases.items()})
         for i, result in enumerate(out):
@@ -802,6 +991,15 @@ class QueryServer:
             self._bind_feature_cache(algo)
         prepared = [a.prepare_serving_model(m, 1)
                     for a, m in zip(algorithms, models)]
+        with self._lock:
+            mode, mesh = self.serving_mode_resolved, self.serving_mesh
+        if mode == "sharded" and mesh is not None:
+            # sharded warm-swap (ISSUE 6): a candidate for a >1-HBM
+            # stable must bind row-sharded too — a single-device copy
+            # of it may not physically fit. Promote later re-places
+            # through the normal _bind, so the stable arm re-derives
+            # its own sharding rather than inheriting this one.
+            prepared = self._shard_models(algorithms, prepared, mesh)
         binding = CandidateBinding(
             engine_params=ep, algorithms=algorithms, models=prepared,
             raw_models=list(models),
@@ -1107,6 +1305,41 @@ def build_app(server: QueryServer) -> HTTPApp:
         return ("<li>cache hit ratio: " + html.escape(", ".join(parts))
                 + " (<a href='/cache.json'>cache.json</a>)</li>")
 
+    def _mesh_panel() -> str:
+        """Per-device lane/HBM occupancy while a mesh is active
+        (ISSUE 6); empty in single mode — the page stays what it was."""
+        mesh = server.mesh_status()
+        if mesh.get("mode", "single") == "single":
+            return ""
+        hbm_by_dev = {str(e.get("device")): e for e in hbm_stats()}
+        parts = [f"<h2>Mesh serving</h2><ul><li>mode: "
+                 f"{html.escape(mesh['mode'])}</li>"]
+        if mesh.get("meshShape"):
+            shape = " × ".join(f"{k}={v}" for k, v
+                               in mesh["meshShape"].items())
+            parts.append(f"<li>mesh: {html.escape(shape)}</li>")
+        if mesh.get("devices"):
+            parts.append(f"<li>devices: {mesh['devices']}</li>")
+        parts.append("</ul>")
+        rows = []
+        for lane in mesh.get("lanes", ()):  # replicated fan-out only
+            hbm = hbm_by_dev.get(str(lane["deviceId"]), {})
+            used = hbm.get("bytesInUse")
+            rows.append(
+                f"<tr><td>{lane['lane']}</td>"
+                f"<td>{html.escape(str(lane['device']))}</td>"
+                f"<td>{lane['dispatches']}</td>"
+                f"<td>{lane['batchP50Ms'] if lane['batchP50Ms'] is not None else '-'}</td>"
+                f"<td>{lane['batchP99Ms'] if lane['batchP99Ms'] is not None else '-'}</td>"
+                f"<td>{used // (1 << 20) if used else '-'}</td></tr>")
+        if rows:
+            parts.append(
+                "<table border='1'><tr><th>lane</th><th>device</th>"
+                "<th>dispatches</th><th>batch p50 (ms)</th>"
+                "<th>batch p99 (ms)</th><th>HBM used (MiB)</th></tr>"
+                + "".join(rows) + "</table>")
+        return "".join(parts)
+
     @app.route("GET", "/")
     def index(req: Request) -> Response:
         inst = server.instance
@@ -1169,7 +1402,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
 {_cache_line()}
-</ul>{release_panel}{table}
+</ul>{_mesh_panel()}{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
         return Response(body=body, content_type="text/html")
@@ -1191,6 +1424,7 @@ def build_app(server: QueryServer) -> HTTPApp:
             "transferGuard": cfg.transfer_guard or "off",
             "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
+            "mesh": server.mesh_status(),
             "hbm": hbm_stats(),
             "cache": (server.cache.stats() if server.cache is not None
                       else {"enabled": False}),
@@ -1422,18 +1656,29 @@ class MicroBatcher:
     1-2 queries that had trickled in — under 8-thread load the queue
     backlog grew unboundedly and p99 hit 11.4s while per-query served
     fine; greedy draining is the fix.)
+
+    With ``lanes`` > 1 (replicated fan-out, ISSUE 6), drainer ``i``
+    serves lane ``i % lanes``: consecutive micro-batches land
+    round-robin on different devices (each with its own full model
+    copy and its own compiled executables), so N chips serve ~N×
+    the single-lane micro-batch qps with zero cross-device traffic
+    on the serve path.
     """
 
     def __init__(self, server: QueryServer, window_ms: float = 2.0,
-                 max_batch: int = 128, pipeline: int = 4):
+                 max_batch: int = 128, pipeline: int = 4,
+                 lanes: int = 1):
         import queue
 
         self.server = server
         self.window = max(window_ms, 0.0) / 1000.0
         self.max_batch = max(max_batch, 1)
+        self.lanes = max(lanes, 1)
         self._q: "queue.Queue" = queue.Queue()
         self._threads = [
             threading.Thread(target=self._drain, daemon=True,
+                             args=(i % self.lanes
+                                   if self.lanes > 1 else None,),
                              name=f"query-microbatcher-{i}")
             for i in range(max(pipeline, 1))]
         for t in self._threads:
@@ -1446,7 +1691,7 @@ class MicroBatcher:
         done.wait()
         return slot[0]
 
-    def _drain(self) -> None:
+    def _drain(self, lane: Optional[int] = None) -> None:
         import queue
 
         while True:
@@ -1454,7 +1699,11 @@ class MicroBatcher:
             # queue depth at pickup: how much backlog this batch found —
             # the arrival-rate × service-time signal the round-4
             # unbounded-backlog pathology would have shown immediately
-            self.server._queue_depth.observe(self._q.qsize() + 1)
+            depth = self._q.qsize() + 1
+            self.server._queue_depth.observe(depth)
+            if lane is not None:
+                self.server._lane_depth.labels(
+                    lane=str(lane)).observe(depth)
             batch = [first]
             waited = False
             while len(batch) < self.max_batch:
@@ -1482,7 +1731,7 @@ class MicroBatcher:
                 obs_list.append(obs)
             try:
                 results = self.server.query_batch(
-                    [b[0] for b in batch], obs_list=obs_list)
+                    [b[0] for b in batch], obs_list=obs_list, lane=lane)
             except Exception as e:  # noqa: BLE001 — isolate to this batch
                 self.server.remote_log(str(e))  # once for the whole batch
                 err = HTTPError(500, str(e))

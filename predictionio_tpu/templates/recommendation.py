@@ -262,15 +262,27 @@ class ALSAlgorithm(Algorithm):
 
     # -- hot-entity tier hooks (ISSUE 4) ------------------------------------
     def pin_hot_entities(self, model: ALSModel,
-                         entity_keys: Sequence[str]):
+                         entity_keys: Sequence[str],
+                         devices: Optional[Sequence] = None):
         """Pin the hottest users' factor rows as ONE device-resident
         table (:func:`~..models.als.pin_user_rows`); returns
         ``({user: (table, slot)}, nbytes)``. Host-served models return
         empty — there is no transfer to skip. The pinned table is
         padded to a pow2 capacity and its k-ladder warmed here (on the
         refresh thread), so the first hot-path query after a refresh
-        never pays a compile."""
-        from ..models.als import pin_user_rows, recommend_pinned
+        never pays a compile.
+
+        With ``devices`` (replicated-mode lanes, ISSUE 6) the pinned
+        table is committed to EVERY lane device
+        (:func:`~..models.als.pin_user_rows_lanes`) and the handle
+        carries the per-device tuple — hot serves stay local to a lane.
+        Sharded models pin a mesh-replicated table instead (the rows
+        are fetched through the collective gather)."""
+        from ..models.als import (
+            pin_user_rows,
+            pin_user_rows_lanes,
+            recommend_pinned,
+        )
 
         known = [(e, int(model.user_ids[e])) for e in entity_keys
                  if model.user_ids and e in model.user_ids]
@@ -279,7 +291,12 @@ class ALSAlgorithm(Algorithm):
         cap = 1
         while cap < len(known):
             cap *= 2
-        table, nbytes = pin_user_rows(model, [u for _, u in known], cap)
+        if devices and getattr(model, "mesh", None) is None:
+            table, nbytes = pin_user_rows_lanes(
+                model, [u for _, u in known], cap, devices)
+        else:
+            table, nbytes = pin_user_rows(model, [u for _, u in known],
+                                          cap)
         if table is None:
             return {}, 0
         ks, k = [], 8
@@ -302,6 +319,24 @@ class ALSAlgorithm(Algorithm):
         from ..models.als import ensure_device_resident
 
         return ensure_device_resident(model, max_batch)
+
+    # -- mesh-wide serving placement hooks (ISSUE 6) ------------------------
+    def replicate_serving_model(self, model: ALSModel,
+                                device) -> ALSModel:
+        """One full factor-table copy committed to ``device`` — a
+        replicated-mode lane's model (per-device compiled executables,
+        no cross-device sync on the serve path)."""
+        from ..models.als import replicate_model
+
+        return replicate_model(model, device)
+
+    def shard_serving_model(self, model: ALSModel, mesh) -> ALSModel:
+        """Row-shard both factor tables over the serving mesh
+        (``NamedSharding``, ALX layout) — the >1-HBM model placement;
+        serving routes through the mesh ranking program."""
+        from ..models.als import shard_model
+
+        return shard_model(model, mesh)
 
     def warm_serving(self, model: ALSModel, max_batch: int = 1) -> None:
         """Pre-compile the serving device kernels for the single-query

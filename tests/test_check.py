@@ -282,6 +282,56 @@ class TestShardingMismatch:
         """)
         assert check_source(code, path=COLD) == []
 
+    def test_positive_collective_axis(self):
+        # ISSUE 6: a typo'd axis handed to a lax collective fails at
+        # trace time on a real mesh exactly like a bad PartitionSpec
+        code = src("""
+            from jax import lax
+
+            def half_step(g):
+                return lax.psum(g, "modle")
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+        assert "modle" in findings[0].message
+
+    def test_positive_collective_axis_kwarg_and_index(self):
+        code = src("""
+            import jax
+
+            def who(x):
+                i = jax.lax.axis_index("bogus")
+                return jax.lax.all_gather(x, axis_name="nope")
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"] * 2
+
+    def test_negative_collectives_on_declared_axes(self):
+        # "batch" is the serving-mesh axis declared by parallel/mesh.py
+        # (BATCH_AXIS) — NamedSharding-annotated serving entry points
+        # and their collectives land clean without pragmas
+        code = src("""
+            import jax
+            from jax import lax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def rank(scores, mesh):
+                spec = NamedSharding(mesh, P(("batch", "model")))
+                s = lax.all_gather(scores, ("batch", "model"), tiled=True)
+                return s, spec, lax.axis_index("batch")
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_collective_variable_axis(self):
+        # a variable axis name is resolved at run time — not lintable
+        code = src("""
+            from jax import lax
+
+            def reduce_over(x, axis):
+                return lax.psum(x, axis)
+        """)
+        assert check_source(code, path=COLD) == []
+
 
 class TestConfigDrift:
     def test_positive_update_outside_platform(self):

@@ -447,6 +447,7 @@ def test_deploy_batching_defaults_match_config():
     assert args.max_batch == cfg.max_batch
     assert args.batch_window_ms == cfg.batch_window_ms
     assert args.batch_pipeline == cfg.batch_pipeline
+    assert args.serving_mode == cfg.serving_mode
     import inspect
 
     sig = inspect.signature(MicroBatcher.__init__)

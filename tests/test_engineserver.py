@@ -198,8 +198,15 @@ class TestMicroBatching:
                 t.start()
             for t in threads:
                 t.join()
+            # same ranking; scores to float32 tolerance — the batched
+            # dispatch compiles a different [B, n] shape whose reduction
+            # order may differ from the B=1 kernel's by an ulp
             for r in results:
-                assert r == want
+                assert [s["item"] for s in r["itemScores"]] == \
+                    [s["item"] for s in want["itemScores"]]
+                for got, exp in zip(r["itemScores"], want["itemScores"]):
+                    assert got["score"] == pytest.approx(exp["score"],
+                                                         rel=1e-5)
         finally:
             srv.shutdown()
 
