@@ -199,27 +199,69 @@ def main() -> None:
                 "stage": "gram_table_pallas", "rank": r,
                 "skipped": skip, "device": dev}), flush=True)
 
+        # the HBM-streaming fused gather+gram kernel (ISSUE 7,
+        # ops/fused_gram.py): the table STAYS in HBM, rows DMA into
+        # double-buffered VMEM tiles — the gram_mode="fused"
+        # realization, raced here at the same shapes so --record can
+        # persist a three-way winner
+        from predictionio_tpu.ops.fused_gram import (
+            fused_gram,
+            fused_gram_supported,
+        )
+
+        if fused_gram_supported():
+            for kname, tab in (
+                    ("gram_kernel_fused", fixed),
+                    ("gram_kernel_fused_bf16",
+                     fixed.astype(jnp.bfloat16))):
+                try:
+                    dt = timeit(jax.jit(fused_gram), tab, idx, w, w)
+                except Exception as e:  # noqa: BLE001 — keep going
+                    print(json.dumps({
+                        "stage": kname, "rank": r,
+                        "skipped": str(e)[:300], "device": dev}),
+                        flush=True)
+                else:
+                    emit(kname, r, dt, flops=gram_flops)
+                    if dt is not None:
+                        stage_ms[kname] = dt
+        else:
+            print(json.dumps({
+                "stage": "gram_kernel_fused", "rank": r,
+                "skipped": "lowering unsupported on this backend",
+                "device": dev}), flush=True)
+
         # --record: persist the fused-variant winners (the half-step's
         # actual realization: gather+gram in one jit) into the
         # shape-keyed autotune table consulted by gram_mode="auto"
         if "--record" in sys.argv:
             from predictionio_tpu.ops.gram_autotune import record
 
-            for bf16, ein, pair in (
-                    (False, "gram_fused", "gram_pair_fused"),
-                    (True, "gram_fused_bf16", "gram_pair_fused_bf16")):
+            for bf16, ein, pair, kern in (
+                    (False, "gram_fused", "gram_pair_fused",
+                     "gram_kernel_fused"),
+                    (True, "gram_fused_bf16", "gram_pair_fused_bf16",
+                     "gram_kernel_fused_bf16")):
                 if ein in stage_ms and pair in stage_ms:
-                    win = ("pair" if stage_ms[pair] < stage_ms[ein]
-                           else "einsum")
+                    cands = {"einsum": stage_ms[ein],
+                             "pair": stage_ms[pair]}
+                    if kern in stage_ms:
+                        # the Pallas kernel joins the race wherever it
+                        # lowered; its absence (no TPU, Mosaic too old)
+                        # keeps the two-way einsum/pair contest
+                        cands["fused"] = stage_ms[kern]
+                    win = min(cands, key=cands.get)
+                    measured = {
+                        "source": "gram_profile",
+                        "einsum_ms": round(stage_ms[ein] * 1e3, 3),
+                        "pair_ms": round(stage_ms[pair] * 1e3, 3),
+                    }
+                    if kern in stage_ms:
+                        measured["fused_ms"] = round(
+                            stage_ms[kern] * 1e3, 3)
                     persisted = record(r, win, bf16=bf16,
                                        device_kind=dev,
-                                       measured={
-                                           "source": "gram_profile",
-                                           "einsum_ms": round(
-                                               stage_ms[ein] * 1e3, 3),
-                                           "pair_ms": round(
-                                               stage_ms[pair] * 1e3, 3),
-                                       })
+                                       measured=measured)
                     print(json.dumps({
                         "recorded": win if persisted else None,
                         "persisted": persisted, "rank": r,

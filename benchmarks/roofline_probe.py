@@ -8,12 +8,26 @@ doesn't see. This probe asks XLA itself: it captures the exact
 argument-assembly duplication), lowers/compiles that same program, and
 prints ``cost_analysis()`` (flops, bytes accessed, optimal seconds).
 
-bytes_accessed / measured_iteration_time vs the chip's HBM bandwidth
-says whether the iteration is HBM-bound; flops / time vs peak says
-MXU-bound; neither ≈ dispatch/serialization-bound.
+The output places the iteration on the DUAL roofline (ISSUE 7):
+
+- ``arithmetic_intensity`` = XLA flops / XLA bytes accessed, the
+  program's position on the x-axis;
+- ``attainable_tflops`` = min(peak MXU, intensity x peak HBM GB/s) —
+  the roof over that position — and ``bound`` says which segment
+  ("hbm" left of the ridge, "mxu" right of it);
+- ``hbm_gbps`` / ``hbm_utilization`` (achieved bandwidth) and
+  ``achieved_tflops`` / ``mfu`` (achieved compute, padded-work FLOP
+  model over the measured steady-state time) say how close the run
+  sits to that roof.
+
+``PROBE_GRAM`` selects the gram realization (einsum | pair | fused |
+auto), so the bench can emit one block per mode and the fused kernel's
+bytes-accessed drop is visible next to the einsum baseline.
 
 Usage: python benchmarks/roofline_probe.py   (from the repo root)
-Env:   BENCH_SCALE, BENCH_RANK as for bench.py; PROBE_ITERS (default 1)
+Env:   BENCH_SCALE, BENCH_RANK as for bench.py; PROBE_ITERS (default 1);
+       PROBE_GRAM (default auto); PROBE_GATHER (float32|bfloat16);
+       PROBE_REPEATS (default 3)
 """
 
 from __future__ import annotations
@@ -28,11 +42,18 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+#: public spec-sheet HBM bandwidth (GB/s) per generation
+PEAK_BW = {"TPU v5 lite": 819, "TPU v5e": 819, "TPU v4": 1228,
+           "TPU v5": 2765, "TPU v5p": 2765, "TPU v6e": 1640,
+           "TPU v6 lite": 1640}
+
 
 def main() -> None:
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     rank = int(os.environ.get("BENCH_RANK", "64"))
     iters = int(os.environ.get("PROBE_ITERS", "1"))
+    gram = os.environ.get("PROBE_GRAM", "auto")
+    gather = os.environ.get("PROBE_GATHER", "float32")
     n_users = int(138_000 * scale)
     n_items = int(27_000 * scale)
     nnz = int(20_000_000 * scale)
@@ -49,7 +70,7 @@ def main() -> None:
     ratings = als.RatingsCOO(users, items, vals, n_users, n_items)
     params = als.ALSParams(rank=rank, num_iterations=iters,
                            implicit_prefs=True, alpha=40.0, reg=0.01,
-                           seed=3)
+                           seed=3, gram_mode=gram, gather_dtype=gather)
 
     captured: dict = {}
     orig = als._train_fused
@@ -88,28 +109,37 @@ def main() -> None:
     flops = float(ca.get("flops", 0.0))
     byts = float(ca.get("bytes accessed", 0.0))
     device = jax.devices()[0].device_kind
-    #: public spec-sheet HBM bandwidth (GB/s) per generation
-    peak_bw = {"TPU v5 lite": 819, "TPU v5e": 819, "TPU v4": 1228,
-               "TPU v5": 2765, "TPU v5p": 2765, "TPU v6e": 1640,
-               "TPU v6 lite": 1640}
-    bw = next((v for k, v in peak_bw.items() if device.startswith(k)),
+    bw = next((v for k, v in PEAK_BW.items() if device.startswith(k)),
               None)
+    try:
+        from bench import device_peak_flops
+
+        peak_fl = device_peak_flops()
+    except Exception:  # noqa: BLE001 — probe must not die on a moved
+        peak_fl = None  # bench.py symbol
     per_iter_s = best / max(iters, 1)
+    model_fl = als.als_flops_per_iter(packed[0], packed[1], params)
+    achieved_fl = model_fl / per_iter_s if per_iter_s else None
     out = {
         "metric": "als_fused_roofline",
         "device": device,
+        "gram_mode": gram,
+        "gather_dtype": gather,
         "rank": rank, "nnz": nnz, "iters_in_program": iters,
         "xla_flops": flops,
         "xla_bytes_accessed": byts,
         "xla_optimal_seconds": ca.get("optimal_seconds"),
         "steady_state_s_per_iter": round(per_iter_s, 4),
-        "model_flops_per_iter": als.als_flops_per_iter(
-            packed[0], packed[1], params),
+        "model_flops_per_iter": model_fl,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime()),
     }
+    if achieved_fl:
+        out["achieved_tflops"] = round(achieved_fl / 1e12, 3)
+        if peak_fl:
+            out["mfu"] = round(achieved_fl / peak_fl, 4)
     if byts and best:
-        # bytes accessed is XLA's POST-fusION traffic model for the
+        # bytes accessed is XLA's POST-fusion traffic model for the
         # compiled program (iters iterations): achieved bandwidth =
         # bytes / steady-state run time
         gbps = byts / best / 1e9
@@ -117,6 +147,18 @@ def main() -> None:
         if bw:
             out["hbm_peak_gbps"] = bw
             out["hbm_utilization"] = round(gbps / bw, 3)
+    if byts and flops:
+        # dual-roofline position: where the program SITS (intensity)
+        # and which roof is over it
+        ai = flops / byts
+        out["arithmetic_intensity"] = round(ai, 3)
+        if bw and peak_fl:
+            attainable = min(peak_fl, ai * bw * 1e9)
+            out["attainable_tflops"] = round(attainable / 1e12, 2)
+            out["bound"] = "hbm" if ai * bw * 1e9 < peak_fl else "mxu"
+            if achieved_fl:
+                out["roofline_fraction"] = round(
+                    achieved_fl / attainable, 3)
     print(json.dumps(out))
 
 

@@ -1,6 +1,6 @@
 """The JAX-specific rule catalogue behind ``ptpu check``.
 
-This module holds the five JAX rules and assembles the full registry
+This module holds the six JAX rules and assembles the full registry
 (:data:`RULES`), which also includes the concurrency rule family from
 :mod:`.concurrency` (``unguarded-shared-state``,
 ``lock-order-inversion``, ``blocking-under-lock``,
@@ -31,6 +31,12 @@ The JAX rules, each an AST pass over one :class:`~.core.ModuleInfo`:
   ``ppermute``/``axis_index``/…) that no mesh builder in
   ``parallel/mesh.py`` declares; XLA only reports these at trace time
   on a real mesh, usually mid-deploy.
+- ``materialized-gather`` — ``table[indices]`` advanced-indexing
+  gathers by a caller-supplied index array inside ``models/``/
+  ``ops/``/``server/`` functions: XLA materializes the gathered rows
+  as an HBM temp sized by the index shape (the ``[B, L, r]`` ALS
+  gather temp behind BENCH_r05's 75%-HBM/0.6%-MFU roofline); fuse it
+  (``gram_mode="fused"``), bound it, or pragma a size case.
 - ``config-drift`` — ``jax.config.update`` outside
   ``utils/platform.py``: scattered config flips make process behavior
   depend on import order (exactly the class of bug
@@ -547,7 +553,86 @@ def rule_sharding_mismatch(mod: ModuleInfo,
 
 
 # ---------------------------------------------------------------------------
-# rule 5: config-drift
+# rule 5: materialized-gather
+# ---------------------------------------------------------------------------
+
+#: directories whose functions sit on the train/serve hot paths — the
+#: places where an advanced-indexing gather's HBM temp scales with the
+#: problem, not with a constant
+MATGATHER_DIR_PARTS = {"models", "ops", "server"}
+
+
+def rule_materialized_gather(mod: ModuleInfo,
+                             ctx: CheckContext) -> List[Finding]:
+    """``table[indices]`` advanced indexing by an index ARRAY inside
+    train/serve hot-path functions.
+
+    XLA materializes the gathered rows as an HBM temp whose size is the
+    full index shape times the row width — ``fixed[indices]`` in the
+    ALS half-step was ``[B, L, r]``, written once and read back at
+    least once, which is exactly the 75%-HBM/0.6%-MFU bound BENCH_r05
+    measured. Bound the gather (row blocks), fuse it
+    (``gram_mode="fused"`` / ``ops/fused_gram.py``), or pragma it with
+    a size justification (a ``[B, r]`` serving row-fetch is fine; an
+    unbounded ``[B, L, r]`` training temp is not).
+
+    Heuristic scope: inside a JITTED function (decorator, wrapped def,
+    or ``jax.jit(lambda …)``) whose subscripted value and index are
+    both bare names, with the index a TRACED parameter of that jit
+    site — a traced scalar would be a data-dependent-shape error, so a
+    traced parameter used as a subscript is an index array and the
+    result is a device gather sized by the caller. ``x.at[i]``
+    scatter/update builders and tuple-literal subscripts (host
+    dispatch tables) are excluded; host-side helpers are out of scope
+    (their gathers are numpy, paid once, not per dispatch)."""
+    parts = set(mod.path.split("/")[:-1])
+    if not (parts & MATGATHER_DIR_PARTS):
+        return []
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    collector = _collect_jit(mod)
+    for site in collector.sites:
+        fn = site.fn
+        if fn is None:
+            continue
+        params = set(_param_names(fn)) - site.static_names
+        if not params:
+            continue
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        fname = getattr(fn, "name", "<lambda>")
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Subscript) \
+                        or id(node) in seen:
+                    continue
+                if not isinstance(node.ctx, ast.Load):
+                    continue
+                idx = node.slice
+                if not (isinstance(idx, ast.Name)
+                        and idx.id in params):
+                    continue
+                val = node.value
+                if not isinstance(val, (ast.Name, ast.Attribute)):
+                    continue  # (a, b)[i] host dispatch, call results
+                if isinstance(val, ast.Attribute) and val.attr == "at":
+                    continue  # x.at[ids] is a scatter builder
+                seen.add(id(node))
+                vname = mod.resolve(val) or "<expr>"
+                findings.append(Finding(
+                    "materialized-gather", mod.path, node.lineno,
+                    node.col_offset,
+                    f"advanced indexing `{vname}[{idx.id}]` by the "
+                    f"index array `{idx.id}` in hot function "
+                    f"`{fname}` materializes the gathered rows as an "
+                    f"HBM temp of unbounded size; bound it (row "
+                    f"blocks), fuse it (gram_mode='fused', "
+                    f"ops/fused_gram.py), or pragma with a size "
+                    f"justification"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 6: config-drift
 # ---------------------------------------------------------------------------
 
 #: the one module allowed to flip global jax config (platform policy)
@@ -601,6 +686,11 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
          "PartitionSpec / NamedSharding / lax-collective axis names "
          "not declared by parallel/mesh.py",
          rule_sharding_mismatch),
+    Rule("materialized-gather",
+         "table[indices] advanced-indexing gathers in models/, ops/, "
+         "or server/ functions — unbounded HBM temps on train/serve "
+         "hot paths (fuse or bound, or pragma with a size case)",
+         rule_materialized_gather),
     Rule("config-drift",
          "jax.config.update outside utils/platform.py",
          rule_config_drift),

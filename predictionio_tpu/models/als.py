@@ -84,7 +84,12 @@ class ALSParams:
     gather_dtype: str = "float32"
     #: Weighted-gram realization: "einsum" (baseline batched matmul),
     #: "pair" (two rank-r systems packed per 128x128 MXU tile —
-    #: ``ops/gram.py``), or "auto".
+    #: ``ops/gram.py``), "fused" (the Pallas gather+Gramian kernel,
+    #: ``ops/fused_gram.py`` — the gathered [B, L, r] temp never lands
+    #: in HBM; on non-TPU backends this runs the kernel in interpret
+    #: mode, a debugging path), or "auto" (the persistent shape-keyed
+    #: autotune table, support-gated so "fused" never resolves where
+    #: the kernel cannot lower).
     gram_mode: str = "auto"
     #: History layout. "pad": one [n_rows, L] padded matrix per side
     #: (entries beyond L are DROPPED — round-1 semantics). "bucket":
@@ -109,10 +114,10 @@ class ALSParams:
             raise ValueError(
                 f"history_mode must be 'auto', 'pad', 'split' or "
                 f"'bucket', got {self.history_mode!r}")
-        if self.gram_mode not in ("auto", "einsum", "pair"):
+        if self.gram_mode not in ("auto", "einsum", "pair", "fused"):
             raise ValueError(
-                f"gram_mode must be 'auto', 'einsum' or 'pair', got "
-                f"{self.gram_mode!r}")
+                f"gram_mode must be 'auto', 'einsum', 'pair' or "
+                f"'fused', got {self.gram_mode!r}")
 
 
 @jax.tree_util.register_dataclass
@@ -153,46 +158,145 @@ class RatingsCOO:
     n_items: int
 
 
+def _resolves_fused(gram: str, rank: int, bf16: bool) -> bool:
+    """Whether ``gram`` lands on the fused Pallas kernel at trace time:
+    explicitly, or via the support-gated autotune table ("auto" never
+    resolves to fused where the kernel cannot lower)."""
+    if gram == "fused":
+        return True
+    if gram != "auto":
+        return False
+    from ..ops.gram_autotune import best_mode
+
+    return best_mode(rank, bf16=bf16) == "fused"
+
+
+def resolved_gram_mode(params: "ALSParams") -> str:
+    """The concrete gram realization ``params`` trains with on the
+    attached backend — the label value of the ``pio_gram_mode`` info
+    gauge (docs/observability.md)."""
+    if params.gram_mode != "auto":
+        return params.gram_mode
+    from ..ops.gram_autotune import best_mode
+
+    return best_mode(params.rank,
+                     bf16=(params.matmul_dtype == "bfloat16"))
+
+
+def _fused_lhs(table: jax.Array, indices: jax.Array, wa: jax.Array,
+               wb: jax.Array, mesh: Optional[Mesh]):
+    """The fused-kernel realization of :func:`_lhs_fn`: gather and
+    Gramian in one Pallas launch (``ops/fused_gram.py``) — the
+    ``[d, B, L, r]`` temp never exists. Under a mesh the kernel runs on
+    each device's LOCAL rows via shard_map: the fixed table enters
+    replicated (the same all-gather the GSPMD gather pays), the
+    index/weight blocks and both outputs stay row-sharded."""
+    from ..ops.fused_gram import fused_gram_dispatch
+
+    r = table.shape[-1]
+    L = indices.shape[-1]
+
+    def flat(tab, idx, a, b2):
+        A, bb = fused_gram_dispatch(tab, idx.reshape(-1, L),
+                                    a.reshape(-1, L), b2.reshape(-1, L))
+        return (A.reshape(idx.shape[:-1] + (r, r)),
+                bb.reshape(idx.shape[:-1] + (r,)))
+
+    if mesh is None:
+        return flat(table, indices, wa, wb)
+    from ..parallel.collectives import shard_map_compat
+
+    spec = rows_spec(mesh)
+    fn = shard_map_compat(flat, mesh,
+                          in_specs=(P(), spec, spec, spec),
+                          out_specs=(spec, spec), check=False)
+    return fn(table, indices, wa, wb)
+
+
+def _lhs_fn(table: jax.Array, indices: jax.Array, wa: jax.Array,
+            wb: jax.Array, *, gram: str, bf16: bool,
+            mesh: Optional[Mesh] = None):
+    """Per-row normal-equation build — the ONE place the factor gather
+    exists: ``A = Σ_l wa·f fᵀ`` and the fused RHS ``b = Σ_l wb·f`` over
+    ``f = table[indices]``. ``table`` is the f32 factors or the bf16
+    shadow (:func:`_shadow_lhs_fn` casts for callers that have not);
+    weights arrive pre-masked so padding slots contribute exactly zero.
+
+    ``gram_mode="fused"`` (and "auto" resolving to it) routes to the
+    Pallas fused gather+Gramian kernel and never materializes the
+    ``[d, B, L, r]`` gather temp in HBM. Every other mode gathers and
+    dispatches to ``ops/gram.py`` exactly as before. Under a mesh the
+    kernel covers row-sharded blocks; L-axis-sharded skinny buckets
+    keep the einsum path, whose contraction over L GSPMD turns into
+    per-device partial Gramians + an all-reduce."""
+    if _resolves_fused(gram, table.shape[-1], bf16) \
+            and (mesh is None or indices.shape[0] == mesh.devices.size):
+        return _fused_lhs(table, indices, wa, wb, mesh)
+    from ..ops.gram import gram_dispatch
+
+    # gather_dtype="bfloat16": F stays bf16 INTO the einsums — the
+    # upcast to f32 happens inside each dot's fusion (exact: the values
+    # are already bf16-quantized) instead of as a standalone convert
+    # materializing a second full-size F (measured 5.2ms per block in
+    # the round-4 trace). Accumulation/solve stay f32 via promotion.
+    # ptpu: allow[materialized-gather] — bounded by _auto_block_rows'
+    # ~1GB block budget, and eliminated entirely under gram_mode="fused"
+    F = table[indices]  # [d, B, L, r] — cross-shard gather under a mesh
+    A = gram_dispatch(F, wa, mode=gram, bf16=bf16)
+    b = jnp.einsum("...lr,...l->...r", F, wb)
+    return A, b
+
+
+def _shadow_lhs_fn(table_f32: jax.Array, indices: jax.Array,
+                   wa: jax.Array, wb: jax.Array, *, gram: str,
+                   bf16: bool, mesh: Optional[Mesh] = None):
+    """:func:`_lhs_fn` over the bf16 SHADOW of an f32 table (the
+    ``ALSParams.gather_dtype="bfloat16"`` wire): rows travel HBM→MXU
+    (or HBM→VMEM, fused) as bf16, accumulation stays f32. The
+    half-iteration impls pre-cast ONCE per half-step so every block
+    shares one shadow buffer; this entry is for callers without that
+    amortization (tests, one-shot solves)."""
+    return _lhs_fn(table_f32.astype(jnp.bfloat16), indices, wa, wb,
+                   gram=gram, bf16=bf16, mesh=mesh)
+
+
 @functools.partial(jax.jit, static_argnames=("implicit", "scale_reg",
-                                             "bf16", "gram"))
+                                             "bf16", "gram", "mesh"))
 def _update_block(fixed: jax.Array, G, indices: jax.Array,
                   values: jax.Array, counts: jax.Array, reg: float,
                   alpha: float, implicit: bool, scale_reg: bool,
-                  bf16: bool = False, gram: str = "auto") -> jax.Array:
+                  bf16: bool = False, gram: str = "auto",
+                  mesh: Optional[Mesh] = None) -> jax.Array:
     """Recompute one block of rows, holding ``fixed`` constant.
 
     fixed: [m, r] (flat, row-sharded); G: [r, r] Gramian of ``fixed`` (only
     for implicit); indices/values: [d, B, L]; counts: [d, B] with leading
     axis sharded across all devices → new factors [d, B, r], same sharding.
     Padding entries carry value 0 and index 0; masks keep them inert.
+    ``mesh`` (static) lets the fused path run its kernel per device on
+    local rows; the einsum/pair paths ignore it (GSPMD places them).
     """
     r = fixed.shape[-1]
     L = indices.shape[-1]
     valid = (jnp.arange(L)[None, None, :]
              < counts[:, :, None]).astype(jnp.float32)
-    F = fixed[indices]  # [d, B, L, r] — cross-shard gather under a mesh
-    # gather_dtype="bfloat16": F stays bf16 INTO the einsums — the
-    # upcast to f32 happens inside each dot's fusion (exact: the values
-    # are already bf16-quantized) instead of as a standalone convert
-    # materializing a second full-size F (measured 5.2ms per block in
-    # the round-4 trace). Accumulation/solve stay f32 via promotion.
-
-    def outer(Fm, w):
-        """Σ_l w·f fᵀ on the MXU (optionally bf16 inputs with f32
-        accumulation); realization per ``ALSParams.gram_mode``."""
-        from ..ops.gram import gram_dispatch
-        return gram_dispatch(Fm, w, mode=gram, bf16=bf16)
-
     if implicit:
         # Hu-Koren-Volinsky: c = 1 + alpha·r, preference p=1 on observed.
         # A = G + Σ (c-1)·f fᵀ (G = FᵀF baseline over *all* items),
         # b = Σ c·f on observed entries.
-        c1 = alpha * values * valid              # c - 1, 0 at padding
-        A = G[None, None] + outer(F, c1)
-        b = jnp.einsum("dnlr,dnl->dnr", F, (c1 + 1.0) * valid)
+        wa = alpha * values * valid              # c - 1, 0 at padding
+        wb = (wa + 1.0) * valid
     else:
-        A = outer(F, valid)
-        b = jnp.einsum("dnlr,dnl->dnr", F, values * valid)
+        wa = valid
+        wb = values * valid
+    A, b = _lhs_fn(fixed, indices, wa, wb, gram=gram, bf16=bf16,
+                   mesh=mesh)
+    if implicit:
+        # G is added AFTER the kernel/einsum output on purpose: the
+        # blocks' normal-equation build has no data dependence on the
+        # fixed-side Gramian, so its (mesh) all-reduce overlaps the
+        # first block's gather instead of gating it
+        A = G[None, None] + A
 
     reg_n = reg * jnp.maximum(counts.astype(jnp.float32), 1.0) if scale_reg \
         else jnp.full(counts.shape, reg, dtype=jnp.float32)
@@ -203,14 +307,35 @@ def _update_block(fixed: jax.Array, G, indices: jax.Array,
 _gramian_jit = jax.jit(gramian)
 
 
+def _fixed_gramian(fixed: jax.Array, mesh: Optional[Mesh], gram: str,
+                   bf16: bool):
+    """Implicit-path baseline Gramian FᵀF of the fixed side. Under a
+    mesh on the fused path it is computed as an EXPLICIT per-shard
+    partial + ICI psum (``parallel/collectives.gramian_allreduce``)
+    that nothing in any block's kernel depends on: blocks add G to
+    their kernel output last (:func:`_update_block`), so the all-reduce
+    rides under the next virtual-row block's gather/kernel launch
+    instead of serializing the half-iteration on it — the ALX overlap
+    (arXiv 2112.02194). Elsewhere it stays the plain einsum whose
+    collective GSPMD derives."""
+    if mesh is not None and _resolves_fused(gram, fixed.shape[-1], bf16):
+        from ..parallel.collectives import gramian_allreduce
+
+        return gramian_allreduce(fixed, mesh)
+    # jitted (compile-once) for the eager split path; inlined like the
+    # plain einsum when traced inside a half-step program
+    return _gramian_jit(fixed)
+
+
 @functools.partial(jax.jit, static_argnames=("implicit", "bf16",
-                                             "gram"),
+                                             "gram", "mesh"),
                    donate_argnums=(5, 6))
 def _partials_block(fixed: jax.Array, indices: jax.Array,
                     values: jax.Array, counts: jax.Array,
                     row_ids: jax.Array, A_acc: jax.Array,
                     b_acc: jax.Array, alpha: float, implicit: bool,
-                    bf16: bool = False, gram: str = "auto"):
+                    bf16: bool = False, gram: str = "auto",
+                    mesh: Optional[Mesh] = None):
     """Split-mode half of :func:`_update_block`: per-VIRTUAL-row partials
     Σ w·ffᵀ and Σ w·f, scatter-added onto the owning real rows.
     Sentinel/padding virtual rows contribute exactly zero (their valid
@@ -219,20 +344,14 @@ def _partials_block(fixed: jax.Array, indices: jax.Array,
     L = indices.shape[-1]
     valid = (jnp.arange(L)[None, None, :]
              < counts[:, :, None]).astype(jnp.float32)
-    F = fixed[indices]  # [d, B, L, r] — bf16 under the shadow gather;
-    # upcast fuses into the consuming dots (see _update_block)
-
-    def outer(Fm, w):
-        from ..ops.gram import gram_dispatch
-        return gram_dispatch(Fm, w, mode=gram, bf16=bf16)
-
     if implicit:
-        c1 = alpha * values * valid
-        A_v = outer(F, c1)
-        b_v = jnp.einsum("dnlr,dnl->dnr", F, (c1 + 1.0) * valid)
+        wa = alpha * values * valid
+        wb = (wa + 1.0) * valid
     else:
-        A_v = outer(F, valid)
-        b_v = jnp.einsum("dnlr,dnl->dnr", F, values * valid)
+        wa = valid
+        wb = values * valid
+    A_v, b_v = _lhs_fn(fixed, indices, wa, wb, gram=gram, bf16=bf16,
+                       mesh=mesh)
     ids = row_ids.reshape(-1)
     A_acc = A_acc.at[ids].add(A_v.reshape(-1, r, r), mode="drop")
     b_acc = b_acc.at[ids].add(b_v.reshape(-1, r), mode="drop")
@@ -281,7 +400,9 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
     like the factors; virtual-row blocks bound the [B, L, r] gather temp
     exactly as the pad path does."""
     implicit = params.implicit_prefs
-    G = _gramian_jit(fixed) if implicit else None
+    bf16 = params.matmul_dtype == "bfloat16"
+    G = _fixed_gramian(fixed, sh["mesh"], params.gram_mode, bf16) \
+        if implicit else None
     gsrc = fixed.astype(jnp.bfloat16) \
         if params.gather_dtype == "bfloat16" else fixed
     d, n_vper, L = sh["idx"].shape
@@ -295,9 +416,8 @@ def _update_side_split(fixed: jax.Array, sh: dict, params: "ALSParams",
         A_acc, b_acc = _partials_block(
             gsrc, sh["idx"][:, s:e], sh["val"][:, s:e],
             sh["cnt"][:, s:e], sh["rid"][:, s:e], A_acc, b_acc,
-            params.alpha, implicit,
-            bf16=(params.matmul_dtype == "bfloat16"),
-            gram=params.gram_mode)
+            params.alpha, implicit, bf16=bf16,
+            gram=params.gram_mode, mesh=sh["mesh"])
     if G is None:
         G = jnp.zeros((r, r), jnp.float32)  # static arg shape filler
     return _solve_accumulated(A_acc, b_acc, G, sh["real_cnt"], params.reg,
@@ -308,12 +428,13 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
                       reg, alpha, implicit: bool, scale_reg: bool,
                       bf16: bool, block_rows_opt,
                       gram: str = "auto",
-                      gather_bf16: bool = False) -> jax.Array:
+                      gather_bf16: bool = False,
+                      mesh: Optional[Mesh] = None) -> jax.Array:
     """Trace-level body of a bucketed half-iteration (jit-wrapped by
     :func:`_bucket_half_step` and inlined whole-training by
     :func:`_train_bucket_fused`)."""
     r = fixed.shape[-1]
-    G = gramian(fixed) if implicit else None
+    G = _fixed_gramian(fixed, mesh, gram, bf16) if implicit else None
     # the bf16 shadow (ALSParams.gather_dtype): gram/rhs/solve stay f32.
     # The barrier shares ONE materialized shadow across every bucket's
     # gather instead of letting XLA re-fuse the cast per bucket
@@ -331,7 +452,7 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
             parts.append(_update_block(
                 gsrc, G, b["idx"][:, s:e], b["val"][:, s:e],
                 b["cnt"][:, s:e], reg, alpha, implicit, scale_reg,
-                bf16=bf16, gram=gram))
+                bf16=bf16, gram=gram, mesh=mesh))
         new = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
                                                                axis=1)
         # each real row lives in exactly one bucket → unique indices (the
@@ -345,13 +466,14 @@ def _bucket_half_impl(fixed: jax.Array, out0: jax.Array, buckets,
 @functools.partial(jax.jit,
                    static_argnames=("implicit", "scale_reg", "bf16",
                                     "block_rows_opt", "gram",
-                                    "gather_bf16"),
+                                    "gather_bf16", "mesh"),
                    donate_argnums=(1,))
 def _bucket_half_step(fixed: jax.Array, out0: jax.Array, buckets,
                       reg, alpha, *, implicit: bool, scale_reg: bool,
                       bf16: bool, block_rows_opt,
                       gram: str = "auto",
-                      gather_bf16: bool = False) -> jax.Array:
+                      gather_bf16: bool = False,
+                      mesh: Optional[Mesh] = None) -> jax.Array:
     """One ENTIRE bucketed half-iteration as a single compiled program —
     Gramian, every bucket's normal-equation blocks, solves, and the
     unique-index scatters all fuse into one dispatch. Separate per-bucket
@@ -363,7 +485,7 @@ def _bucket_half_step(fixed: jax.Array, out0: jax.Array, buckets,
     """
     return _bucket_half_impl(fixed, out0, buckets, reg, alpha, implicit,
                              scale_reg, bf16, block_rows_opt, gram,
-                             gather_bf16)
+                             gather_bf16, mesh)
 
 
 def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
@@ -381,17 +503,19 @@ def _update_side_bucket(fixed: jax.Array, bk: dict, params: "ALSParams"
         scale_reg=params.scale_reg_by_count,
         bf16=(params.matmul_dtype == "bfloat16"),
         block_rows_opt=params.block_rows, gram=params.gram_mode,
-        gather_bf16=(params.gather_dtype == "bfloat16"))
+        gather_bf16=(params.gather_dtype == "bfloat16"),
+        mesh=bk["mesh"])
 
 
 def _pad_half_impl(fixed: jax.Array, lay: dict, block: int, reg, alpha,
                    implicit: bool, scale_reg: bool, bf16: bool,
-                   gram: str, gather_bf16: bool = False) -> jax.Array:
+                   gram: str, gather_bf16: bool = False,
+                   mesh: Optional[Mesh] = None) -> jax.Array:
     """One pad-layout half-iteration (trace-level body): Gramian, row
     blocks through :func:`_update_block`, flat reshape. SHARED by the
     per-step path (:func:`_update_side`) and the fused whole-run
     trainer — the two must never diverge."""
-    G = gramian(fixed) if implicit else None
+    G = _fixed_gramian(fixed, mesh, gram, bf16) if implicit else None
     gsrc = jax.lax.optimization_barrier(
         fixed.astype(jnp.bfloat16)) if gather_bf16 else fixed
     d, n_per, L = lay["idx"].shape
@@ -401,7 +525,7 @@ def _pad_half_impl(fixed: jax.Array, lay: dict, block: int, reg, alpha,
         parts.append(_update_block(
             gsrc, G, lay["idx"][:, st:e], lay["val"][:, st:e],
             lay["cnt"][:, st:e], reg, alpha, implicit, scale_reg,
-            bf16=bf16, gram=gram))
+            bf16=bf16, gram=gram, mesh=mesh))
     out = parts[0] if len(parts) == 1 \
         else jnp.concatenate(parts, axis=1)
     return out.reshape(d * n_per, out.shape[-1])
@@ -432,15 +556,17 @@ def _train_fused(U: jax.Array, V: jax.Array, lay_u, lay_i, reg, alpha,
     output on a mesh."""
 
     def half(fixed, kind, lay, block, n_total, shard):
+        mesh = None if shard is None else shard.mesh
         if kind == "bucket":
             out0 = jnp.zeros((n_total, fixed.shape[-1]), fixed.dtype)
             if shard is not None:
                 out0 = jax.lax.with_sharding_constraint(out0, shard)
             return _bucket_half_impl(fixed, out0, lay, reg, alpha,
                                      implicit, scale_reg, bf16,
-                                     block_rows_opt, gram, gather_bf16)
+                                     block_rows_opt, gram, gather_bf16,
+                                     mesh)
         out = _pad_half_impl(fixed, lay, block, reg, alpha, implicit,
-                             scale_reg, bf16, gram, gather_bf16)
+                             scale_reg, bf16, gram, gather_bf16, mesh)
         if shard is not None:
             out = jax.lax.with_sharding_constraint(out, shard)
         return out
@@ -459,7 +585,8 @@ def _train_fused(U: jax.Array, V: jax.Array, lay_u, lay_i, reg, alpha,
 
 def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
                  counts: jax.Array, params: "ALSParams",
-                 block_rows: int) -> jax.Array:
+                 block_rows: int,
+                 mesh: Optional[Mesh] = None) -> jax.Array:
     """One half-iteration, row-blocked to bound the [B, L, r] gather's
     memory (ALX-style batched updates); the per-step twin of the fused
     trainer — both route through :func:`_pad_half_impl`."""
@@ -469,7 +596,8 @@ def _update_side(fixed: jax.Array, indices: jax.Array, values: jax.Array,
         params.scale_reg_by_count,
         bf16=(params.matmul_dtype == "bfloat16"),
         gram=params.gram_mode,
-        gather_bf16=(params.gather_dtype == "bfloat16"))
+        gather_bf16=(params.gather_dtype == "bfloat16"),
+        mesh=mesh)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "n_padded", "rank"))
@@ -1241,7 +1369,7 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
                                                     blk)
         return lambda fixed: _update_side(
             fixed, layout["idx"], layout["val"], layout["cnt"], params,
-            blk)
+            blk, mesh)
 
     step_u = _stepper(user_h, uh)
     step_i = _stepper(item_h, ih)
@@ -1318,6 +1446,8 @@ def _serve_topk(user_factors: jax.Array, item_factors: jax.Array,
     were 4-5 separate dispatches, each a round trip through the device
     tunnel — fused, a query pays one dispatch and one fetch (measured:
     the per-query device path's p50 dropped ~4x)."""
+    # ptpu: allow[materialized-gather] — a [B, r] serving row fetch
+    # (no history axis): bounded by the micro-batcher's pow2 batch cap
     vecs = user_factors[idx]
     return _topk_scores(vecs, item_factors, k=k, n_items=n_items)
 
@@ -1352,6 +1482,9 @@ def _gather_rows_fn(mesh: Mesh):
     REPLICATED [B, r] block: the GSPMD-inserted collective that
     resolves a cross-shard user-row fetch (the ALX serving gather).
     Output replicated so the per-shard ranking can consume it."""
+    # ptpu: allow[materialized-gather] — [B, r] cross-shard row fetch
+    # bounded by the serving batch; the sharded table itself never
+    # materializes anywhere
     return jax.jit(lambda table, idx: table[idx],
                    out_shardings=NamedSharding(mesh, P()))
 

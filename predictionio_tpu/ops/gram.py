@@ -56,21 +56,50 @@ def gram_pairs(F: jax.Array, w: jax.Array,
     return jnp.stack([A0, A1], axis=-3).reshape(*lead, n, r, r)
 
 
+def _pair_padded(F: jax.Array, w: jax.Array, bf16: bool) -> jax.Array:
+    """:func:`gram_pairs` for ANY row count: an odd batch is padded
+    with one zero row (its gram is exactly zero) and sliced back. This
+    is the ONE place odd-row handling lives — callers never assert
+    evenness themselves (callers used to silently fall back to the
+    einsum path on odd B, so the measured pair win evaporated on any
+    odd tail block)."""
+    n = F.shape[-3]
+    if n % 2 == 0:
+        return gram_pairs(F, w, bf16=bf16)
+    padF = [(0, 0)] * F.ndim
+    padF[-3] = (0, 1)
+    padw = [(0, 0)] * w.ndim
+    padw[-2] = (0, 1)
+    out = gram_pairs(jnp.pad(F, padF), jnp.pad(w, padw), bf16=bf16)
+    return out[..., :n, :, :]
+
+
 def gram_dispatch(F: jax.Array, w: jax.Array, mode: str,
                   bf16: bool = False) -> jax.Array:
-    """``mode``: "einsum" (baseline), "pair", or "auto".
+    """``mode``: "einsum" (baseline), "pair", "fused", or "auto".
 
     "auto" resolves through the persistent shape-keyed table
     (:mod:`.gram_autotune`): measured winners recorded by the bench's
     gram race / ``gram_profile.py --record``, then packaged defaults,
     then an MXU-tile-occupancy heuristic. The resolution happens at
     trace time (mode and shapes are static), so the choice costs
-    nothing at run time."""
+    nothing at run time.
+
+    "fused" here means the caller materialized the gather before
+    dispatching — with ``F`` already in hand there is nothing left to
+    fuse, so it degrades to the baseline einsum. The fused entry point
+    is ``models/als.py::_lhs_fn`` (table + indices, via
+    :mod:`.fused_gram`), which intercepts the mode BEFORE the gather
+    exists; landing here is the documented fallback for layouts the
+    kernel doesn't cover (L-axis-sharded skinny buckets).
+
+    Odd row counts are handled HERE (pad-and-slice, :func:`_pair_padded`)
+    — "pair" applies to any B."""
     if mode == "auto":
         from .gram_autotune import best_mode
 
         mode = best_mode(F.shape[-1], bf16=bf16)
-        if mode == "pair" and F.shape[-3] % 2 == 0:
+        if mode == "pair":
             # the autotuned winner describes the ACCELERATOR; on a CPU
             # lowering of the same trace (virtual-mesh dryruns on hosts
             # where the TPU plugin is the default backend) pair's 2x
@@ -78,11 +107,11 @@ def gram_dispatch(F: jax.Array, w: jax.Array, mode: str,
             # mirroring solve.py's platform gate
             return jax.lax.platform_dependent(
                 F, w,
-                tpu=lambda F, w: gram_pairs(F, w, bf16=bf16),
+                tpu=lambda F, w: _pair_padded(F, w, bf16=bf16),
                 default=lambda F, w: gram_weighted(F, w, bf16=bf16))
         return gram_weighted(F, w, bf16=bf16)
-    if mode == "pair" and F.shape[-3] % 2 == 0:
-        return gram_pairs(F, w, bf16=bf16)
+    if mode == "pair":
+        return _pair_padded(F, w, bf16=bf16)
     return gram_weighted(F, w, bf16=bf16)
 
 

@@ -96,13 +96,39 @@ def _table() -> dict:
         return dict(_cache_mem)
 
 
+#: the gram realizations an autotune entry may name (``ops/gram.py``
+#: einsum/pair on a materialized gather; ``ops/fused_gram.py`` for the
+#: gather-fusing Pallas kernel)
+MODES = ("einsum", "pair", "fused")
+
+
+def _fused_lowers() -> bool:
+    """Whether the fused Pallas kernel can actually lower on the
+    attached backend — a tuning table measured on one machine may name
+    "fused" on a host whose jax/Mosaic build can't compile it (or with
+    no accelerator at all); resolution must DEGRADE, never raise."""
+    try:
+        from .fused_gram import fused_gram_supported
+
+        return fused_gram_supported()
+    except Exception:  # noqa: BLE001 — probe failure = unsupported
+        return False
+
+
 def best_mode(rank: int, bf16: bool = False,
               device_kind: str | None = None) -> str:
-    """Concrete gram mode ("einsum" | "pair") for ``gram_mode="auto"``."""
+    """Concrete gram mode ("einsum" | "pair" | "fused") for
+    ``gram_mode="auto"``. A table entry naming "fused" is honored only
+    where the Pallas kernel lowers (:func:`_fused_lowers`); everywhere
+    else it falls back to the baseline einsum instead of raising —
+    the tuning table describes a *preference*, not a capability."""
     fam = device_family(device_kind)
     ent = _table().get(_key(fam, rank, bf16))
-    if isinstance(ent, dict) and ent.get("mode") in ("einsum", "pair"):
-        return ent["mode"]
+    if isinstance(ent, dict) and ent.get("mode") in MODES:
+        mode = ent["mode"]
+        if mode == "fused" and not _fused_lowers():
+            return "einsum"
+        return mode
     # heuristic: pair-packing helps exactly when two systems fit one
     # 128-wide MXU tile; CPUs/GPUs gain nothing from the extra flops
     if fam.startswith("TPU") and _rank_bucket(rank) < 128:
@@ -117,7 +143,7 @@ def record(rank: int, mode: str, bf16: bool = False,
     concurrent processes tuning different shapes don't clobber).
     Returns whether anything was persisted — callers reporting
     "recorded" must not claim success for a refused write."""
-    if mode not in ("einsum", "pair"):
+    if mode not in MODES:
         return False
     fam = device_family(device_kind)
     if fam in ("unknown", "cpu"):

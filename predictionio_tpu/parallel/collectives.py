@@ -44,6 +44,34 @@ def all_reduce_sum(x: jax.Array, axis: Axis = MODEL_AXIS) -> jax.Array:
     return lax.psum(x, axis)
 
 
+def gramian_allreduce(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """``xᵀx`` of a row-sharded ``[n, r]`` table as an EXPLICIT
+    per-shard partial + ICI psum, replicated out.
+
+    The fused-gram training path (``models/als.py::_fixed_gramian``)
+    uses this instead of the plain einsum so the all-reduce is a
+    structurally independent node: every update block's Pallas kernel
+    builds its observed-entry system without touching G (the baseline
+    Gramian is added to the kernel OUTPUT), which frees XLA's
+    latency-hiding scheduler to run this collective on ICI underneath
+    the next virtual-row block's gather DMAs and kernel launch rather
+    than serializing each half-iteration behind it — the compute/
+    collective overlap ALX builds its sharded trainer around
+    (arXiv 2112.02194). Axis names come from the mesh, so the same
+    program runs over a ``(data, model)`` training mesh and a
+    ``(batch, model)`` serving mesh."""
+    axes = tuple(mesh.axis_names)
+
+    def part(t):
+        return lax.psum(
+            jax.lax.dot_general(t, t, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32),
+            axes)
+
+    return shard_map_compat(part, mesh, in_specs=P(axes),
+                            out_specs=P(), check=False)(x)
+
+
 def all_gather(x: jax.Array, axis: Axis = MODEL_AXIS,
                *, tiled: bool = True) -> jax.Array:
     """Gather shards along the leading dim (NCCL allgather role)."""

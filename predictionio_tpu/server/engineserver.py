@@ -292,6 +292,9 @@ class QueryServer:
             "pio_serving_warm",
             "1 once the serving shapes are pre-compiled",
             fn=lambda: 1.0 if self.warm_done.is_set() else 0.0)
+        # the initial _bind ran before this registry existed; record
+        # the resolved gram mode now (rebinds re-record inside _bind)
+        self._record_gram_mode()
         if self.cache is not None:
             self.cache.register_metrics(self.metrics)
         if locks_instrumented():
@@ -374,6 +377,7 @@ class QueryServer:
             self.models = [a.prepare_serving_model(m, bind_batch)
                            for a, m in zip(self.algorithms, models)]
             self.serving = self.engine.make_serving(engine_params)
+            self._record_gram_mode()
             # mesh-wide placement (ISSUE 6): resolve the serving mode
             # against the live devices and the model's resident bytes,
             # then either fan the binding out as per-device lane copies
@@ -382,6 +386,42 @@ class QueryServer:
             # a promote/reload swaps mode, mesh, lanes and models as
             # one unit — queries never see a half-placed binding.
             self._place_binding()
+
+    # ptpu: guarded-by[_lock] — only ever called from _bind under the
+    # binding lock (the gauge family itself is thread-safe)
+    def _record_gram_mode(self) -> None:
+        """Refresh the ``pio_gram_mode`` info gauge (ISSUE 7) from the
+        bound algorithms' ALS params: the weighted-gram realization
+        they resolve to on THIS backend (autotune table + Pallas
+        lowering support, ``models/als.resolved_gram_mode``) reads 1;
+        a label a rebind left behind drops to 0 — a retrain/deploy
+        that silently fell off the fused kernel is visible on
+        /metrics, not just in bench lines. The very first _bind runs
+        before __init__ creates the registry — __init__ re-records
+        right after; rebinds find it in place."""
+        if getattr(self, "metrics", None) is None:
+            return  # constructor's initial _bind; __init__ re-records
+        try:
+            from ..models.als import resolved_gram_mode
+
+            mode = None
+            for algo in self.algorithms:
+                p = getattr(algo, "params", None)
+                if p is not None and hasattr(p, "gram_mode"):
+                    mode = resolved_gram_mode(p)
+                    break
+            if mode is None:
+                return
+            fam = self.metrics.gauge(
+                "pio_gram_mode",
+                "Resolved ALS gram realization of the bound engine "
+                "params (info gauge: 1 at the active mode label)")
+            self._gram_mode_gauge = fam
+            for _, child in fam.children():
+                child.set(0.0)
+            fam.labels(mode=mode).set(1.0)
+        except Exception:  # noqa: BLE001 — telemetry must not block a
+            pass           # deploy/reload/promote
 
     @staticmethod
     def _models_nbytes(models: List[Any]) -> Optional[int]:

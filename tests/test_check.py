@@ -387,6 +387,79 @@ class TestPragmaGeneral:
         assert rules_of(check_source(code, path=COLD)) == ["config-drift"]
 
 
+class TestMaterializedGather:
+    """`table[indices]` advanced-indexing gathers inside jitted
+    train/serve hot-path functions (ISSUE 7): the [B, L, r]-shaped HBM
+    temps behind the BENCH_r05 roofline bound."""
+
+    def test_positive_jitted_gather(self):
+        code = src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def half_step(table, indices, w, k):
+                F = table[indices]
+                return (F * w[..., None]).sum(-2)
+        """)
+        assert rules_of(check_source(code, path=COLD)) \
+            == ["materialized-gather"]
+
+    def test_positive_jit_of_lambda(self):
+        code = src("""
+            import jax
+
+            def make(table):
+                return jax.jit(lambda tab, idx: tab[idx])
+        """)
+        assert rules_of(check_source(code, path=COLD)) \
+            == ["materialized-gather"]
+
+    def test_negative_unjitted_host_helper(self):
+        # host-side numpy gathers pay once, not per dispatch
+        code = src("""
+            import numpy as np
+
+            def pack(table, indices):
+                return np.asarray(table)[indices]
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_static_index_and_scatter_builder(self):
+        code = src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("sel",))
+            def pick(table, acc, ids, sel):
+                part = table[sel]                 # static: no temp
+                return acc.at[ids].add(part)      # scatter, not gather
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_outside_hot_packages(self):
+        code = src("""
+            import jax
+
+            @jax.jit
+            def gather(table, indices):
+                return table[indices]
+        """)
+        assert check_source(code,
+                            path="predictionio_tpu/rollout/x.py") == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax
+
+            @jax.jit
+            def serve(table, idx):
+                # ptpu: allow[materialized-gather] — [B, r] row fetch
+                return table[idx]
+        """)
+        assert check_source(code, path=COLD) == []
+
+
 class TestRepoWide:
     def test_package_is_clean(self):
         findings = run_check([PKG])
@@ -400,6 +473,7 @@ class TestRepoWide:
         assert set(RULES) == {
             "host-sync-in-hot-path", "recompile-hazard",
             "missing-donation", "sharding-mismatch", "config-drift",
+            "materialized-gather",
             "unguarded-shared-state", "lock-order-inversion",
             "blocking-under-lock", "callback-under-lock"}
 
