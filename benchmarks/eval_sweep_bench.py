@@ -21,6 +21,9 @@ def main() -> None:
     n_events = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
     import jax
 
+    # ptpu: allow[config-drift] — standalone bench entrypoint pinning
+    # the platform before any framework import, same job as
+    # force_cpu_if_requested (no library code runs before this line)
     jax.config.update("jax_platforms", "cpu")
 
     from predictionio_tpu.controller.context import Context

@@ -2,28 +2,42 @@
 
 Public surface:
 
-- :func:`run_check` / :func:`check_source` — run the rule suite over
-  paths or a source blob, returning :class:`Finding`\\ s. Module rules
-  run per file; project rules (the cross-file lock-order graph) run
-  once over the whole parsed set.
-- :data:`RULES` — the rule registry (name → :class:`Rule`): five JAX
-  rules plus the concurrency family (:mod:`.concurrency`).
+- :func:`run_check` / :func:`check_source` / :func:`check_project` —
+  run the rule suite over paths, a source blob, or an in-memory
+  multi-module project, returning :class:`Finding`\\ s. Module rules
+  run per file; project rules (the cross-file lock-order graph, the
+  interprocedural summary consumers) run once over the whole parsed
+  set, against the :class:`~.core.ProjectIndex` call graph.
+- :data:`RULES` — the rule registry (name → :class:`Rule`): six JAX
+  rules, the concurrency family (:mod:`.concurrency`), and the Pallas
+  kernel-safety family (:mod:`.kernels`).
 - :func:`findings_to_json` / :func:`findings_to_sarif` — machine
-  output (:mod:`.report`); SARIF feeds GitHub code-scanning.
+  output (:mod:`.report`); SARIF feeds GitHub code-scanning, with
+  interprocedural call chains as ``relatedLocations``.
 - :func:`write_baseline` / :func:`load_baseline` /
-  :func:`new_findings` — gate CI on *no new findings*
+  :func:`new_findings` / :func:`shrinkable_entries` — gate CI on *no
+  new findings* and ratchet the recorded debt monotonically down
   (:mod:`.baseline`).
 - ``# ptpu: allow[rule] — why`` pragmas suppress a finding on that line
   or via the comment block directly above; ``# ptpu: guarded-by[lock]``
-  is the lock-contract annotation ``unguarded-shared-state`` honors.
+  is the lock-contract annotation ``unguarded-shared-state`` honors. A
+  pragma at an effect's direct site also stops interprocedural
+  propagation (blessing the one named helper blesses its callers).
 
 See ``docs/static-analysis.md`` for the operator-facing rule catalogue.
 """
 
-from .baseline import load_baseline, new_findings, write_baseline
+from .baseline import (
+    load_baseline,
+    new_findings,
+    shrinkable_entries,
+    write_baseline,
+)
 from .core import (
     CheckContext,
     Finding,
+    ProjectIndex,
+    check_project,
     check_source,
     default_context,
     iter_py_files,
@@ -35,8 +49,10 @@ from .rules import RULES, Rule
 __all__ = [
     "CheckContext",
     "Finding",
+    "ProjectIndex",
     "RULES",
     "Rule",
+    "check_project",
     "check_source",
     "default_context",
     "findings_to_json",
@@ -45,5 +61,6 @@ __all__ = [
     "load_baseline",
     "new_findings",
     "run_check",
+    "shrinkable_entries",
     "write_baseline",
 ]

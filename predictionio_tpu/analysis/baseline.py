@@ -7,9 +7,14 @@ burns them down without blocking the gate:
 - ``ptpu check --baseline findings.json --write-baseline`` records the
   current findings;
 - ``ptpu check --baseline findings.json`` then fails ONLY on findings
-  not in the baseline — pre-existing debt passes, regressions don't;
-- as debt is paid down, re-write the baseline (shrinking it is always
-  safe; CI can diff the file to prove the burn-down is monotone).
+  not in the baseline — pre-existing debt passes, regressions don't —
+  and prints the entries the run no longer reproduces
+  (:func:`shrinkable_entries`), so paid-down debt is visible;
+- ``--write-baseline`` against an EXISTING baseline auto-tightens: it
+  only ever removes or decrements entries (the ratchet — CI re-runs
+  it every build, so the recorded debt is monotone non-increasing);
+  recording genuinely new debt (enabling a new rule) needs the
+  explicit ``--baseline-grow`` flag.
 
 Findings are keyed by ``(path, rule, message)`` — deliberately NOT by
 line, so unrelated edits that shift code don't resurrect baselined
@@ -20,7 +25,7 @@ baselined finding in the same file still fails.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding
 
@@ -40,10 +45,17 @@ def _counts(findings: Sequence[Finding]) -> Dict[Key, int]:
     return out
 
 
-def write_baseline(path: str, findings: Sequence[Finding]) -> int:
+def write_baseline(path: str, findings: Sequence[Finding],
+                   cap: Optional[Dict[Key, int]] = None) -> int:
     """Persist the current findings as the accepted debt; returns how
-    many entries were recorded."""
+    many entries were recorded. With ``cap`` (the previously recorded
+    baseline) the write RATCHETS: every entry is clamped to
+    ``min(current, recorded)`` and keys the old baseline never held
+    are dropped — the file can only shrink, never absorb new debt."""
     counts = _counts(findings)
+    if cap is not None:
+        counts = {k: min(c, cap[k])
+                  for k, c in counts.items() if k in cap}
     doc = {
         "version": BASELINE_VERSION,
         "entries": [
@@ -85,3 +97,17 @@ def new_findings(findings: Sequence[Finding],
         else:
             out.append(f)
     return out
+
+
+def shrinkable_entries(findings: Sequence[Finding],
+                       baseline: Dict[Key, int]
+                       ) -> List[Tuple[Key, int, int]]:
+    """Baseline entries the current run under-fills: ``(key,
+    recorded, actual)`` with ``actual < recorded`` — the debt that has
+    been paid down and can ratchet out of the file (sorted for stable
+    output)."""
+    counts = _counts(findings)
+    out = [(k, rec, counts.get(k, 0))
+           for k, rec in baseline.items()
+           if counts.get(k, 0) < rec]
+    return sorted(out)

@@ -46,7 +46,15 @@ import os
 import re
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
-from .core import CheckContext, Finding, ModuleInfo
+from .core import (
+    CheckContext,
+    Finding,
+    ModuleInfo,
+    chain_related,
+    chain_text,
+    short_name,
+    strongly_connected,
+)
 
 #: what makes a name "a lock" for the with-scope rules
 LOCK_NAME_RE = re.compile(r"lock|mutex", re.IGNORECASE)
@@ -268,10 +276,13 @@ def rule_unguarded_shared_state(mod: ModuleInfo,
 # shared with-scope walker (lock-order / blocking / callback rules)
 # ---------------------------------------------------------------------------
 
-def _lock_node_name(mod: ModuleInfo, expr: ast.AST,
-                    class_name: Optional[str]) -> Optional[str]:
+def lock_expr_name(mod: ModuleInfo, expr: ast.AST,
+                   class_name: Optional[str]) -> Optional[str]:
     """Canonical cross-file name for a lock expression in a ``with``
-    item, or None when the expression is not lock-like."""
+    item, or None when the expression is not lock-like. Shared with
+    the interprocedural acquires-locks summaries
+    (:class:`~.core.ProjectIndex`), so call-through acquisition edges
+    land on the same graph nodes as syntactic nesting."""
     attr = _self_attr(expr)
     if attr is not None:
         if LOCK_NAME_RE.search(attr):
@@ -322,8 +333,8 @@ class _WithScopeWalker:
             h = list(held)
             for item in node.items:
                 self._visit(item.context_expr, h, class_name)
-                name = _lock_node_name(self.mod, item.context_expr,
-                                       class_name)
+                name = lock_expr_name(self.mod, item.context_expr,
+                                      class_name)
                 if name is not None:
                     if self.on_edge is not None:
                         for prior in h:
@@ -334,7 +345,7 @@ class _WithScopeWalker:
             self._visit_block(node.body, h, class_name)
             return
         if self.on_node is not None and held:
-            self.on_node(node, held)
+            self.on_node(node, held, class_name)
         for child in ast.iter_child_nodes(node):
             self._visit(child, held, class_name)
 
@@ -343,73 +354,49 @@ class _WithScopeWalker:
 # rule: lock-order-inversion (project-scoped)
 # ---------------------------------------------------------------------------
 
-def _strongly_connected(nodes: Set[str],
-                        edges: Dict[str, Set[str]]) -> List[Set[str]]:
-    """Tarjan SCCs (iterative), smallest-first for determinism."""
-    index: Dict[str, int] = {}
-    low: Dict[str, int] = {}
-    on_stack: Set[str] = set()
-    stack: List[str] = []
-    sccs: List[Set[str]] = []
-    counter = [0]
-
-    for root in sorted(nodes):
-        if root in index:
-            continue
-        work: List[Tuple[str, int]] = [(root, 0)]
-        while work:
-            node, pi = work[-1]
-            if pi == 0:
-                index[node] = low[node] = counter[0]
-                counter[0] += 1
-                stack.append(node)
-                on_stack.add(node)
-            advanced = False
-            succs = sorted(edges.get(node, ()))
-            for i in range(pi, len(succs)):
-                s = succs[i]
-                if s not in index:
-                    work[-1] = (node, i + 1)
-                    work.append((s, 0))
-                    advanced = True
-                    break
-                if s in on_stack:
-                    low[node] = min(low[node], index[s])
-            if advanced:
-                continue
-            work.pop()
-            if work:
-                parent = work[-1][0]
-                low[parent] = min(low[parent], low[node])
-            if low[node] == index[node]:
-                scc: Set[str] = set()
-                while True:
-                    w = stack.pop()
-                    on_stack.discard(w)
-                    scc.add(w)
-                    if w == node:
-                        break
-                sccs.append(scc)
-    return sccs
-
-
 def rule_lock_order_inversion(mods: Sequence[ModuleInfo],
                               ctx: CheckContext) -> List[Finding]:
+    """Cycles in the acquisition graph. Edges come from two sources:
+    syntactic nesting (``with a:`` containing ``with b:``) and — via
+    the interprocedural acquires-locks summaries — calls made while a
+    lock is held into functions that (transitively) acquire another
+    lock: ``with a: self._refill()`` where ``_refill`` takes ``b`` is
+    an a→b edge even though no ``with b:`` is lexically in sight."""
     edges: Dict[str, Set[str]] = {}
     sites: Dict[Tuple[str, str], Tuple[str, int, int]] = {}
+    proj = ctx.project
+
+    def add_edge(src: str, dst: str, path: str, line: int,
+                 col: int) -> None:
+        if src == dst:
+            return
+        edges.setdefault(src, set()).add(dst)
+        sites.setdefault((src, dst), (path, line, col))
 
     for mod in mods:
         def on_edge(src: str, dst: str, expr: ast.AST,
                     _mod: ModuleInfo = mod) -> None:
-            edges.setdefault(src, set()).add(dst)
-            sites.setdefault((src, dst),
-                             (_mod.path, expr.lineno, expr.col_offset))
+            add_edge(src, dst, _mod.path, expr.lineno, expr.col_offset)
 
-        _WithScopeWalker(mod, on_edge=on_edge).run()
+        def on_node(node: ast.AST, held: List[str],
+                    class_name: Optional[str],
+                    _mod: ModuleInfo = mod) -> None:
+            if proj is None or not isinstance(node, ast.Call):
+                return
+            qname, _ = proj.resolve_call(_mod, class_name, node.func)
+            callee = proj.functions.get(qname or "")
+            if callee is None:
+                return
+            for acq in callee.acquires:
+                for prior in held:
+                    add_edge(prior, acq, _mod.path, node.lineno,
+                             node.col_offset)
+
+        _WithScopeWalker(mod, on_edge=on_edge, on_node=on_node).run()
 
     nodes = set(edges) | {d for ds in edges.values() for d in ds}
     findings: List[Finding] = []
-    for scc in _strongly_connected(nodes, edges):
+    for scc in strongly_connected(nodes, edges):
         if len(scc) < 2:
             continue
         internal = sorted(
@@ -436,8 +423,37 @@ def rule_lock_order_inversion(mods: Sequence[ModuleInfo],
 def _storage_chain(resolved: Optional[str]) -> bool:
     if not resolved:
         return False
+    # a Capitalized tail is a class constructor (data.storage.Model),
+    # not an I/O call — building the record doesn't touch the backend
+    if resolved.split(".")[-1][:1].isupper():
+        return False
     return any(seg in ("storage", "_storage")
                for seg in resolved.split("."))
+
+
+def blocking_reason(mod: ModuleInfo, node: ast.Call) -> Optional[str]:
+    """Why this call blocks, or None — the shared predicate behind the
+    direct rule and the interprocedural blocks summaries
+    (:class:`~.core.ProjectIndex`)."""
+    resolved = mod.resolve(node.func)
+    if resolved in BLOCKING_EXACT:
+        return BLOCKING_EXACT[resolved]
+    if resolved:
+        for prefix, reason in BLOCKING_PREFIXES:
+            if resolved.startswith(prefix):
+                return reason
+        if _storage_chain(resolved):
+            return ("storage/event-store I/O under a lock serializes "
+                    "every waiter on the backend")
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in ("block_until_ready", "urlopen") \
+                or (attr == "join" and not node.args
+                    and not node.keywords) \
+                or attr in ("wait", "result"):
+            return BLOCKING_METHOD_ATTRS.get(
+                attr, "blocking call while a lock is held")
+    return None
 
 
 def rule_blocking_under_lock(mod: ModuleInfo,
@@ -446,31 +462,14 @@ def rule_blocking_under_lock(mod: ModuleInfo,
         return []
     findings: List[Finding] = []
     seen: Set[int] = set()
+    proj = ctx.project
 
-    def on_node(node: ast.AST, held: List[str]) -> None:
+    def on_node(node: ast.AST, held: List[str],
+                class_name: Optional[str]) -> None:
         if not isinstance(node, ast.Call) or id(node) in seen:
             return
         seen.add(id(node))
-        resolved = mod.resolve(node.func)
-        why = None
-        if resolved in BLOCKING_EXACT:
-            why = BLOCKING_EXACT[resolved]
-        elif resolved:
-            for prefix, reason in BLOCKING_PREFIXES:
-                if resolved.startswith(prefix):
-                    why = reason
-                    break
-            if why is None and _storage_chain(resolved):
-                why = ("storage/event-store I/O under a lock "
-                       "serializes every waiter on the backend")
-        if why is None and isinstance(node.func, ast.Attribute):
-            attr = node.func.attr
-            if attr in ("block_until_ready", "urlopen") \
-                    or (attr == "join" and not node.args
-                        and not node.keywords) \
-                    or attr in ("wait", "result"):
-                why = BLOCKING_METHOD_ATTRS.get(
-                    attr, "blocking call while a lock is held")
+        why = blocking_reason(mod, node)
         if why is not None:
             findings.append(Finding(
                 "blocking-under-lock", mod.path, node.lineno,
@@ -478,6 +477,28 @@ def rule_blocking_under_lock(mod: ModuleInfo,
                 f"blocking call while holding {'/'.join(held)}: {why}; "
                 f"snapshot state under the lock and do the slow work "
                 f"outside it"))
+            return
+        # interprocedural: the blocking call hides inside a helper —
+        # report the held-lock call site with the chain to the direct
+        # blocking site
+        if proj is None:
+            return
+        qname, _ = proj.resolve_call(mod, class_name, node.func)
+        callee = proj.functions.get(qname or "")
+        if callee is None or callee.effects["blocking"] is None:
+            return
+        hops = proj.chain(callee, "blocking")
+        if not hops:
+            return
+        findings.append(Finding(
+            "blocking-under-lock", mod.path, node.lineno,
+            node.col_offset,
+            f"calling `{short_name(callee.qname)}` while holding "
+            f"{'/'.join(held)} transitively blocks: "
+            f"{chain_text(hops)}; snapshot state under the lock and "
+            f"do the slow work outside it (or pragma the helper's "
+            f"blocking site if it is the blessed shape)",
+            related=chain_related(hops)))
 
     _WithScopeWalker(mod, on_node=on_node).run()
     return findings
@@ -529,11 +550,21 @@ def rule_callback_under_lock(mod: ModuleInfo,
     findings: List[Finding] = []
     seen: Set[int] = set()
 
+    proj = ctx.project
+    owners: Dict[int, str] = {}
+    for cls in ast.walk(mod.tree):
+        if isinstance(cls, ast.ClassDef):
+            for sub in cls.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    owners[id(sub)] = cls.name
+
     # walk per function scope so each scope's dynamically-bound names
     # are in force; _WithScopeWalker supplies the held-lock context
     for fn, dynamic in _function_scopes(mod.tree):
 
         def on_node(node: ast.AST, held: List[str],
+                    class_name: Optional[str],
                     _dynamic: Set[str] = dynamic) -> None:
             if not isinstance(node, ast.Call) or id(node) in seen:
                 return
@@ -548,7 +579,8 @@ def rule_callback_under_lock(mod: ModuleInfo,
                     f"callee can re-enter and deadlock — snapshot "
                     f"under the lock, call outside it (the "
                     f"invalidation-bus publish pattern)"))
-            elif isinstance(node.func, ast.Attribute) \
+                return
+            if isinstance(node.func, ast.Attribute) \
                     and node.func.attr in CALLBACK_ATTRS:
                 seen.add(id(node))
                 findings.append(Finding(
@@ -559,9 +591,57 @@ def rule_callback_under_lock(mod: ModuleInfo,
                     f"subscriber that takes the same lock (or "
                     f"publishes back) deadlocks — move the delivery "
                     f"outside the critical section"))
+                return
+            if proj is None:
+                return
+            # interprocedural: (a) the delivery hides inside a helper;
+            # (b) a dynamically-bound callable is PASSED into a helper
+            # that invokes its argument — either way the foreign code
+            # runs with this lock held
+            qname, bound = proj.resolve_call(mod, class_name,
+                                             node.func)
+            callee = proj.functions.get(qname or "")
+            if callee is None:
+                return
+            if callee.effects["callback"] is not None:
+                hops = proj.chain(callee, "callback")
+                if hops:
+                    seen.add(id(node))
+                    findings.append(Finding(
+                        "callback-under-lock", mod.path, node.lineno,
+                        node.col_offset,
+                        f"calling `{short_name(callee.qname)}` while "
+                        f"holding {'/'.join(held)} transitively "
+                        f"delivers to subscribers/plugins: "
+                        f"{chain_text(hops)}; snapshot under the "
+                        f"lock, deliver outside it",
+                        related=chain_related(hops)))
+                    return
+            if not callee.call_sinks:
+                return
+            off = 1 if bound else 0
+            for i, a in enumerate(node.args):
+                pos = i + off
+                passed_dynamic = (
+                    (isinstance(a, ast.Name) and a.id in _dynamic)
+                    or isinstance(a, ast.Lambda))
+                if passed_dynamic and pos in callee.call_sinks:
+                    seen.add(id(node))
+                    hops = proj.sink_chain(callee, "call", pos)
+                    findings.append(Finding(
+                        "callback-under-lock", mod.path, node.lineno,
+                        node.col_offset,
+                        f"passing a dynamically-bound callable into "
+                        f"`{short_name(callee.qname)}` while holding "
+                        f"{'/'.join(held)} — the helper invokes it "
+                        f"with the lock held: {chain_text(hops)}; "
+                        f"snapshot under the lock, call outside it",
+                        related=chain_related(hops)))
+                    return
 
         walker = _WithScopeWalker(mod, on_node=on_node)
         # held state starts fresh inside fn (function boundaries reset
-        # acquisition context)
-        walker._visit_block([fn], [], None)
+        # acquisition context); the owning class rides along so
+        # self-method calls resolve in the project index
+        walker._visit_block([fn], [], owners.get(id(fn)))
     return findings

@@ -8,7 +8,10 @@ stays the human surface; this module adds:
 - ``sarif`` — SARIF 2.1.0, the format GitHub code scanning ingests, so
   a CI run of ``ptpu check --format sarif`` annotates the PR diff with
   each finding at its exact line (upload with
-  ``github/codeql-action/upload-sarif``).
+  ``github/codeql-action/upload-sarif``). Interprocedural findings
+  carry their call chain as ``relatedLocations`` — the code-scanning
+  UI walks from the hot call site down to the helper's direct
+  violation.
 """
 
 from __future__ import annotations
@@ -24,12 +27,17 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
 
 
 def findings_to_json(findings: Sequence[Finding]) -> str:
+    def one(f: Finding) -> dict:
+        d = {"rule": f.rule, "path": f.path, "line": f.line,
+             "col": f.col, "message": f.message}
+        if f.related:
+            d["related"] = [{"path": p, "line": ln, "note": note}
+                            for p, ln, note in f.related]
+        return d
+
     return json.dumps({
         "count": len(findings),
-        "findings": [
-            {"rule": f.rule, "path": f.path, "line": f.line,
-             "col": f.col, "message": f.message}
-            for f in findings],
+        "findings": [one(f) for f in findings],
     }, indent=2, sort_keys=True)
 
 
@@ -51,27 +59,36 @@ def findings_to_sarif(findings: Sequence[Finding],
                        "static-analysis.md",
         })
     index = {rid: i for i, rid in enumerate(rule_ids)}
+
+    def physical(path: str, line: int, col: int) -> dict:
+        return {
+            "artifactLocation": {
+                "uri": path.replace("\\", "/"),
+                "uriBaseId": "%SRCROOT%",
+            },
+            "region": {
+                "startLine": max(line, 1),
+                # SARIF columns are 1-based; ast's are 0-based
+                "startColumn": col + 1,
+            },
+        }
+
     results = []
     for f in findings:
-        results.append({
+        result = {
             "ruleId": f.rule,
             "ruleIndex": index[f.rule],
             "level": "error",
             "message": {"text": f.message},
-            "locations": [{
-                "physicalLocation": {
-                    "artifactLocation": {
-                        "uri": f.path.replace("\\", "/"),
-                        "uriBaseId": "%SRCROOT%",
-                    },
-                    "region": {
-                        "startLine": max(f.line, 1),
-                        # SARIF columns are 1-based; ast's are 0-based
-                        "startColumn": f.col + 1,
-                    },
-                },
-            }],
-        })
+            "locations": [{"physicalLocation":
+                           physical(f.path, f.line, f.col)}],
+        }
+        if f.related:
+            result["relatedLocations"] = [
+                {"physicalLocation": physical(p, ln, 0),
+                 "message": {"text": note}}
+                for p, ln, note in f.related]
+        results.append(result)
     doc = {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,

@@ -4,9 +4,17 @@ This module holds the six JAX rules and assembles the full registry
 (:data:`RULES`), which also includes the concurrency rule family from
 :mod:`.concurrency` (``unguarded-shared-state``,
 ``lock-order-inversion``, ``blocking-under-lock``,
-``callback-under-lock``).
+``callback-under-lock``) and the Pallas kernel-safety family from
+:mod:`.kernels` (``vmem-overbudget``, ``dma-unwaited``,
+``low-precision-accumulator``, ``missing-interpret-fallback``).
 
-The JAX rules, each an AST pass over one :class:`~.core.ModuleInfo`:
+``host-sync-in-hot-path`` and ``materialized-gather`` are
+project-scoped: beyond their direct per-module passes they consult the
+interprocedural effect summaries (:class:`~.core.ProjectIndex`) so a
+violation hidden inside a helper — any number of calls away — is
+reported at the hot-path call site with the call chain in the message.
+
+The JAX rules:
 
 - ``host-sync-in-hot-path`` — device→host landings (``np.asarray``,
   ``.item()``, ``.tolist()``, ``jax.device_get``,
@@ -31,12 +39,14 @@ The JAX rules, each an AST pass over one :class:`~.core.ModuleInfo`:
   ``ppermute``/``axis_index``/…) that no mesh builder in
   ``parallel/mesh.py`` declares; XLA only reports these at trace time
   on a real mesh, usually mid-deploy.
-- ``materialized-gather`` — ``table[indices]`` advanced-indexing
-  gathers by a caller-supplied index array inside ``models/``/
-  ``ops/``/``server/`` functions: XLA materializes the gathered rows
-  as an HBM temp sized by the index shape (the ``[B, L, r]`` ALS
-  gather temp behind BENCH_r05's 75%-HBM/0.6%-MFU roofline); fuse it
-  (``gram_mode="fused"``), bound it, or pragma a size case.
+- ``materialized-gather`` — ``table[indices]`` advanced-indexing and
+  ``jnp.take``/``jnp.take_along_axis`` gathers by a caller-supplied
+  index array inside ``models/``/``ops/``/``server/`` functions
+  (directly, or through a helper the traced index flows into): XLA
+  materializes the gathered rows as an HBM temp sized by the index
+  shape (the ``[B, L, r]`` ALS gather temp behind BENCH_r05's
+  75%-HBM/0.6%-MFU roofline); fuse it (``gram_mode="fused"``), bound
+  it, or pragma a size case.
 - ``config-drift`` — ``jax.config.update`` outside
   ``utils/platform.py``: scattered config flips make process behavior
   depend on import order (exactly the class of bug
@@ -54,7 +64,14 @@ import ast
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from .core import CheckContext, Finding, ModuleInfo
+from .core import (
+    CheckContext,
+    Finding,
+    ModuleInfo,
+    chain_related,
+    chain_text,
+    short_name,
+)
 
 RuleFn = Callable[[ModuleInfo, CheckContext], List[Finding]]
 
@@ -101,44 +118,79 @@ def _in_hot_path(path: str) -> bool:
     return bool(set(parts[:-1]) & HOT_DIR_PARTS)
 
 
-def rule_host_sync(mod: ModuleInfo, ctx: CheckContext) -> List[Finding]:
-    if not _in_hot_path(mod.path):
-        return []
+def host_sync_reason(mod: ModuleInfo, node: ast.Call) -> Optional[str]:
+    """Why this call is a device→host sync, or None — the shared
+    predicate behind the direct rule and the interprocedural effect
+    summaries (:class:`~.core.ProjectIndex`)."""
+    name = mod.resolve(node.func)
+    if name in HOST_SYNC_CALLS:
+        return HOST_SYNC_CALLS[name]
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in HOST_SYNC_METHODS \
+            and not node.args and not node.keywords:
+        return HOST_SYNC_METHODS[node.func.attr]
+    if name in ("float", "int") and len(node.args) == 1 \
+            and isinstance(node.args[0], ast.Call):
+        inner = mod.resolve(node.args[0].func)
+        if inner and inner.startswith("jax.numpy."):
+            return (f"{name}() on a jnp result forces a blocking "
+                    f"device→host scalar read")
+    return None
+
+
+def rule_host_sync(mods: Sequence[ModuleInfo],
+                   ctx: CheckContext) -> List[Finding]:
+    """Project-scoped: direct syncs inside hot-package functions, plus
+    — through the call graph — hot-path calls into helpers (anywhere
+    in the project) that transitively sync, reported at the hot call
+    site with the chain down to the direct site. Helpers living in hot
+    packages are skipped here: their bodies already get the direct
+    finding."""
     findings: List[Finding] = []
-    seen: Set[int] = set()
-    funcs = [n for n in ast.walk(mod.tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-    for fn in funcs:
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call) or id(node) in seen:
-                continue
-            seen.add(id(node))
-            name = mod.resolve(node.func)
-            if name in HOST_SYNC_CALLS:
-                findings.append(Finding(
-                    "host-sync-in-hot-path", mod.path, node.lineno,
-                    node.col_offset,
-                    f"{HOST_SYNC_CALLS[name]} (in hot function "
-                    f"`{fn.name}`); keep the hot path device-resident "
-                    f"or pragma with justification"))
-            elif isinstance(node.func, ast.Attribute) \
-                    and node.func.attr in HOST_SYNC_METHODS \
-                    and not node.args and not node.keywords:
-                findings.append(Finding(
-                    "host-sync-in-hot-path", mod.path, node.lineno,
-                    node.col_offset,
-                    f"{HOST_SYNC_METHODS[node.func.attr]} (in hot "
-                    f"function `{fn.name}`)"))
-            elif name in ("float", "int") and len(node.args) == 1 \
-                    and isinstance(node.args[0], ast.Call):
-                inner = mod.resolve(node.args[0].func)
-                if inner and inner.startswith("jax.numpy."):
+    for mod in mods:
+        if not _in_hot_path(mod.path):
+            continue
+        seen: Set[int] = set()
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        for fn in funcs:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                why = host_sync_reason(mod, node)
+                if why is not None:
                     findings.append(Finding(
                         "host-sync-in-hot-path", mod.path, node.lineno,
                         node.col_offset,
-                        f"{name}() on a jnp result forces a blocking "
-                        f"device→host scalar read (in hot function "
-                        f"`{fn.name}`)"))
+                        f"{why} (in hot function `{fn.name}`); keep "
+                        f"the hot path device-resident or pragma with "
+                        f"justification"))
+    proj = ctx.project
+    if proj is None:
+        return findings
+    for fninfo in proj.functions.values():
+        if not fninfo.hot(HOT_DIR_PARTS):
+            continue
+        for call in fninfo.calls:
+            callee = proj.functions.get(call.callee or "")
+            if callee is None or callee.hot(HOT_DIR_PARTS):
+                continue
+            if callee.effects["host_sync"] is None:
+                continue
+            hops = proj.chain(callee, "host_sync")
+            if not hops:
+                continue
+            findings.append(Finding(
+                "host-sync-in-hot-path", fninfo.mod.path, call.line,
+                call.col,
+                f"calling `{short_name(callee.qname)}` from hot "
+                f"function `{short_name(fninfo.qname)}` transitively "
+                f"syncs device→host: {chain_text(hops)}; keep the hot "
+                f"path device-resident, or pragma the blessed helper "
+                f"at its direct site",
+                related=chain_related(hops)))
     return findings
 
 
@@ -561,9 +613,28 @@ def rule_sharding_mismatch(mod: ModuleInfo,
 #: problem, not with a constant
 MATGATHER_DIR_PARTS = {"models", "ops", "server"}
 
+#: gather-by-call forms that materialize exactly like advanced
+#: indexing (``jnp.take(table, idx)`` lowers to the same XLA gather);
+#: maps dotted name → positional index of the ``indices`` argument
+GATHER_CALLS = {
+    "jax.numpy.take": 1,
+    "jax.numpy.take_along_axis": 1,
+}
 
-def rule_materialized_gather(mod: ModuleInfo,
-                             ctx: CheckContext) -> List[Finding]:
+
+def _gather_finding(mod: ModuleInfo, node: ast.AST, desc: str,
+                    fname: str, idx_name: str) -> Finding:
+    return Finding(
+        "materialized-gather", mod.path, node.lineno, node.col_offset,
+        f"{desc} by the index array `{idx_name}` in hot function "
+        f"`{fname}` materializes the gathered rows as an HBM temp of "
+        f"unbounded size; bound it (row blocks), fuse it "
+        f"(gram_mode='fused', ops/fused_gram.py), or pragma with a "
+        f"size justification")
+
+
+def _module_materialized_gather(mod: ModuleInfo,
+                                ctx: CheckContext) -> List[Finding]:
     """``table[indices]`` advanced indexing by an index ARRAY inside
     train/serve hot-path functions.
 
@@ -581,15 +652,24 @@ def rule_materialized_gather(mod: ModuleInfo,
     both bare names, with the index a TRACED parameter of that jit
     site — a traced scalar would be a data-dependent-shape error, so a
     traced parameter used as a subscript is an index array and the
-    result is a device gather sized by the caller. ``x.at[i]``
+    result is a device gather sized by the caller. ``jnp.take`` /
+    ``jnp.take_along_axis`` on a traced-parameter index are the same
+    gather spelled as a call and are flagged identically. ``x.at[i]``
     scatter/update builders and tuple-literal subscripts (host
     dispatch tables) are excluded; host-side helpers are out of scope
-    (their gathers are numpy, paid once, not per dispatch)."""
+    (their gathers are numpy, paid once, not per dispatch).
+
+    The project pass (:func:`rule_materialized_gather`) additionally
+    flags a jitted function PASSING a traced parameter into a helper
+    that (transitively) uses that parameter position as a gather
+    index — the helper hides the subscript, the call site pays the
+    HBM temp."""
     parts = set(mod.path.split("/")[:-1])
     if not (parts & MATGATHER_DIR_PARTS):
         return []
     findings: List[Finding] = []
     seen: Set[int] = set()
+    proj = ctx.project
     collector = _collect_jit(mod)
     for site in collector.sites:
         fn = site.fn
@@ -602,32 +682,93 @@ def rule_materialized_gather(mod: ModuleInfo,
         fname = getattr(fn, "name", "<lambda>")
         for stmt in body:
             for node in ast.walk(stmt):
-                if not isinstance(node, ast.Subscript) \
-                        or id(node) in seen:
+                if id(node) in seen:
                     continue
-                if not isinstance(node.ctx, ast.Load):
+                if isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, ast.Load):
+                    idx = node.slice
+                    if not (isinstance(idx, ast.Name)
+                            and idx.id in params):
+                        continue
+                    val = node.value
+                    if not isinstance(val, (ast.Name, ast.Attribute)):
+                        continue  # (a, b)[i] host dispatch
+                    if isinstance(val, ast.Attribute) \
+                            and val.attr == "at":
+                        continue  # x.at[ids] is a scatter builder
+                    seen.add(id(node))
+                    vname = mod.resolve(val) or "<expr>"
+                    findings.append(_gather_finding(
+                        mod, node,
+                        f"advanced indexing `{vname}[{idx.id}]`",
+                        fname, idx.id))
                     continue
-                idx = node.slice
-                if not (isinstance(idx, ast.Name)
-                        and idx.id in params):
+                if not isinstance(node, ast.Call):
                     continue
-                val = node.value
-                if not isinstance(val, (ast.Name, ast.Attribute)):
-                    continue  # (a, b)[i] host dispatch, call results
-                if isinstance(val, ast.Attribute) and val.attr == "at":
-                    continue  # x.at[ids] is a scatter builder
+                resolved = mod.resolve(node.func)
+                pos = GATHER_CALLS.get(resolved or "")
+                if pos is not None:
+                    idx_arg = node.args[pos] \
+                        if len(node.args) > pos else None
+                    for kw in node.keywords:
+                        if kw.arg == "indices":
+                            idx_arg = kw.value
+                    if isinstance(idx_arg, ast.Name) \
+                            and idx_arg.id in params:
+                        seen.add(id(node))
+                        short = (resolved or "").rsplit(".", 1)[-1]
+                        findings.append(_gather_finding(
+                            mod, node, f"`jnp.{short}(…)`", fname,
+                            idx_arg.id))
+                    continue
+                # interprocedural: traced param flows into a helper's
+                # gather-index position
+                if proj is None or id(node) in seen:
+                    continue
+                qname, bound = proj.resolve_call(mod, None, node.func)
+                callee = proj.functions.get(qname or "")
+                if callee is None or not callee.index_sinks:
+                    continue
+                off = 1 if bound else 0
+                flow = None
+                for i, a in enumerate(node.args):
+                    if isinstance(a, ast.Name) and a.id in params \
+                            and (i + off) in callee.index_sinks:
+                        flow = (a.id, i + off)
+                        break
+                if flow is None:
+                    for kw in node.keywords:
+                        if kw.arg and kw.arg in callee.params \
+                                and isinstance(kw.value, ast.Name) \
+                                and kw.value.id in params:
+                            p = callee.params.index(kw.arg)
+                            if p in callee.index_sinks:
+                                flow = (kw.value.id, p)
+                                break
+                if flow is None:
+                    continue
                 seen.add(id(node))
-                vname = mod.resolve(val) or "<expr>"
+                idx_name, p = flow
+                hops = proj.sink_chain(callee, "index", p)
                 findings.append(Finding(
                     "materialized-gather", mod.path, node.lineno,
                     node.col_offset,
-                    f"advanced indexing `{vname}[{idx.id}]` by the "
-                    f"index array `{idx.id}` in hot function "
-                    f"`{fname}` materializes the gathered rows as an "
-                    f"HBM temp of unbounded size; bound it (row "
-                    f"blocks), fuse it (gram_mode='fused', "
-                    f"ops/fused_gram.py), or pragma with a size "
-                    f"justification"))
+                    f"traced index `{idx_name}` of jitted `{fname}` "
+                    f"flows into a gather one call away: "
+                    f"{chain_text(hops)} — the helper hides the "
+                    f"subscript but the call site pays the HBM temp; "
+                    f"bound it, fuse it (gram_mode='fused'), or "
+                    f"pragma the helper's gather with a size "
+                    f"justification",
+                    related=chain_related(hops)))
+    return findings
+
+
+def rule_materialized_gather(mods: Sequence[ModuleInfo],
+                             ctx: CheckContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in mods:
+        findings.extend(_module_materialized_gather(mod, ctx))
     return findings
 
 
@@ -668,12 +809,19 @@ from .concurrency import (  # noqa: E402 — registry assembly
     rule_lock_order_inversion,
     rule_unguarded_shared_state,
 )
+from .kernels import (  # noqa: E402 — registry assembly
+    rule_dma_unwaited,
+    rule_low_precision_accumulator,
+    rule_missing_interpret_fallback,
+    rule_vmem_overbudget,
+)
 
 RULES: Dict[str, Rule] = {r.name: r for r in (
     Rule("host-sync-in-hot-path",
          "device→host sync (np.asarray/.item()/.tolist()/device_get/"
-         "block_until_ready) inside server/ or ops/ functions",
-         rule_host_sync),
+         "block_until_ready) inside server/ or ops/ functions, "
+         "directly or through any helper call chain",
+         rule_host_sync, project=True),
     Rule("recompile-hazard",
          "jit sites that silently re-trace: unhashable statics, "
          "closures over jnp arrays, Python control flow on traced args",
@@ -687,13 +835,33 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
          "not declared by parallel/mesh.py",
          rule_sharding_mismatch),
     Rule("materialized-gather",
-         "table[indices] advanced-indexing gathers in models/, ops/, "
-         "or server/ functions — unbounded HBM temps on train/serve "
-         "hot paths (fuse or bound, or pragma with a size case)",
-         rule_materialized_gather),
+         "table[indices] / jnp.take gathers by traced params in "
+         "models/, ops/, or server/ functions — directly or through "
+         "a helper — unbounded HBM temps on train/serve hot paths "
+         "(fuse or bound, or pragma with a size case)",
+         rule_materialized_gather, project=True),
     Rule("config-drift",
          "jax.config.update outside utils/platform.py",
          rule_config_drift),
+    Rule("vmem-overbudget",
+         "pallas_call whose statically-evaluated VMEM working set "
+         "(BlockSpec tiles double-buffered + scratch) exceeds the "
+         "~16 MiB/core budget for the autotune rank/chunk grid",
+         rule_vmem_overbudget),
+    Rule("dma-unwaited",
+         "make_async_copy .start() without a matching .wait() (by "
+         "variable or semaphore slot), or a slot restarted before "
+         "its wait",
+         rule_dma_unwaited),
+    Rule("low-precision-accumulator",
+         "+=/dot accumulation into bf16/f16 Pallas scratch refs — "
+         "kernel accumulators must be f32",
+         rule_low_precision_accumulator),
+    Rule("missing-interpret-fallback",
+         "pallas_call hard-wired to compiled mode (no interpret= "
+         "escape) instead of riding a support-gated dispatcher like "
+         "fused_gram_dispatch",
+         rule_missing_interpret_fallback),
     Rule("unguarded-shared-state",
          "reads/writes of a class's lock-guarded attributes outside "
          "the lock (honors # ptpu: guarded-by[lock])",

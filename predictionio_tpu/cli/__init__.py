@@ -1032,12 +1032,14 @@ def cmd_run(args, storage: Storage) -> int:
 
 
 def cmd_check(args) -> int:
-    """``ptpu check`` — JAX-aware + concurrency static analysis (pure
-    AST, no jax/storage import: safe on any host, fast enough for a
-    pre-commit hook). Non-zero exit on findings — or, with
-    ``--baseline``, on findings NOT in the baseline. ``--format
-    json|sarif`` for machines (sarif feeds GitHub code-scanning PR
-    annotations); see docs/static-analysis.md."""
+    """``ptpu check`` — JAX-aware + concurrency + Pallas-kernel static
+    analysis, interprocedural over the scanned set (pure AST, no
+    jax/storage import: safe on any host, fast enough for a pre-commit
+    hook). Non-zero exit on findings — or, with ``--baseline``, on
+    findings NOT in the baseline (which only ever ratchets down; see
+    --baseline-grow). ``--format json|sarif`` for machines (sarif
+    feeds GitHub code-scanning PR annotations, interprocedural call
+    chains as relatedLocations); see docs/static-analysis.md."""
     from ..analysis import (
         RULES,
         findings_to_json,
@@ -1045,6 +1047,7 @@ def cmd_check(args) -> int:
         load_baseline,
         new_findings,
         run_check,
+        shrinkable_entries,
         write_baseline,
     )
 
@@ -1062,10 +1065,28 @@ def cmd_check(args) -> int:
         if not args.baseline:
             _err("--write-baseline requires --baseline FILE")
             return 2
-        n = write_baseline(args.baseline, findings)
+        cap = None
+        if not args.baseline_grow and os.path.exists(args.baseline):
+            try:
+                cap = load_baseline(args.baseline)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                _err(f"ptpu check: cannot read baseline: {e}")
+                return 2
+        n = write_baseline(args.baseline, findings, cap=cap)
         _err(f"ptpu check: wrote {n} baseline entr"
              f"{'y' if n == 1 else 'ies'} "
-             f"({len(findings)} finding(s)) to {args.baseline}.")
+             f"({len(findings)} finding(s)) to {args.baseline}"
+             f"{' (ratchet: shrink-only)' if cap is not None else ''}.")
+        if cap is not None:
+            overflow = new_findings(findings, cap)
+            if overflow:
+                _err(f"ptpu check: {len(overflow)} finding(s) exceed "
+                     f"the recorded baseline and were NOT absorbed "
+                     f"(the baseline only ratchets down; fix them or "
+                     f"re-record deliberately with --baseline-grow):")
+                for f in overflow:
+                    _err(f"  {f.format()}")
+                return 1
         return 0
     gating = findings
     baselined = 0
@@ -1077,6 +1098,14 @@ def cmd_check(args) -> int:
             return 2
         gating = new_findings(findings, baseline)
         baselined = len(findings) - len(gating)
+        shrinkable = shrinkable_entries(findings, baseline)
+        if shrinkable:
+            _err(f"ptpu check: {len(shrinkable)} baseline entr"
+                 f"{'y is' if len(shrinkable) == 1 else 'ies are'} "
+                 f"no longer fully reproduced — the baseline can "
+                 f"ratchet down (re-run with --write-baseline):")
+            for (path, rule, _msg), rec, act in shrinkable:
+                _err(f"  {path}: {rule}: recorded {rec}, found {act}")
     if args.format == "json":
         _out(findings_to_json(gating))
     elif args.format == "sarif":
@@ -1393,9 +1422,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--channel", default="")
     s.add_argument("--input", required=True)
 
-    s = sub.add_parser("check", help="JAX-aware + concurrency static "
-                       "analysis (host-sync, recompile, donation, "
-                       "sharding, config, lock-discipline lints)")
+    s = sub.add_parser("check", help="JAX-aware + concurrency + Pallas"
+                       "-kernel static analysis, interprocedural "
+                       "(host-sync, recompile, donation, sharding, "
+                       "config, lock-discipline, VMEM-budget, DMA, "
+                       "accumulator-precision lints)")
     s.add_argument("paths", nargs="*",
                    help="files/dirs to check (default: predictionio_tpu)")
     s.add_argument("--rule", action="append", default=[],
@@ -1410,8 +1441,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="baseline file: exit 1 only on findings NOT "
                         "recorded in it (legacy-debt burn-down)")
     s.add_argument("--write-baseline", action="store_true",
-                   help="record current findings into --baseline FILE "
-                        "and exit 0")
+                   help="record current findings into --baseline FILE; "
+                        "against an existing baseline this only "
+                        "RATCHETS (removes/decrements entries) and "
+                        "fails on findings beyond the recorded debt")
+    s.add_argument("--baseline-grow", action="store_true",
+                   help="with --write-baseline: allow recording NEW "
+                        "debt (e.g. when enabling a rule) instead of "
+                        "the default shrink-only ratchet")
 
     sub.add_parser("template", help="list bundled engine templates")
     sub.add_parser("shell", help="interactive shell with storage preloaded")

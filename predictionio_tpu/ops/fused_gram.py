@@ -215,6 +215,15 @@ def fused_gram(table: jax.Array, idx: jax.Array, wa: jax.Array,
     idx = _pad_axis(_pad_axis(idx.astype(jnp.int32), 1, Lp), 0, Bp)
     wa = _pad_axis(_pad_axis(wa.astype(jnp.float32), 1, Lp), 0, Bp)
     wb = _pad_axis(_pad_axis(wb.astype(jnp.float32), 1, Lp), 0, Bp)
+    # `ptpu check` (vmem-overbudget) proves this bound statically over
+    # the autotune rank grid; assert it at trace time too, so an
+    # exotic (L, rank, chunk) combination from a caller-supplied
+    # override fails loudly on the host instead of OOMing VMEM
+    # mid-train (shapes are static under jit — this costs nothing)
+    assert fused_vmem_bytes(Lp, r, table.dtype.itemsize, block_rows,
+                            Lc) < 16 * 1024 * 1024, \
+        f"fused_gram VMEM working set exceeds the ~16 MiB/core " \
+        f"budget at rank {r}, chunk {Lc}, L {Lp} (docs/kernels.md)"
     n_chunks = Lp // Lc
     kernel = functools.partial(_fused_gram_kernel, n_chunks, Lc)
     A, b = pl.pallas_call(

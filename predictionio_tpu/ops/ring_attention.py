@@ -12,7 +12,13 @@ of the sequence anywhere.
 Numerics: the classic streaming-softmax accumulation (running max ``m``,
 normalizer ``l``, weighted accumulator) — each incoming KV block updates
 the triple exactly, so the result equals dense softmax attention to
-float rounding, block order notwithstanding.
+float rounding, block order notwithstanding. The (m, l, acc) triple is
+f32 regardless of the q/k/v wire dtype, with
+``preferred_element_type=f32`` on every contraction — the same
+accumulate-in-f32 contract ``ptpu check`` enforces on Pallas scratch
+(``low-precision-accumulator``, docs/static-analysis.md): bf16 belongs
+on the wire, never in the running sum (a bf16 ``l`` visibly skews long
+-sequence attention weights).
 
 The op is jit/shard_map-first: no data-dependent Python control flow,
 static shapes, a ``lax.fori_loop`` of P ring steps.

@@ -62,6 +62,8 @@ def _build_mesh(shape: Tuple[int, int], names: Tuple[str, str],
     if d0 * d1 > n:
         raise ValueError(f"mesh {d0}x{d1} needs {d0 * d1} devices, "
                          f"have {n}")
+    # ptpu: allow[host-sync-in-hot-path] — np.asarray over a host LIST
+    # of Device handles (mesh topology), not a device array: no D2H
     dev = np.asarray(devices[: d0 * d1]).reshape(d0, d1)
     return Mesh(dev, names)
 

@@ -109,6 +109,10 @@ class ReleaseRegistry:
         return f"{RESERVED_PREFIX}-{digest[:20]}"
 
     def _load(self) -> Dict[str, Any]:
+        # ptpu: allow[blocking-under-lock] — the registry lock IS the
+        # read-modify-write atomicity boundary for the release blob:
+        # every caller holds it across load+mutate+save by design.
+        # Admin-plane ops (pin/promote/rollback), never the query path.
         blob = self.storage.models().get(self.key)
         if blob is None:
             return _empty_state()
@@ -123,11 +127,15 @@ class ReleaseRegistry:
     def _save(self, state: Dict[str, Any]) -> None:
         state["history"] = state["history"][-MAX_HISTORY:]
         payload = json.dumps(state).encode("utf-8")
+        # ptpu: allow[blocking-under-lock] — same contract as _load:
+        # the held lock is what makes load+mutate+save atomic
         self.storage.models().insert(Model(id=self.key, models=payload))
         self._index_self()
 
     def _index_self(self) -> None:
         triple = [self.engine_id, self.engine_version, self.engine_variant]
+        # ptpu: allow[blocking-under-lock] — rides _save's atomicity
+        # contract (see _load); index writes are admin-plane only
         models = self.storage.models()
         blob = models.get(INDEX_KEY)
         entries: List[List[str]] = []

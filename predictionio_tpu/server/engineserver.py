@@ -377,6 +377,11 @@ class QueryServer:
             self.models = [a.prepare_serving_model(m, bind_batch)
                            for a, m in zip(self.algorithms, models)]
             self.serving = self.engine.make_serving(engine_params)
+            # ptpu: allow[blocking-under-lock] — bind-time only
+            # (deploy/reload/promote, never a query): the gram-mode
+            # resolution may one-shot-probe the fused kernel's
+            # lowering, and the result must be recorded inside the
+            # same swap that installs the binding it describes
             self._record_gram_mode()
             # mesh-wide placement (ISSUE 6): resolve the serving mode
             # against the live devices and the model's resident bytes,
@@ -385,6 +390,9 @@ class QueryServer:
             # mesh (sharded). Inside the same lock as the binding swap:
             # a promote/reload swaps mode, mesh, lanes and models as
             # one unit — queries never see a half-placed binding.
+            # ptpu: allow[blocking-under-lock] — that atomic-swap
+            # contract is exactly why the device placement happens
+            # with the lock held (bind-time, never per query)
             self._place_binding()
 
     # ptpu: guarded-by[_lock] — only ever called from _bind under the

@@ -29,10 +29,16 @@ def to_jsonable(obj: Any) -> Any:
     if isinstance(obj, (list, tuple, set, frozenset)):
         return [to_jsonable(x) for x in obj]
     if isinstance(obj, np.generic):
+        # ptpu: allow[host-sync-in-hot-path] — numpy scalar, host-side
         return obj.item()
     if isinstance(obj, np.ndarray):
+        # ptpu: allow[host-sync-in-hot-path] — numpy array, host-side
         return obj.tolist()
     if hasattr(obj, "tolist"):  # jax.Array without importing jax here
+        # ptpu: allow[host-sync-in-hot-path] — THE serialization
+        # boundary: results must land on host exactly here, after the
+        # serve/readback span, to become wire JSON (the one blessed
+        # D2H funnel of the query path, like ragged._host for packing)
         return obj.tolist()
     return str(obj)
 

@@ -8,7 +8,12 @@ from dataclasses import dataclass
 
 import pytest
 
-from predictionio_tpu.analysis import RULES, check_source, run_check
+from predictionio_tpu.analysis import (
+    RULES,
+    check_project,
+    check_source,
+    run_check,
+)
 from predictionio_tpu.cli import main
 
 PKG = os.path.join(os.path.dirname(os.path.dirname(
@@ -475,7 +480,35 @@ class TestRepoWide:
             "missing-donation", "sharding-mismatch", "config-drift",
             "materialized-gather",
             "unguarded-shared-state", "lock-order-inversion",
-            "blocking-under-lock", "callback-under-lock"}
+            "blocking-under-lock", "callback-under-lock",
+            "vmem-overbudget", "dma-unwaited",
+            "low-precision-accumulator", "missing-interpret-fallback"}
+
+    def test_kernel_files_clean_under_kernel_rules(self):
+        # the acceptance bar: the real Pallas kernels pass the rules
+        # that were written because of them
+        findings = run_check(
+            [os.path.join(PKG, "ops")],
+            rule_names=["vmem-overbudget", "dma-unwaited",
+                        "low-precision-accumulator",
+                        "missing-interpret-fallback"])
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_benchmarks_and_examples_clean(self):
+        root = os.path.dirname(PKG)
+        findings = run_check([os.path.join(root, "benchmarks"),
+                              os.path.join(root, "examples")])
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_full_run_wall_time_budget(self):
+        # CI enforces 60 s over predictionio_tpu+benchmarks+examples;
+        # guard the interprocedural pass from quadratic blowup with
+        # headroom for slow runners
+        import time
+
+        t0 = time.time()
+        run_check([PKG])
+        assert time.time() - t0 < 30
 
     def test_parse_error_is_reported_not_raised(self):
         findings = check_source("def broken(:", path=COLD)
@@ -1084,6 +1117,953 @@ class TestCheckFormatsAndBaseline:
         good = tmp_path / "fine.py"
         good.write_text("X = 1\n")
         assert main(["check", str(good), "--write-baseline"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# interprocedural layer: call graph + effect summaries (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+class TestInterprocedural:
+    def test_two_hop_host_sync_reported_at_hot_site(self):
+        findings = check_project({
+            "pkg/utils/convert.py": src("""
+                import numpy as np
+
+                def land(x):
+                    return np.asarray(x)
+            """),
+            "pkg/lib/middle.py": src("""
+                from pkg.utils.convert import land
+
+                def shuttle(x):
+                    return land(x) + 1
+            """),
+            "pkg/server/handler.py": src("""
+                from pkg.lib.middle import shuttle
+
+                def handle(q):
+                    return shuttle(q)
+            """),
+        })
+        assert rules_of(findings) == ["host-sync-in-hot-path"]
+        f = findings[0]
+        # anchored at the HOT call site, not in the helpers
+        assert f.path == "pkg/server/handler.py"
+        # ...with the full chain in the message
+        assert "shuttle" in f.message and "land" in f.message
+        assert "np.asarray" in f.message
+        # ...and the hop locations machine-readable for SARIF
+        assert [p for p, _, _ in f.related] == [
+            "pkg/lib/middle.py", "pkg/utils/convert.py"]
+
+    def test_helper_in_hot_package_not_double_reported(self):
+        # the helper's own body gets the direct finding; the call site
+        # must not add a second one
+        findings = check_project({
+            "pkg/server/helper.py": src("""
+                import numpy as np
+
+                def land(x):
+                    return np.asarray(x)
+            """),
+            "pkg/server/handler.py": src("""
+                from pkg.server.helper import land
+
+                def handle(q):
+                    return land(q)
+            """),
+        })
+        assert rules_of(findings) == ["host-sync-in-hot-path"]
+        assert findings[0].path == "pkg/server/helper.py"
+
+    def test_pragma_at_direct_site_stops_propagation(self):
+        # blessing the one named D2H helper blesses its callers
+        findings = check_project({
+            "pkg/utils/convert.py": src("""
+                import numpy as np
+
+                def land(x):
+                    # ptpu: allow[host-sync-in-hot-path] — blessed
+                    return np.asarray(x)
+            """),
+            "pkg/server/handler.py": src("""
+                from pkg.utils.convert import land
+
+                def handle(q):
+                    return land(q)
+            """),
+        })
+        assert findings == []
+
+    def test_pragma_at_call_site_suppresses(self):
+        findings = check_project({
+            "pkg/utils/convert.py": src("""
+                import numpy as np
+
+                def land(x):
+                    return np.asarray(x)
+            """),
+            "pkg/server/handler.py": src("""
+                from pkg.utils.convert import land
+
+                def handle(q):
+                    # ptpu: allow[host-sync-in-hot-path] — one-shot
+                    return land(q)
+            """),
+        })
+        assert findings == []
+
+    def test_recursion_and_cycles_handled(self):
+        # mutual recursion must neither crash nor lose the effect
+        findings = check_project({
+            "pkg/utils/recur.py": src("""
+                import numpy as np
+
+                def a(x):
+                    return b(x)
+
+                def b(x):
+                    if x:
+                        return a(x)
+                    return np.asarray(x)
+            """),
+            "pkg/server/h.py": src("""
+                from pkg.utils.recur import a
+
+                def handle(q):
+                    return a(q)
+            """),
+        })
+        assert rules_of(findings) == ["host-sync-in-hot-path"]
+        assert findings[0].path == "pkg/server/h.py"
+
+    def test_self_recursion_no_crash(self):
+        assert check_project({
+            "pkg/lib/r.py": "def f(x):\n    return f(x - 1)\n",
+        }) == []
+
+    def test_method_vs_function_resolution(self):
+        # a module FUNCTION named like a method of another class must
+        # not satisfy a self.X() call — only the enclosing class's own
+        # method does
+        findings = check_project({
+            "pkg/utils/sink.py": src("""
+                import numpy as np
+
+                def flush(x):
+                    return np.asarray(x)
+            """),
+            "pkg/server/srv.py": src("""
+                from pkg.utils.sink import flush
+
+                class Handler:
+                    def flush(self, x):
+                        return x  # clean method, same name
+
+                    def a(self, q):
+                        return self.flush(q)   # clean: own method
+
+                    def b(self, q):
+                        return flush(q)        # dirty: module func
+            """),
+        })
+        assert rules_of(findings) == ["host-sync-in-hot-path"]
+        assert "in hot function `b`" in findings[0].message \
+            or "`Handler.b`" in findings[0].message
+
+    def test_relative_import_resolution(self):
+        findings = check_project({
+            "predictionio_tpu/utils/conv.py": src("""
+                import numpy as np
+
+                def land(x):
+                    return np.asarray(x)
+            """),
+            "predictionio_tpu/server/web.py": src("""
+                from ..utils.conv import land
+
+                def handle(q):
+                    return land(q)
+            """),
+        })
+        assert rules_of(findings) == ["host-sync-in-hot-path"]
+        assert findings[0].path == "predictionio_tpu/server/web.py"
+
+    def test_ambiguous_suffix_resolves_to_nothing(self):
+        # two modules define helper(); the call must not guess
+        findings = check_project({
+            "pkg/a/util.py": src("""
+                import numpy as np
+
+                def helper(x):
+                    return np.asarray(x)
+            """),
+            "pkg/b/util.py": src("""
+                def helper(x):
+                    return x
+            """),
+            "pkg/server/h.py": src("""
+                from util import helper
+
+                def handle(q):
+                    return helper(q)
+            """),
+        })
+        assert findings == []
+
+    def test_gather_sink_through_helper(self):
+        findings = check_project({
+            "pkg/ops/helper.py": src("""
+                def fetch_rows(table, ids):
+                    return table[ids]
+            """),
+            "pkg/models/train.py": src("""
+                import jax
+                from pkg.ops.helper import fetch_rows
+
+                @jax.jit
+                def step(table, idx):
+                    return fetch_rows(table, idx)
+            """),
+        }, rule_names=["materialized-gather"])
+        assert rules_of(findings) == ["materialized-gather"]
+        assert findings[0].path == "pkg/models/train.py"
+        assert "fetch_rows" in findings[0].message
+
+    def test_gather_sink_two_hops_and_kwarg(self):
+        findings = check_project({
+            "pkg/ops/inner.py": src("""
+                def raw(table, ids):
+                    return table[ids]
+            """),
+            "pkg/ops/outer.py": src("""
+                from pkg.ops.inner import raw
+
+                def fetch(table, rows):
+                    return raw(table, rows)
+            """),
+            "pkg/models/train.py": src("""
+                import jax
+                from pkg.ops.outer import fetch
+
+                @jax.jit
+                def step(table, idx):
+                    return fetch(table, rows=idx)
+            """),
+        }, rule_names=["materialized-gather"])
+        assert rules_of(findings) == ["materialized-gather"]
+        assert findings[0].path == "pkg/models/train.py"
+
+    def test_blocking_chain_under_lock(self):
+        findings = check_project({
+            "pkg/server/srv.py": src("""
+                import threading
+
+                class Server:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def _slow(self):
+                        import time
+                        time.sleep(1)
+
+                    def tick(self):
+                        with self._lock:
+                            self._slow()
+            """),
+        }, rule_names=["blocking-under-lock"])
+        assert rules_of(findings) == ["blocking-under-lock"]
+        assert "_slow" in findings[0].message
+        assert "time.sleep" in findings[0].message
+
+    def test_callback_delivery_chain_under_lock(self):
+        findings = check_project({
+            "pkg/cache/bus.py": src("""
+                import threading
+
+                class Bus:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.subs = []
+
+                    def _deliver(self, ev):
+                        self.bus.publish(ev)
+
+                    def ingest(self, ev):
+                        with self._lock:
+                            self._deliver(ev)
+            """),
+        }, rule_names=["callback-under-lock"])
+        assert rules_of(findings) == ["callback-under-lock"]
+        assert "_deliver" in findings[0].message
+
+    def test_callable_passed_into_invoking_helper_under_lock(self):
+        findings = check_project({
+            "pkg/cache/run.py": src("""
+                import threading
+
+                def run_hook(fn, ev):
+                    return fn(ev)
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def fire(self, hook, ev):
+                        with self._lock:
+                            run_hook(hook, ev)
+            """),
+        }, rule_names=["callback-under-lock"])
+        assert rules_of(findings) == ["callback-under-lock"]
+        assert "run_hook" in findings[0].message
+
+    def test_lock_order_edge_through_call(self):
+        # with a: self._refill() where _refill takes b, elsewhere
+        # with b: takes a — a cycle with no lexical nesting of a and b
+        findings = check_project({
+            "pkg/cache/two.py": src("""
+                import threading
+
+                class Two:
+                    def __init__(self):
+                        self._a_lock = threading.Lock()
+                        self._b_lock = threading.Lock()
+
+                    def _refill(self):
+                        with self._b_lock:
+                            pass
+
+                    def forward(self):
+                        with self._a_lock:
+                            self._refill()
+
+                    def backward(self):
+                        with self._b_lock:
+                            with self._a_lock:
+                                pass
+            """),
+        }, rule_names=["lock-order-inversion"])
+        assert rules_of(findings) == ["lock-order-inversion"]
+        assert "Two._a_lock" in findings[0].message
+        assert "Two._b_lock" in findings[0].message
+
+    def test_cli_reports_two_hop_sync(self, tmp_path, capsys):
+        # the acceptance-criteria path: a seeded two-call-deep host
+        # sync surfaces through the real `ptpu check` entry point
+        (tmp_path / "utils").mkdir()
+        (tmp_path / "lib").mkdir()
+        (tmp_path / "server").mkdir()
+        (tmp_path / "utils" / "conv.py").write_text(src("""
+            import numpy as np
+
+            def land(x):
+                return np.asarray(x)
+        """))
+        (tmp_path / "lib" / "mid.py").write_text(src("""
+            from utils.conv import land
+
+            def shuttle(x):
+                return land(x)
+        """))
+        (tmp_path / "server" / "web.py").write_text(src("""
+            from lib.mid import shuttle
+
+            def handle(q):
+                return shuttle(q)
+        """))
+        assert main(["check", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "host-sync-in-hot-path" in out
+        assert "shuttle" in out and "land" in out
+        assert "web.py" in out
+
+
+class TestTakeGather:
+    def test_jnp_take_positive(self):
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(table, idx):
+                return jnp.take(table, idx)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["materialized-gather"]
+        assert "jnp.take" in findings[0].message
+
+    def test_jnp_take_along_axis_kwarg(self):
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(table, idx):
+                return jnp.take_along_axis(table, indices=idx, axis=0)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["materialized-gather"]
+
+    def test_jnp_take_static_index_negative(self):
+        code = src("""
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=("idx",))
+            def step(table, idx):
+                return jnp.take(table, idx)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_jnp_take_outside_hot_dirs_negative(self):
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(table, idx):
+                return jnp.take(table, idx)
+        """)
+        assert check_source(code,
+                            path="predictionio_tpu/obs/x.py") == []
+
+    def test_jnp_take_pragma(self):
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def serve(table, idx):
+                # ptpu: allow[materialized-gather] — [B, r] row fetch
+                return jnp.take(table, idx)
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel-safety rules (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+KERNEL_PRELUDE = src("""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+""")
+
+
+def ksrc(text):
+    """Kernel-test source: the pallas prelude + a dedented body (the
+    two halves dedent separately — their literal indents differ)."""
+    return KERNEL_PRELUDE + src(text)
+
+
+class TestVmemOverbudget:
+    def test_seeded_overbudget_kernel(self):
+        code = ksrc("""
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def big(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(8,),
+                    in_specs=[pl.BlockSpec((4096, 4096),
+                                           lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec((4096, 4096),
+                                           lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((4096, 4096),
+                                                   jnp.float32),
+                    interpret=True,
+                )(x)
+        """)
+        findings = check_source(code, path="ops/k.py",
+                                rule_names=["vmem-overbudget"])
+        assert rules_of(findings) == ["vmem-overbudget"]
+        assert "16 MiB" in findings[0].message
+
+    def test_rank_scenario_from_autotune_grid(self):
+        # r is free → bound to the autotune rank grid; 128·chunk·r·4B
+        # double-buffered clears the budget only at r=128
+        code = ksrc("""
+            def kern(x_ref, o_ref, acc):
+                o_ref[:] = x_ref[:]
+
+            def run(x, r):
+                return pl.pallas_call(
+                    kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((128, 512, r),
+                                           lambda i: (i, 0, 0),
+                                           memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec((128, 512, r),
+                                           lambda i: (i, 0, 0),
+                                           memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((512, 512, r),
+                                                   jnp.float32),
+                    scratch_shapes=[pltpu.VMEM((r, r), jnp.float32)],
+                    interpret=True,
+                )(x)
+        """)
+        findings = check_source(code, path="ops/k.py",
+                                rule_names=["vmem-overbudget"])
+        assert rules_of(findings) == ["vmem-overbudget"]
+        assert "rank 128" in findings[0].message
+
+    def test_constraint_makes_scenario_infeasible(self):
+        # the block clears the budget at rank 64 and would blow it at
+        # rank 128 — but an enclosing bound excludes r=128 (the
+        # solve.py scratch-variant pattern), so the call is clean
+        code = ksrc("""
+            _RP_MAX = 64
+
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x, r):
+                if r <= _RP_MAX:
+                    return pl.pallas_call(
+                        kern,
+                        grid=(4,),
+                        in_specs=[pl.BlockSpec((48, 512, r),
+                                               lambda i: (i, 0, 0),
+                                               memory_space=pltpu.VMEM)],
+                        out_specs=pl.BlockSpec((8, 128),
+                                               lambda i: (i, 0),
+                                               memory_space=pltpu.VMEM),
+                        out_shape=jax.ShapeDtypeStruct((32, 128),
+                                                       jnp.float32),
+                        interpret=True,
+                    )(x)
+        """)
+        assert check_source(code, path="ops/k.py",
+                            rule_names=["vmem-overbudget"]) == []
+
+    def test_same_shapes_without_constraint_flagged_at_128(self):
+        # the twin of the test above minus the bound: rank 128 is now
+        # feasible and 25 MiB of double-buffered block exceeds budget
+        code = ksrc("""
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x, r):
+                return pl.pallas_call(
+                    kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((48, 512, r),
+                                           lambda i: (i, 0, 0),
+                                           memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec((8, 128),
+                                           lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((32, 128),
+                                                   jnp.float32),
+                    interpret=True,
+                )(x)
+        """)
+        findings = check_source(code, path="ops/k.py",
+                                rule_names=["vmem-overbudget"])
+        assert rules_of(findings) == ["vmem-overbudget"]
+        assert "rank 128" in findings[0].message
+
+    def test_any_memory_space_not_counted(self):
+        # the fused_gram idiom: the big table stays in HBM (ANY) and
+        # rows stream via DMA — only VMEM residents count
+        code = ksrc("""
+            def kern(t_ref, o_ref):
+                o_ref[:] = o_ref[:]
+
+            def run(table):
+                return pl.pallas_call(
+                    kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                    out_specs=pl.BlockSpec((8, 128),
+                                           lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((32, 128),
+                                                   jnp.float32),
+                    interpret=True,
+                )(table)
+        """)
+        assert check_source(code, path="ops/k.py",
+                            rule_names=["vmem-overbudget"]) == []
+
+    def test_pragma_suppresses(self):
+        code = ksrc("""
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def big(x):
+                # ptpu: allow[vmem-overbudget] — measured: fits
+                return pl.pallas_call(
+                    kern,
+                    grid=(8,),
+                    in_specs=[pl.BlockSpec((4096, 4096),
+                                           lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec((4096, 4096),
+                                           lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((4096, 4096),
+                                                   jnp.float32),
+                    interpret=True,
+                )(x)
+        """)
+        assert check_source(code, path="ops/k.py",
+                            rule_names=["vmem-overbudget"]) == []
+
+
+class TestDmaUnwaited:
+    def test_start_without_wait(self):
+        code = ksrc("""
+            def kern(h_ref, o_ref, buf, sem):
+                pltpu.make_async_copy(h_ref.at[0], buf.at[0],
+                                      sem.at[0]).start()
+                o_ref[:] = buf[0]
+        """)
+        findings = check_source(code, path="ops/k.py",
+                                rule_names=["dma-unwaited"])
+        assert rules_of(findings) == ["dma-unwaited"]
+        assert "no matching .wait()" in findings[0].message
+
+    def test_var_start_wait_pair_clean(self):
+        code = ksrc("""
+            def kern(h_ref, o_ref, buf, sem):
+                c = pltpu.make_async_copy(h_ref.at[0], buf.at[0],
+                                          sem.at[0])
+                c.start()
+                c.wait()
+                o_ref[:] = buf[0]
+        """)
+        assert check_source(code, path="ops/k.py",
+                            rule_names=["dma-unwaited"]) == []
+
+    def test_split_start_and_wait_matched_by_semaphore(self):
+        # the fused_gram pipeline idiom: issue in one nested helper,
+        # drain in a sibling — matched through the semaphore slot
+        code = ksrc("""
+            def kern(h_ref, o_ref, buf, sems):
+                def issue(slot):
+                    pltpu.make_async_copy(h_ref.at[slot],
+                                          buf.at[slot],
+                                          sems.at[slot]).start()
+
+                def drain(slot):
+                    pltpu.make_async_copy(h_ref.at[slot],
+                                          buf.at[slot],
+                                          sems.at[slot]).wait()
+
+                issue(0)
+                drain(0)
+                o_ref[:] = buf[0]
+        """)
+        assert check_source(code, path="ops/k.py",
+                            rule_names=["dma-unwaited"]) == []
+
+    def test_slot_restarted_before_wait(self):
+        code = ksrc("""
+            def kern(h_ref, o_ref, buf, sem):
+                pltpu.make_async_copy(h_ref.at[0], buf.at[0],
+                                      sem.at[0]).start()
+                pltpu.make_async_copy(h_ref.at[1], buf.at[1],
+                                      sem.at[0]).start()
+                pltpu.make_async_copy(h_ref.at[0], buf.at[0],
+                                      sem.at[0]).wait()
+                o_ref[:] = buf[0]
+        """)
+        findings = check_source(code, path="ops/k.py",
+                                rule_names=["dma-unwaited"])
+        assert rules_of(findings) == ["dma-unwaited"]
+        assert "restarted before its wait" in findings[0].message
+
+
+class TestLowPrecisionAccumulator:
+    BF16 = ksrc("""
+        def kern(x_ref, o_ref, acc):
+            acc[:] = acc[:] + x_ref[:]
+            o_ref[:] = acc[:]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.bfloat16)],
+                interpret=True,
+            )(x)
+    """)
+
+    def test_bf16_accumulation_flagged(self):
+        findings = check_source(
+            self.BF16, path="ops/k.py",
+            rule_names=["low-precision-accumulator"])
+        assert rules_of(findings) == ["low-precision-accumulator"]
+        assert "bfloat16" in findings[0].message
+
+    def test_f32_accumulator_clean(self):
+        code = self.BF16.replace("jnp.bfloat16)],", "jnp.float32)],")
+        assert check_source(
+            code, path="ops/k.py",
+            rule_names=["low-precision-accumulator"]) == []
+
+    def test_augassign_and_dot_into_bf16(self):
+        code = ksrc("""
+            def kern(x_ref, o_ref, acc):
+                acc[:] += x_ref[:]
+                acc[:] = jax.lax.dot_general(
+                    x_ref[:], x_ref[:], (((0,), (0,)), ((), ())))
+                o_ref[:] = acc[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    kern,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                   jnp.float32),
+                    scratch_shapes=[pltpu.VMEM((128, 128),
+                                               jnp.float16)],
+                    interpret=True,
+                )(x)
+        """)
+        findings = check_source(
+            code, path="ops/k.py",
+            rule_names=["low-precision-accumulator"])
+        assert rules_of(findings) == ["low-precision-accumulator"] * 2
+
+    def test_partial_bound_kernel_mapping(self):
+        # functools.partial-bound leading args shift the ref mapping —
+        # the fused_gram wiring shape
+        code = ksrc("""
+            def kern(n, x_ref, o_ref, acc):
+                acc[:] = acc[:] + x_ref[:]
+                o_ref[:] = acc[:]
+
+            def run(x):
+                k = functools.partial(kern, 4)
+                return pl.pallas_call(
+                    k,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                   jnp.float32),
+                    scratch_shapes=[pltpu.VMEM((8, 128),
+                                               jnp.bfloat16)],
+                    interpret=True,
+                )(x)
+        """)
+        findings = check_source(
+            code, path="ops/k.py",
+            rule_names=["low-precision-accumulator"])
+        assert rules_of(findings) == ["low-precision-accumulator"]
+
+
+class TestMissingInterpretFallback:
+    def test_no_interpret_kwarg_flagged(self):
+        code = ksrc("""
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    kern,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                   jnp.float32),
+                )(x)
+        """)
+        findings = check_source(
+            code, path="ops/k.py",
+            rule_names=["missing-interpret-fallback"])
+        assert rules_of(findings) == ["missing-interpret-fallback"]
+        assert "fused_gram_dispatch" in findings[0].message
+
+    def test_interpret_param_clean(self):
+        code = ksrc("""
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x, interpret=False):
+                return pl.pallas_call(
+                    kern,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                   jnp.float32),
+                    interpret=interpret,
+                )(x)
+        """)
+        assert check_source(
+            code, path="ops/k.py",
+            rule_names=["missing-interpret-fallback"]) == []
+
+    def test_interpret_false_literal_flagged(self):
+        code = ksrc("""
+            def kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    kern,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                   jnp.float32),
+                    interpret=False,
+                )(x)
+        """)
+        findings = check_source(
+            code, path="ops/k.py",
+            rule_names=["missing-interpret-fallback"])
+        assert rules_of(findings) == ["missing-interpret-fallback"]
+
+    def test_non_pallas_module_ignored(self):
+        assert check_source(
+            "def pallas_call(x):\n    return x\n",
+            path="ops/k.py",
+            rule_names=["missing-interpret-fallback"]) == []
+
+
+# ---------------------------------------------------------------------------
+# checker robustness: broken files become findings, never crashes
+# ---------------------------------------------------------------------------
+
+class TestCheckerRobustness:
+    def test_syntax_error_file_is_per_file_finding(self, tmp_path):
+        d = tmp_path / "server"
+        d.mkdir()
+        (d / "broken.py").write_text("def broken(:\n")
+        (d / "bad.py").write_text(src("""
+            import numpy as np
+
+            def handler(arr):
+                return np.asarray(arr)
+        """))
+        findings = run_check([str(tmp_path)])
+        rules = rules_of(findings)
+        # the broken file reports, AND the rest of the tree still runs
+        assert "parse-error" in rules
+        assert "host-sync-in-hot-path" in rules
+
+    def test_undecodable_file_is_per_file_finding(self, tmp_path):
+        d = tmp_path / "server"
+        d.mkdir()
+        (d / "binary.py").write_bytes(b"\xff\xfe\x00\x00garbage")
+        (d / "fine.py").write_text("X = 1\n")
+        findings = run_check([str(tmp_path)])
+        assert rules_of(findings) == ["parse-error"]
+        assert "binary.py" in findings[0].path
+
+    def test_cli_exit_code_on_broken_fixture(self, tmp_path, capsys):
+        (tmp_path / "broken.py").write_text("def broken(:\n")
+        assert main(["check", str(tmp_path)]) == 1
+        assert "parse-error" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+class TestBaselineRatchet:
+    TWO = src("""
+        import numpy as np
+
+        def handler(arr):
+            a = np.asarray(arr)
+            b = np.asarray(arr)
+            return a, b
+    """)
+    ONE = src("""
+        import numpy as np
+
+        def handler(arr):
+            return np.asarray(arr)
+    """)
+
+    def _write(self, tmp_path, text):
+        d = tmp_path / "server"
+        d.mkdir(exist_ok=True)
+        (d / "bad.py").write_text(text)
+
+    def test_gate_prints_shrinkable_entries(self, tmp_path, capsys):
+        self._write(tmp_path, self.TWO)
+        bl = tmp_path / "bl.json"
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        self._write(tmp_path, self.ONE)
+        assert main(["check", str(tmp_path),
+                     "--baseline", str(bl)]) == 0
+        err = capsys.readouterr().err
+        assert "ratchet down" in err
+        assert "recorded 2, found 1" in err
+
+    def test_write_baseline_auto_tightens(self, tmp_path, capsys):
+        from predictionio_tpu.analysis import load_baseline
+
+        self._write(tmp_path, self.TWO)
+        bl = tmp_path / "bl.json"
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        self._write(tmp_path, self.ONE)
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        recorded = load_baseline(str(bl))
+        assert sum(recorded.values()) == 1  # 2 → 1: ratcheted
+
+    def test_write_baseline_refuses_new_debt(self, tmp_path, capsys):
+        self._write(tmp_path, self.ONE)
+        bl = tmp_path / "bl.json"
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        # a NEW kind of finding appears; the ratchet must not absorb it
+        (tmp_path / "server" / "drift.py").write_text(src("""
+            import jax
+
+            def setup():
+                jax.config.update("jax_enable_x64", True)
+        """))
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 1
+        err = capsys.readouterr().err
+        assert "NOT absorbed" in err
+        # the baseline still gates: the new finding fails the gate
+        assert main(["check", str(tmp_path),
+                     "--baseline", str(bl)]) == 1
+
+    def test_baseline_grow_records_new_debt(self, tmp_path, capsys):
+        self._write(tmp_path, self.ONE)
+        bl = tmp_path / "bl.json"
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        (tmp_path / "server" / "drift.py").write_text(src("""
+            import jax
+
+            def setup():
+                jax.config.update("jax_enable_x64", True)
+        """))
+        assert main(["check", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline", "--baseline-grow"]) == 0
+        assert main(["check", str(tmp_path),
+                     "--baseline", str(bl)]) == 0
+
+    def test_shrinkable_entries_api(self):
+        from predictionio_tpu.analysis import shrinkable_entries
+
+        findings = check_source(self.ONE,
+                                path="predictionio_tpu/server/s.py")
+        assert len(findings) == 1
+        key = (findings[0].path, findings[0].rule, findings[0].message)
+        shrink = shrinkable_entries(findings, {key: 3})
+        assert shrink == [(key, 3, 1)]
+        assert shrinkable_entries(findings, {key: 1}) == []
 
 
 # ---------------------------------------------------------------------------
