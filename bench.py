@@ -659,6 +659,10 @@ def main():
         "compiles_since_warm": _tele(tele_cfg, "compilesSinceWarm"),
         "transfer_guard_violations": _tele(tele_cfg,
                                            "transferGuardViolations"),
+        # staged-vs-serial serving pipeline ratios + overlap proof
+        # (ISSUE 9): qps_x / p99_x and the device-idle fraction from
+        # the staged server's own accounting
+        "serving_pipeline": (serving or {}).get("pipeline"),
         "serving": serving,
         "roofline": roofline,
         "device": jax.devices()[0].device_kind,

@@ -35,10 +35,24 @@ fan-out (a full model copy per device, per-device lanes), and the
 row-sharded mesh — per-mode qps plus the replicated/single
 ``scaling_x`` ratio.
 
+Since ISSUE 9 the micro-batch config runs twice — the staged
+continuous-batching pipeline vs the serial drainer at the same load —
+and a ``pipeline_overlap`` row embeds the qps/p99 ratios plus the
+server's own device-idle / overlap fractions (the proof the device
+stays busy while host stages run).
+
+With ``--arrival-rate QPS``, an OPEN-LOOP fixed-rate generator replaces
+the closed-loop battery (coordinated-omission-safe: latency is measured
+from each request's scheduled arrival, so a stalling server accrues
+latency instead of silently slowing the offered load). Sweep the rate
+to trace the qps-vs-p99 knee — the first slice of ROADMAP's
+load-harness item.
+
 Usage: python benchmarks/serving_bench.py [n_items_device] [rank]
                                           [--canary FRACTION]
                                           [--zipf ALPHA] [--cache]
                                           [--mesh]
+                                          [--arrival-rate QPS]
 Env:   SERVE_THREADS (8), SERVE_REQUESTS (400 per config)
 """
 
@@ -105,9 +119,9 @@ def _sample_users(rng, n_users: int, n: int, zipf=None) -> np.ndarray:
     return (rng.zipf(float(zipf), size=n) - 1) % n_users
 
 
-def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
-                 n_threads: int, label: str, zipf=None,
-                 hot_hit_probe: int = 0) -> dict:
+def _boot_server(model: ALSModel, cfg: ServerConfig):
+    """One deployed QueryServer over a synthetic COMPLETED instance —
+    shared by the closed-loop configs and the open-loop generator."""
     storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
     storage.apps().insert(App(0, "servebench"))
     ctx = Context(app_name="servebench", _storage=storage)
@@ -121,22 +135,32 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     qs = QueryServer(ctx, engine, ep, [model], inst, cfg)
     srv = create_engine_server(qs, host="127.0.0.1", port=0)
     srv.start_background()
-    port = srv.port
-    rng = np.random.default_rng(1)
-    users = _sample_users(rng, model.n_users, n_requests, zipf)
+    return qs, srv
 
-    # wait for the server-side warmup (ServerConfig.warm_start compiles
-    # the single-query + pow2 batch ladder), then a few real queries
+
+def _wait_warm(port: int, label: str) -> None:
+    """Block until the server-side warmup (ServerConfig.warm_start
+    compiles the single-query + pow2 batch ladder) reports done."""
     deadline = time.monotonic() + 300
     while time.monotonic() < deadline:
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/status.json",
                 timeout=30) as resp:
             if json.loads(resp.read()).get("servingWarm"):
-                break
+                return
         time.sleep(0.5)
-    else:
-        raise RuntimeError(f"{label}: serving warmup did not finish")
+    raise RuntimeError(f"{label}: serving warmup did not finish")
+
+
+def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
+                 n_threads: int, label: str, zipf=None,
+                 hot_hit_probe: int = 0) -> dict:
+    qs, srv = _boot_server(model, cfg)
+    port = srv.port
+    rng = np.random.default_rng(1)
+    users = _sample_users(rng, model.n_users, n_requests, zipf)
+
+    _wait_warm(port, label)
     for u in users[:3]:
         body = json.dumps({"user": f"u{u}", "num": 10}).encode()
         urllib.request.urlopen(urllib.request.Request(
@@ -254,6 +278,10 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
             "server_p99_ms": (round(lat_hist["p99"] * 1000, 2)
                               if lat_hist.get("p99") is not None
                               else None),
+            # the pipeline overlap proof (ISSUE 9): device idle /
+            # overlap fractions + deadline sheds from the server's own
+            # accounting, embedded beside the client-side percentiles
+            "pipeline": status.get("pipeline"),
         }
     except Exception as e:  # noqa: BLE001 — telemetry is advisory
         telemetry = {"error": str(e)[:200]}
@@ -282,19 +310,47 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
     return out
 
 
+def pipeline_block(staged: dict, serial: dict) -> dict:
+    """The ISSUE 9 acceptance view: staged vs serial drainer at the
+    SAME offered load — qps/p99 ratios plus the staged server's own
+    overlap accounting (device idle fraction proving the device stayed
+    busy while host stages ran)."""
+    out = {
+        "config": "pipeline_overlap",
+        "staged_qps": staged.get("qps"),
+        "serial_qps": serial.get("qps"),
+        "staged_p99_ms": staged.get("p99_ms"),
+        "serial_p99_ms": serial.get("p99_ms"),
+    }
+    if serial.get("qps") and staged.get("qps"):
+        out["qps_x"] = round(staged["qps"] / serial["qps"], 2)
+    if serial.get("p99_ms") and staged.get("p99_ms"):
+        out["p99_x"] = round(serial["p99_ms"] / staged["p99_ms"], 2)
+    pipe = ((staged.get("telemetry") or {}).get("pipeline")) or {}
+    ov = pipe.get("overlap") or {}
+    out["device_idle_fraction"] = ov.get("deviceIdleFraction")
+    out["overlap_fraction"] = ov.get("overlapFraction")
+    out["overlapped_dispatches"] = ov.get("overlappedDispatches")
+    out["deadline_exceeded"] = pipe.get("deadlineExceeded")
+    return out
+
+
 def standard_battery(n_items_dev: int, rank: int, n_req: int,
                      n_threads: int, hi_threads: int) -> dict:
-    """The four-config serving battery — ONE definition shared by this
-    script's ``main()`` and ``bench.py``'s serving block (they drifted
-    when each kept its own copy): host fast path, per-query at trickle
-    load, per-query and micro-batcher at burst load (``hi_threads``
-    offered concurrency — the apples-to-apples pair)."""
+    """The serving battery — ONE definition shared by this script's
+    ``main()`` and ``bench.py``'s serving block (they drifted when each
+    kept its own copy): host fast path, per-query at trickle load,
+    per-query and micro-batcher at burst load (``hi_threads`` offered
+    concurrency — the apples-to-apples pair). Since ISSUE 9 the
+    micro-batcher runs TWICE at the same load — staged continuous-
+    batching pipeline vs the serial drainer — and a ``pipeline``
+    summary row carries the ratio + overlap proof."""
     from predictionio_tpu.server.engineserver import ServerConfig
 
     host_model = synth_model(2000, 2000, rank, device=False)
     dev_model = synth_model(50_000, n_items_dev, rank, device=True)
     hi_req = max(n_req, 8 * hi_threads)
-    return {
+    out = {
         "host_fast_path": bench_config(
             host_model, ServerConfig(), max(n_req, 300), n_threads,
             "host_fast_path"),
@@ -307,7 +363,130 @@ def standard_battery(n_items_dev: int, rank: int, n_req: int,
         "microbatch": bench_config(
             dev_model, ServerConfig(batching=True, max_batch=128,
                                     batch_window_ms=2.0),
-            hi_req, hi_threads, "device_microbatch"),
+            hi_req, hi_threads, "device_microbatch_staged"),
+        "microbatch_serial": bench_config(
+            dev_model, ServerConfig(batching=True, max_batch=128,
+                                    batch_window_ms=2.0,
+                                    serving_pipeline="serial"),
+            hi_req, hi_threads, "device_microbatch_serial"),
+    }
+    out["pipeline"] = pipeline_block(out["microbatch"],
+                                     out["microbatch_serial"])
+    return out
+
+
+def bench_open_loop(model: ALSModel, cfg: ServerConfig, rate_qps: float,
+                    n_requests: int, n_threads: int, label: str) -> dict:
+    """Open-loop fixed-rate load (the first slice of ROADMAP's
+    load-harness item): request k's INTENDED start time is
+    ``t0 + k/rate`` regardless of how the server is doing, and latency
+    is measured from that intended start — coordinated-omission-safe:
+    a stalling server keeps accruing latency on every scheduled
+    arrival instead of silently slowing the offered load the way a
+    closed loop does. Sweep ``--arrival-rate`` to find the qps-vs-p99
+    knee; past it, p99 grows without bound (or deadline sheds appear),
+    which IS the capacity signal."""
+    qs, srv = _boot_server(model, cfg)
+    port = srv.port
+    try:
+        _wait_warm(port, label)
+        rng = np.random.default_rng(3)
+        users = rng.integers(0, model.n_users, n_requests)
+        for u in users[:3]:
+            body = json.dumps({"user": f"u{u}", "num": 10}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=120).read()
+
+        lat: list = []
+        shed: list = []
+        errors: list = []
+        lock = threading.Lock()
+        idx = iter(range(n_requests))
+        t0 = time.monotonic() + 0.05
+
+        def worker():
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            try:
+                while True:
+                    with lock:
+                        k = next(idx, None)
+                    if k is None:
+                        return
+                    t_sched = t0 + k / rate_qps
+                    delay = t_sched - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    body = json.dumps({"user": f"u{users[k]}",
+                                       "num": 10}).encode()
+                    try:
+                        conn.request("POST", "/queries.json", body=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        # latency from the SCHEDULED start: waiting for
+                        # a free connection/worker counts against the
+                        # server, not against the workload
+                        dt = time.monotonic() - t_sched
+                        if resp.status == 503:
+                            with lock:
+                                shed.append(dt)
+                        elif resp.status != 200 or not json.loads(
+                                payload).get("itemScores"):
+                            raise RuntimeError(
+                                f"status {resp.status}")
+                        else:
+                            with lock:
+                                lat.append(dt)
+                    except Exception as e:  # noqa: BLE001 — surface
+                        with lock:
+                            errors.append(str(e))
+                        conn.close()
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - max(t_start, t0)
+        pipe = None
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status.json",
+                    timeout=30) as resp:
+                pipe = json.loads(resp.read()).get("pipeline")
+        except Exception as e:  # noqa: BLE001 — telemetry is advisory
+            pipe = {"error": str(e)[:200]}
+    finally:
+        srv.shutdown()
+    if errors:
+        raise RuntimeError(
+            f"{label}: {len(errors)} failed requests "
+            f"(first: {errors[0]})")
+    if not lat:
+        raise RuntimeError(f"{label}: every request was shed; offered "
+                           f"rate {rate_qps} is far past the knee")
+    arr = np.sort(np.asarray(lat)) * 1e3
+    return {
+        "config": label,
+        "open_loop": True,
+        "offered_qps": rate_qps,
+        "achieved_qps": round(len(lat) / wall, 1),
+        "n": len(arr),
+        "shed": len(shed),
+        "p50_ms": round(float(np.percentile(arr, 50)), 2),
+        "p90_ms": round(float(np.percentile(arr, 90)), 2),
+        "p99_ms": round(float(np.percentile(arr, 99)), 2),
+        "pipeline": pipe,
     }
 
 
@@ -500,6 +679,11 @@ def main() -> None:
     if "--mesh" in argv:
         with_mesh = True
         argv.remove("--mesh")
+    arrival_rate = None
+    if "--arrival-rate" in argv:
+        i = argv.index("--arrival-rate")
+        arrival_rate = float(argv[i + 1])
+        del argv[i:i + 2]
     sys.argv[1:] = argv
     n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -516,6 +700,34 @@ def main() -> None:
     device_kind = jax.devices()[0].device_kind
 
     hi = int(os.environ.get("SERVE_THREADS_HI", "256"))
+    if arrival_rate is not None:
+        # open-loop mode REPLACES the closed-loop battery: fixed-rate
+        # arrivals against the staged and serial micro-batch paths at
+        # the same offered qps — sweep the rate to trace the knee
+        from predictionio_tpu.server.engineserver import ServerConfig
+
+        dev_model = synth_model(50_000, n_items_dev, rank, device=True)
+        n_open = max(n_requests, int(arrival_rate * 10))
+        results = [
+            bench_open_loop(
+                dev_model, ServerConfig(batching=True, max_batch=128,
+                                        batch_window_ms=2.0),
+                arrival_rate, n_open, hi, "open_loop_staged"),
+            bench_open_loop(
+                dev_model, ServerConfig(batching=True, max_batch=128,
+                                        batch_window_ms=2.0,
+                                        serving_pipeline="serial"),
+                arrival_rate, n_open, hi, "open_loop_serial"),
+        ]
+        print(json.dumps({
+            "bench": "serving_queries_json_open_loop",
+            "device": device_kind,
+            "rank": rank,
+            "n_items_device": n_items_dev,
+            "offered_qps": arrival_rate,
+            "results": results,
+        }))
+        return
     results = list(standard_battery(n_items_dev, rank, n_requests,
                                     n_threads, hi).values())
     if with_mesh:

@@ -312,6 +312,11 @@ def cmd_deploy(args, storage: Storage) -> int:
         max_batch=args.max_batch,
         batch_window_ms=args.batch_window_ms,
         batch_pipeline=args.batch_pipeline,
+        serving_pipeline=args.pipeline,
+        queue_deadline_ms=args.queue_deadline_ms,
+        assemble_workers=args.assemble_workers,
+        readback_workers=args.readback_workers,
+        pipeline_depth=args.pipeline_depth,
         serving_cache=args.cache,
         cache_entries=args.cache_entries,
         cache_ttl_sec=args.cache_ttl,
@@ -1245,7 +1250,31 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--batch-window-ms", type=float, default=2.0,
                    help="wait for a lone query before serving it solo")
     s.add_argument("--batch-pipeline", type=int, default=4,
-                   help="concurrent batch dispatches in flight")
+                   help="concurrent batch dispatches in flight "
+                        "(serial pipeline only)")
+    s.add_argument("--pipeline", default="staged",
+                   choices=["staged", "serial"],
+                   help="serving batch-path architecture "
+                        "(docs/serving-pipeline.md): staged = "
+                        "continuous-batching pipeline overlapping host "
+                        "assembly, device dispatch and readback; "
+                        "serial = the pre-pipeline drainer threads")
+    s.add_argument("--queue-deadline-ms", type=float, default=30000.0,
+                   help="per-query deadline covering queue wait "
+                        "through readback; exceeded queries shed with "
+                        "503 (pio_query_deadline_exceeded_total). "
+                        "0 disables")
+    s.add_argument("--assemble-workers", type=int, default=1,
+                   help="staged pipeline: host threads parsing/"
+                        "supplementing the next batch (raise for "
+                        "storage-heavy supplements)")
+    s.add_argument("--readback-workers", type=int, default=4,
+                   help="staged pipeline: host threads blocking on "
+                        "device results + serializing")
+    s.add_argument("--pipeline-depth", type=int, default=0,
+                   help="staged pipeline: bounded in-flight batches "
+                        "per lane (the backpressure knob); 0 = auto "
+                        "(1 on CPU, 4 on accelerators)")
     s.add_argument("--cache", action="store_true",
                    help="serving cache hierarchy: query-result + "
                         "feature caches and the device-resident "

@@ -1840,6 +1840,104 @@ def recommend_products(model: ALSModel, user_index: int, k: int
 _TOPK_CHUNK = 2048
 
 
+def _dispatch_topk_chunk(model: ALSModel, user_indices: np.ndarray,
+                         k: int):
+    """Enqueue ONE top-k device dispatch (batch ≤ ``_TOPK_CHUNK``) and
+    return a no-arg resolver that blocks on the device arrays
+    (``jax.device_get``) and hands back host ``([B, k] ids, scores)``.
+
+    The dispatch half returns as soon as XLA has the executable
+    enqueued — JAX async dispatch — so a staged serving pipeline can
+    launch batch k+1 before batch k's results are read back (ISSUE 9).
+    The batch axis pads to the pow2 ladder (every distinct [B, r]
+    shape is a fresh XLA compile — measured ~10-20s each through the
+    device tunnel) exactly as the synchronous path always did.
+
+    Sharded models launch under ``_mesh_dispatch_lock`` as ever, but
+    the readback runs OUTSIDE the lock: fetching an already-enqueued
+    result is not a collective launch, so readers never serialize the
+    NEXT batch's mesh dispatch behind a device→host transfer."""
+    B = len(user_indices)
+    kk = min(k, model.n_items)
+    k_dev = _compiled_k(k, model.n_items)
+    Bp = 1
+    while Bp < B:
+        Bp *= 2
+    idx_dev = np.empty(Bp, dtype=np.int64)
+    idx_dev[:B] = user_indices
+    idx_dev[B:] = user_indices[0] if B else 0  # pad rows: any valid row
+    mesh = getattr(model, "mesh", None)
+    if mesh is not None:
+        n_dev = mesh.devices.size
+        n_pad = model.item_factors.shape[0]
+        if n_pad % n_dev:
+            raise ValueError(
+                f"item rows {n_pad} not divisible by mesh size "
+                f"{n_dev}; pad factors to a device multiple "
+                f"(shard_model does)")
+        k_local = min(k_dev, n_pad // n_dev)
+        ranked = _sharded_rank_fn(mesh, k_dev, k_local, model.n_items)
+        with _mesh_dispatch_lock:
+            vecs = _user_vecs(model.user_factors, idx_dev, mesh)
+            # ptpu: allow[callback-under-lock] — `ranked` is a compiled
+            # XLA executable (jit of shard_map), not user code: it
+            # cannot re-enter this lock, and serializing the launch is
+            # the lock's entire purpose (concurrent mesh-collective
+            # launches deadlock)
+            ids, scores = ranked(vecs, model.item_factors)
+    else:
+        scores, ids = _serve_topk(
+            jnp.asarray(model.user_factors),
+            jnp.asarray(model.item_factors),
+            idx_dev, k=k_dev, n_items=model.n_items)
+
+    def resolve() -> Tuple[np.ndarray, np.ndarray]:
+        i, s = jax.device_get((ids, scores))
+        return i[:B, :kk], s[:B, :kk]
+
+    return resolve
+
+
+def recommend_batch_async(model: ALSModel, user_indices: np.ndarray,
+                          k: int):
+    """Dispatch/readback split of :func:`recommend_batch` (ISSUE 9):
+    enqueues the device work and returns a no-arg resolver; calling it
+    blocks until the results are on the host. Between the two calls
+    the device computes while the caller is free to assemble and
+    dispatch MORE batches — the continuous-batching serving pipeline's
+    contract (docs/serving-pipeline.md).
+
+    Host-served models compute inline (numpy is synchronous; there is
+    nothing to overlap) and the resolver just returns the arrays.
+    Batches past ``_TOPK_CHUNK`` dispatch every chunk up front — the
+    device executes them back to back — and the resolver drains them
+    in order."""
+    user_indices = np.asarray(user_indices)
+    B = len(user_indices)
+    kk = min(k, model.n_items)
+    if B == 0:
+        empty = (np.empty((0, kk), np.int64),
+                 np.empty((0, kk), np.float32))
+        return lambda: empty
+    if getattr(model, "mesh", None) is None \
+            and _serve_on_host(model, batch=B):
+        host = _host_topk(np.asarray(model.user_factors)[user_indices],
+                          model.item_factors, k, model.n_items)
+        return lambda: host
+    resolvers = [
+        _dispatch_topk_chunk(model, user_indices[s:s + _TOPK_CHUNK], k)
+        for s in range(0, B, _TOPK_CHUNK)]
+    if len(resolvers) == 1:
+        return resolvers[0]
+
+    def resolve() -> Tuple[np.ndarray, np.ndarray]:
+        parts = [r() for r in resolvers]
+        return (np.concatenate([p[0] for p in parts], axis=0),
+                np.concatenate([p[1] for p in parts], axis=0))
+
+    return resolve
+
+
 def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Micro-batched top-k for many users (one device dispatch, or the
@@ -1847,66 +1945,11 @@ def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
     (``model.mesh``) rank over the mesh: cross-shard user gather +
     per-device item-shard top-k + candidate merge, with the batch axis
     padded to the same pow2 ladder as the single-device path so the
-    micro-batcher's arbitrary batch sizes reuse O(log) compilations."""
-    if getattr(model, "mesh", None) is not None:
-        B = len(user_indices)
-        k = min(k, model.n_items)
-        if B == 0:
-            return (np.empty((0, k), np.int64),
-                    np.empty((0, k), np.float32))
-        if B > _TOPK_CHUNK:
-            parts = [recommend_batch(model,
-                                     user_indices[s:s + _TOPK_CHUNK], k)
-                     for s in range(0, B, _TOPK_CHUNK)]
-            return (np.concatenate([p[0] for p in parts], axis=0),
-                    np.concatenate([p[1] for p in parts], axis=0))
-        Bp = 1
-        while Bp < B:
-            Bp *= 2
-        idx_dev = np.empty(Bp, dtype=np.int64)
-        idx_dev[:B] = user_indices
-        idx_dev[B:] = user_indices[0]
-        k_dev = _compiled_k(k, model.n_items)
-        ids, scores = recommend_batch_sharded(
-            model.user_factors, model.item_factors, idx_dev, k_dev,
-            model.mesh, model.n_items)
-        return ids[:B, :k], scores[:B, :k]
-    if _serve_on_host(model, batch=len(user_indices)):
-        return _host_topk(
-            np.asarray(model.user_factors)[np.asarray(user_indices)],
-            model.item_factors, k, model.n_items)
-    k_dev = _compiled_k(k, model.n_items)
-    # pad the BATCH axis to a power of two as well: the serving
-    # micro-batcher produces arbitrary batch sizes, and every distinct
-    # [B, r] shape is a fresh XLA compile — measured ~10-20s each
-    # through the device tunnel, which turned the batched path's p90
-    # into seconds (BENCH_LASTGOOD round 4). O(log) shapes instead.
-    # Past _TOPK_CHUNK rows, process fixed-size chunks: an eval sweep
-    # hands over EVERY test user at once, and one [B_pow2, n_items]
-    # score matrix at that size is an HBM OOM (measured: 131072×27k f32
-    # = 14.5GB on a 16GB v5e during the north-star eval).
-    B = len(user_indices)
-    k = min(k, model.n_items)
-    if B > _TOPK_CHUNK:
-        ids_parts, score_parts = [], []
-        for s in range(0, B, _TOPK_CHUNK):
-            i, sc = recommend_batch(
-                model, user_indices[s:s + _TOPK_CHUNK], k)
-            ids_parts.append(i)
-            score_parts.append(sc)
-        return (np.concatenate(ids_parts, axis=0),
-                np.concatenate(score_parts, axis=0))
-    Bp = 1
-    while Bp < B:
-        Bp *= 2
-    idx_dev = np.empty(Bp, dtype=np.int64)
-    idx_dev[:B] = user_indices
-    idx_dev[B:] = user_indices[0] if B else 0  # pad rows: any valid row
-    scores, ids = _serve_topk(
-        jnp.asarray(model.user_factors), jnp.asarray(model.item_factors),
-        idx_dev, k=k_dev, n_items=model.n_items)
-    ids, scores = jax.device_get((ids, scores))
-    return (ids[:B, :k], scores[:B, :k])
+    micro-batcher's arbitrary batch sizes reuse O(log) compilations.
+
+    Realized as :func:`recommend_batch_async` + immediate readback so
+    the synchronous and pipelined paths can never diverge."""
+    return recommend_batch_async(model, user_indices, k)()
 
 
 def predict_rating(model: ALSModel, user_index: int, item_index: int) -> float:

@@ -7,6 +7,7 @@ docs/observability.md for the full metric catalog.
 """
 
 from .guard import TransferGuardCounter
+from .overlap import OverlapTracker
 from .histogram import (
     DEFAULT_LATENCY_BOUNDS,
     POW2_COUNT_BOUNDS,
@@ -27,6 +28,7 @@ __all__ = [
     "DEFAULT_LATENCY_BOUNDS",
     "POW2_COUNT_BOUNDS",
     "MetricsRegistry",
+    "OverlapTracker",
     "StreamingHistogram",
     "TransferGuardCounter",
     "escape_label_value",

@@ -366,33 +366,52 @@ class ALSAlgorithm(Algorithm):
                 break     # runtime batch pads to a warmed shape
             b *= 2
 
-    def batch_predict(self, model: ALSModel, queries: Sequence[Query]
-                      ) -> List[PredictedResult]:
-        """One batched device dispatch for all known users
-        (the reference's cartesian batchPredict, ``ALSAlgorithm.scala:
-        113-150``, without the shuffle)."""
+    def batch_predict_async(self, model: ALSModel,
+                            queries: Sequence[Query]):
+        """Dispatch half of :meth:`batch_predict` (ISSUE 9): enqueues
+        the batched device top-k and returns a no-arg resolver that
+        blocks on the device arrays and builds the per-query results.
+        The staged serving pipeline's dispatch stage calls this and
+        hands the resolver to the readback stage, so the NEXT batch
+        launches while this one's results are still on device
+        (docs/serving-pipeline.md)."""
+        from ..models.als import recommend_batch_async
+
         known = [(qi, int(model.user_ids[q.user])) for qi, q in
                  enumerate(queries) if model.user_ids
                  and q.user in model.user_ids]
         out: List[PredictedResult] = [PredictedResult()] * len(queries)
         if not known:
-            return out
+            return lambda: out
         max_black = max((len(q.black_list or ()) for q in queries),
                         default=0)
         num = max(q.num for q in queries) + max_black
         idx = np.array([u for _, u in known], dtype=np.int64)
-        ids, scores = recommend_batch(model, idx, num)
-        inv = model.item_ids.inverse
-        for row, (qi, _) in enumerate(known):
-            q = queries[qi]
-            black = {model.item_ids[i] for i in (q.black_list or ())
-                     if i in model.item_ids}
-            picked = [(int(i), float(s))
-                      for i, s in zip(ids[row], scores[row])
-                      if int(i) not in black][: q.num]
-            out[qi] = PredictedResult(tuple(
-                ItemScore(item=inv[i], score=s) for i, s in picked))
-        return out
+        handle = recommend_batch_async(model, idx, num)
+
+        def resolve() -> List[PredictedResult]:
+            ids, scores = handle()
+            inv = model.item_ids.inverse
+            for row, (qi, _) in enumerate(known):
+                q = queries[qi]
+                black = {model.item_ids[i] for i in (q.black_list or ())
+                         if i in model.item_ids}
+                picked = [(int(i), float(s))
+                          for i, s in zip(ids[row], scores[row])
+                          if int(i) not in black][: q.num]
+                out[qi] = PredictedResult(tuple(
+                    ItemScore(item=inv[i], score=s) for i, s in picked))
+            return out
+
+        return resolve
+
+    def batch_predict(self, model: ALSModel, queries: Sequence[Query]
+                      ) -> List[PredictedResult]:
+        """One batched device dispatch for all known users
+        (the reference's cartesian batchPredict, ``ALSAlgorithm.scala:
+        113-150``, without the shuffle). Dispatch + immediate readback
+        of :meth:`batch_predict_async` — the two must never diverge."""
+        return self.batch_predict_async(model, queries)()
 
 
 class RecommendationServing(FirstServing):

@@ -39,30 +39,22 @@ def _algo_pool():
     return _dispatch_pool
 
 
-def predict_serve_batch(algorithms: List[Any], models: List[Any],
-                        serving: Any, queries: List[Any],
-                        timings: Optional[Dict[str, float]] = None
-                        ) -> List[Any]:
-    """The batched serving pipeline shared by the engine server's
-    micro-batcher and the batch-predict job: supplement each query, ONE
-    ``batch_predict`` device dispatch per algorithm, then serve per
-    query. Per-query failures (supplement/serve) come back as the raised
-    exception in that query's slot; a ``batch_predict`` failure fills
-    every live slot (it is one dispatch). When ``timings`` is given, the
-    wall time of each internal phase is accumulated into it under
-    ``supplement``/``dispatch``/``serve`` (the engine server's per-phase
-    telemetry reads these)."""
-    out: List[Any] = [None] * len(queries)
+def supplement_batch(serving: Any, queries: List[Any], out: List[Any],
+                     timings: Optional[Dict[str, float]] = None
+                     ) -> tuple:
+    """Supplement each query (the assemble-stage host work). Returns
+    ``(supplemented, live)``; per-query supplement failures land as the
+    raised exception in that query's ``out`` slot. With more than one
+    query the supplements run CONCURRENTLY on the shared dispatch pool:
+    for templates whose supplement reads the event store (seen/
+    constraint lookups), a serial loop made a 128-query batch pay 128
+    sequential storage round trips before the device saw anything.
+    Futures are drained in query order, so result order and per-query
+    error slots are exactly the serial loop's."""
     supplemented: List[Any] = []
     live: List[int] = []
     t0 = time.monotonic()
     if len(queries) > 1:
-        # supplement CONCURRENTLY on the shared dispatch pool: for
-        # templates whose supplement reads the event store (seen/
-        # constraint lookups), the serial loop made a 128-query batch
-        # pay 128 sequential storage round trips before the device saw
-        # anything. Futures are drained in query order, so result order
-        # and per-query error slots are exactly the serial loop's.
         pool = _algo_pool()
         futures = [pool.submit(serving.supplement, q) for q in queries]
         for i, f in enumerate(futures):
@@ -78,21 +70,76 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
                 live.append(i)
             except Exception as e:  # noqa: BLE001 — isolate per query
                 out[i] = e
-    t1 = time.monotonic()
     if timings is not None:
-        timings["supplement"] = timings.get("supplement", 0.0) + (t1 - t0)
-    if live:
-        try:
-            if len(algorithms) == 1:
-                per_algo = [algorithms[0].batch_predict(models[0],
-                                                        supplemented)]
+        timings["supplement"] = (timings.get("supplement", 0.0)
+                                 + (time.monotonic() - t0))
+    return supplemented, live
+
+
+def dispatch_batch(algorithms: List[Any], models: List[Any],
+                   supplemented: List[Any],
+                   timings: Optional[Dict[str, float]] = None
+                   ) -> List[Any]:
+    """Per-algorithm device DISPATCH without readback (ISSUE 9):
+    returns one no-arg resolver per algorithm; calling it blocks until
+    that algorithm's predictions are host-real. Algorithms exposing
+    ``batch_predict_async`` (the dispatch/readback split — e.g. ALS)
+    enqueue here and block only in their resolver, which is what lets
+    the serving pipeline launch batch k+1 before batch k's results
+    exist. Algorithms without the hook run their full (blocking)
+    ``batch_predict`` on the shared pool — the resolver blocks on the
+    future — preserving the concurrent multi-algorithm dispatch and
+    still overlapping host stages of OTHER batches.
+
+    A dispatch-time failure raises out of this call (the caller fills
+    every live slot — one dispatch, whole batch); resolver-time
+    failures raise out of the resolver the same way."""
+    t0 = time.monotonic()
+    try:
+        resolvers: List[Any] = []
+        for a, m in zip(algorithms, models):
+            async_fn = getattr(a, "batch_predict_async", None)
+            if async_fn is not None:
+                resolvers.append(async_fn(m, supplemented))
             else:
-                # independent per-algorithm dispatches run concurrently;
-                # results stay in params order (serving depends on it)
-                futures = [_algo_pool().submit(a.batch_predict, m,
-                                               supplemented)
-                           for a, m in zip(algorithms, models)]
-                per_algo = [f.result() for f in futures]
+                resolvers.append(_algo_pool().submit(
+                    a.batch_predict, m, supplemented).result)
+        return resolvers
+    finally:
+        if timings is not None:
+            timings["dispatch"] = (timings.get("dispatch", 0.0)
+                                   + (time.monotonic() - t0))
+
+
+class PendingBatch:
+    """An in-flight coalesced batch: device dispatches enqueued, host
+    results not yet read back. Built by :func:`dispatch_serve_batch`
+    (or assembled from parts by the engine server's staged pipeline);
+    :meth:`resolve` blocks on the device arrays and finishes the
+    per-query serving — the readback stage's work."""
+
+    __slots__ = ("queries", "serving", "out", "live", "resolvers")
+
+    def __init__(self, queries: List[Any], serving: Any, out: List[Any],
+                 live: List[int], resolvers: List[Any]):
+        self.queries = queries
+        self.serving = serving
+        self.out = out
+        self.live = live
+        self.resolvers = resolvers
+
+    def resolve(self, timings: Optional[Dict[str, float]] = None
+                ) -> List[Any]:
+        """Block on the device results (``device_wait``), then serve
+        per query (``serve``). Same error contract as the serial path:
+        a per-algorithm readback failure fills every live slot; a
+        per-query serve failure fills only its own."""
+        out, live = self.out, self.live
+        if not live:
+            return out
+        t1 = time.monotonic()
+        try:
+            per_algo = [r() for r in self.resolvers]
         except Exception as e:  # noqa: BLE001 — one dispatch, whole batch
             for i in live:
                 out[i] = e
@@ -100,19 +147,63 @@ def predict_serve_batch(algorithms: List[Any], models: List[Any],
         finally:
             t2 = time.monotonic()
             if timings is not None:
-                timings["dispatch"] = (timings.get("dispatch", 0.0)
-                                       + (t2 - t1))
+                timings["device_wait"] = (timings.get("device_wait", 0.0)
+                                          + (t2 - t1))
         for row, i in enumerate(live):
             try:
                 # serve sees the original query (CreateServer.scala:511)
-                out[i] = serving.serve(queries[i],
-                                       [preds[row] for preds in per_algo])
+                out[i] = self.serving.serve(
+                    self.queries[i], [preds[row] for preds in per_algo])
             except Exception as e:  # noqa: BLE001
                 out[i] = e
         if timings is not None:
             timings["serve"] = (timings.get("serve", 0.0)
                                 + (time.monotonic() - t2))
-    return out
+        return out
+
+
+def dispatch_serve_batch(algorithms: List[Any], models: List[Any],
+                         serving: Any, queries: List[Any],
+                         timings: Optional[Dict[str, float]] = None
+                         ) -> PendingBatch:
+    """Supplement + per-algorithm device dispatch, WITHOUT blocking on
+    results: returns a :class:`PendingBatch` whose ``resolve()`` does
+    the readback and per-query serving. The serving pipeline's dispatch
+    stage uses this to keep the device enqueued batch after batch while
+    earlier batches' results are still in flight (ISSUE 9)."""
+    out: List[Any] = [None] * len(queries)
+    supplemented, live = supplement_batch(serving, queries, out,
+                                          timings=timings)
+    resolvers: List[Any] = []
+    if live:
+        try:
+            resolvers = dispatch_batch(algorithms, models, supplemented,
+                                       timings=timings)
+        except Exception as e:  # noqa: BLE001 — one dispatch, whole batch
+            for i in live:
+                out[i] = e
+            live = []
+    return PendingBatch(queries, serving, out, live, resolvers)
+
+
+def predict_serve_batch(algorithms: List[Any], models: List[Any],
+                        serving: Any, queries: List[Any],
+                        timings: Optional[Dict[str, float]] = None
+                        ) -> List[Any]:
+    """The batched serving pipeline shared by the engine server's
+    micro-batcher and the batch-predict job: supplement each query, ONE
+    ``batch_predict`` device dispatch per algorithm, then serve per
+    query. Per-query failures (supplement/serve) come back as the raised
+    exception in that query's slot; a ``batch_predict`` failure fills
+    every live slot (it is one dispatch). When ``timings`` is given, the
+    wall time of each internal phase is accumulated into it under
+    ``supplement``/``dispatch``/``device_wait``/``serve`` (the engine
+    server's per-phase telemetry reads these; ``dispatch`` is the pure
+    device ENQUEUE since ISSUE 9, ``device_wait`` the block on its
+    results). Realized as dispatch + immediate resolve so the serial
+    and staged paths can never diverge."""
+    return dispatch_serve_batch(algorithms, models, serving, queries,
+                                timings=timings).resolve(timings=timings)
 
 
 def batch_predict_lines(engine: Engine,

@@ -448,6 +448,12 @@ def test_deploy_batching_defaults_match_config():
     assert args.batch_window_ms == cfg.batch_window_ms
     assert args.batch_pipeline == cfg.batch_pipeline
     assert args.serving_mode == cfg.serving_mode
+    # staged-pipeline knobs (ISSUE 9) stay in sync the same way
+    assert args.pipeline == cfg.serving_pipeline
+    assert args.queue_deadline_ms == cfg.queue_deadline_ms
+    assert args.assemble_workers == cfg.assemble_workers
+    assert args.readback_workers == cfg.readback_workers
+    assert args.pipeline_depth == cfg.pipeline_depth
     import inspect
 
     sig = inspect.signature(MicroBatcher.__init__)
