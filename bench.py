@@ -572,6 +572,23 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, don't die
             device_scaling = {"error": _clean_err(e, 300)}
 
+    # streaming freshness (ISSUE 10): the real ingest→fold-in→serve
+    # loop over HTTP — event→servable p50 is the freshness the
+    # incremental trainer actually delivers vs the ~minutes a full
+    # retrain cadence bounds it to
+    streaming = None
+    if os.environ.get("BENCH_STREAMING", "1") == "1":
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks"))
+            import streaming_smoke as stream_smoke
+
+            streaming = stream_smoke.measure(
+                trials=int(os.environ.get("BENCH_STREAM_TRIALS", "6")))
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            streaming = {"error": _clean_err(e, 300)}
+
     # roofline accounting (VERDICT r4 weak #3: "memory-bound" was an
     # excuse, not a measurement): XLA's post-fusion bytes-accessed over
     # the steady-state iteration time vs the chip's HBM peak, PLUS the
@@ -663,6 +680,11 @@ def main():
         # (ISSUE 9): qps_x / p99_x and the device-idle fraction from
         # the staged server's own accounting
         "serving_pipeline": (serving or {}).get("pipeline"),
+        # event→servable freshness through the streaming trainer
+        # (ISSUE 10): ingest to correct serve, real HTTP loop
+        "event_to_servable_ms": (streaming or {}).get(
+            "event_to_servable_p50_ms"),
+        "streaming": streaming,
         "serving": serving,
         "roofline": roofline,
         "device": jax.devices()[0].device_kind,

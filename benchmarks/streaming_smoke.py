@@ -1,0 +1,226 @@
+"""Streaming incremental-training smoke (ISSUE 10) — the CI gate for
+the event→model loop.
+
+End-to-end over REAL HTTP on whatever device is available (CI: CPU):
+
+1. train + deploy a recommendation engine with the streaming trainer
+   attached (``ServerConfig(streaming=True)``), and start an event
+   server sharing the process-default invalidation bus (the bus wake
+   path production uses for co-located servers);
+2. for each trial, ingest a brand-new user's ratings through the event
+   server's ``POST /events.json`` and poll the engine server's
+   ``/queries.json`` until the recommendations reflect them — the
+   wall-clock from first-accepted-ingest to first-correct-serve is the
+   **event→servable** freshness sample. Gate: p50 under the smoke
+   budget (default 5 s; ``STREAM_SMOKE_BUDGET_S`` overrides);
+3. zero cursor gaps: after the loop the trainer must have consumed
+   EXACTLY the relevant events ingested (none lost, none twice), with
+   cursor lag 0 and the ``pio_stream_*`` series exported on /metrics.
+
+Prints one JSON line; exits non-zero on any violation. ``measure()``
+is importable — bench.py embeds ``event_to_servable_ms`` in the BENCH
+line through it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from predictionio_tpu.controller import Context  # noqa: E402
+from predictionio_tpu.data import DataMap, Event  # noqa: E402
+from predictionio_tpu.data.storage import App, Storage  # noqa: E402
+from predictionio_tpu.data.storage.base import AccessKey  # noqa: E402
+from predictionio_tpu.templates.recommendation import (  # noqa: E402
+    default_engine_params,
+    recommendation_engine,
+)
+from predictionio_tpu.workflow import (  # noqa: E402
+    get_latest_completed,
+    load_models_for_deploy,
+    run_train,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def _call(port, method, path, body=None, timeout=60):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else (
+        b"" if method == "POST" else None)
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _seed(storage, app_id, n_users=30):
+    rng = np.random.default_rng(7)
+    events, t = [], T0
+    for u in range(n_users):
+        group = range(0, 15) if u % 2 == 0 else range(15, 30)
+        for i in rng.choice(list(group), size=8, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": 5.0}), event_time=t))
+            t += timedelta(minutes=1)
+    storage.events().insert_batch(events, app_id)
+
+
+def measure(trials: int = 8, ratings_per_trial: int = 3,
+            interval_ms: float = 100.0, timeout_s: float = 30.0) -> dict:
+    """The ingest→fold-in→serve loop over real HTTP; returns the
+    freshness samples + consistency checks (no printing, no exit —
+    bench.py embeds this)."""
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        create_engine_server,
+    )
+    from predictionio_tpu.server.eventserver import (
+        build_app as build_event_app,
+    )
+    from predictionio_tpu.server.http import AppServer
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, "streamsmoke"))
+    storage.events().init(app_id)
+    storage.access_keys().insert(
+        AccessKey(key="sk", app_id=app_id, events=[]))
+    _seed(storage, app_id)
+    ctx = Context(app_name="streamsmoke", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("streamsmoke", rank=8, num_iterations=6,
+                               reg=0.05, seed=11)
+    run_train(ctx, engine, ep, engine_id="streamsmoke",
+              engine_factory="templates.recommendation")
+    inst = get_latest_completed(ctx, engine_id="streamsmoke")
+    models = load_models_for_deploy(ctx, engine, inst, ep)
+    qs = QueryServer(
+        ctx, engine, ep, models, inst,
+        ServerConfig(warm_start=False, streaming=True,
+                     stream_app_name="streamsmoke",
+                     stream_interval_ms=interval_ms,
+                     stream_canary_probes=2))
+    # the event server shares the process-default bus with the trainer
+    # (build_app and StreamTrainer both fall back to default_bus), so
+    # every accepted ingest wakes the fold-in loop immediately
+    ev_srv = AppServer(build_event_app(storage), "127.0.0.1",
+                       0).start_background()
+    en_srv = create_engine_server(qs, "127.0.0.1", 0).start_background()
+
+    out: dict = {"trials": trials}
+    samples_ms = []
+    ingested_relevant = 0
+    try:
+        for k in range(trials):
+            user = f"smoke_user_{k}"
+            items = [(k * 3 + j) % 15 for j in range(ratings_per_trial)]
+            t0 = time.monotonic()
+            for i in items:
+                status, _ = _call(
+                    ev_srv.port, "POST", f"/events.json?accessKey=sk",
+                    {"event": "rate", "entityType": "user",
+                     "entityId": user, "targetEntityType": "item",
+                     "targetEntityId": f"i{i}",
+                     "properties": {"rating": 5.0}})
+                assert status == 201, f"ingest failed: {status}"
+                ingested_relevant += 1
+            deadline = time.monotonic() + timeout_s
+            servable = None
+            while time.monotonic() < deadline:
+                _, got = _call(en_srv.port, "POST", "/queries.json",
+                               {"user": user, "num": 5})
+                if got.get("itemScores"):
+                    servable = (time.monotonic() - t0) * 1000.0
+                    break
+                time.sleep(0.02)
+            if servable is None:
+                out[f"trial_{k}_timed_out"] = True
+            else:
+                samples_ms.append(servable)
+        # settle, then check exactly-once consumption + zero lag
+        deadline = time.monotonic() + 10
+        stream = {}
+        while time.monotonic() < deadline:
+            _, stream = _call(en_srv.port, "GET", "/stream.json")
+            if stream.get("cursorLag", 1) == 0 and \
+                    stream.get("eventsConsumed", 0) >= \
+                    240 + ingested_relevant:
+                break
+            time.sleep(0.1)
+        out["events_ingested"] = ingested_relevant
+        out["events_consumed"] = stream.get("eventsConsumed")
+        out["cursor_lag"] = stream.get("cursorLag")
+        out["applies"] = stream.get("applies")
+        out["canary_rejects"] = stream.get("canaryRejects")
+        # 240 seed events drain in the first pass; every ingested event
+        # consumed exactly once on top of that = zero cursor gaps
+        out["zero_cursor_gaps"] = (
+            stream.get("eventsConsumed") == 240 + ingested_relevant
+            and stream.get("cursorLag") == 0)
+        _, status_json = _call(en_srv.port, "GET", "/status.json")
+        lin = status_json.get("lineage") or {}
+        out["lineage_generation"] = lin.get("incrementalGeneration")
+        out["lineage_ok"] = (lin.get("baseInstanceId") == inst.id
+                             and (lin.get("incrementalGeneration")
+                                  or 0) >= 1)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{en_srv.port}/metrics",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        out["stream_series_exported"] = all(
+            s in text for s in ("pio_stream_events_consumed_total",
+                                "pio_stream_foldin_seconds",
+                                "pio_stream_freshness_seconds",
+                                "pio_stream_cursor_lag",
+                                "pio_stream_drift_score"))
+    finally:
+        qs.stop_stream()
+        en_srv.shutdown()
+        ev_srv.shutdown()
+    if samples_ms:
+        arr = np.sort(np.asarray(samples_ms))
+        out["event_to_servable_p50_ms"] = round(
+            float(np.percentile(arr, 50)), 1)
+        out["event_to_servable_p90_ms"] = round(
+            float(np.percentile(arr, 90)), 1)
+        out["event_to_servable_max_ms"] = round(float(arr[-1]), 1)
+    out["samples"] = len(samples_ms)
+    return out
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    budget_ms = float(os.environ.get("STREAM_SMOKE_BUDGET_S",
+                                     "5")) * 1000.0
+    res = measure(trials=int(os.environ.get("STREAM_SMOKE_TRIALS", "8")))
+    checks = {
+        "all_trials_servable": res.get("samples") == res["trials"],
+        "p50_under_budget": (
+            res.get("event_to_servable_p50_ms") is not None
+            and res["event_to_servable_p50_ms"] < budget_ms),
+        "zero_cursor_gaps": bool(res.get("zero_cursor_gaps")),
+        "lineage_ok": bool(res.get("lineage_ok")),
+        "stream_series_exported": bool(
+            res.get("stream_series_exported")),
+    }
+    ok = all(checks.values())
+    print(json.dumps({"bench": "streaming_smoke", "ok": ok,
+                      "budget_ms": budget_ms, **checks, **res}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,41 @@ class InvalidationBus:
             self._delivered += delivered
         return delivered
 
+    def publish_many(self, app_id: Optional[int],
+                     items: List[tuple]) -> int:
+        """Coalesced multi-entity publish: deliver every
+        ``(entity_type, entity_id, event_name)`` of one accepted batch
+        with ONE subscriber snapshot and one stats update, instead of
+        a full :meth:`publish` (two lock passes + dead-ref sweep) per
+        item — the event server's batch/webhook ingest path. Per-item
+        delivery to each subscriber is preserved, so tag semantics are
+        exactly those of N single publishes."""
+        if not items:
+            return 0
+        with self._lock:
+            refs = list(self._subs)
+        delivered = 0
+        dead = False
+        for ref in refs:
+            fn = ref()
+            if fn is None:
+                dead = True
+                continue
+            for entity_type, entity_id, event_name in items:
+                try:
+                    fn(app_id, entity_type, entity_id, event_name)
+                    delivered += 1
+                except Exception as e:  # noqa: BLE001 — ingest goes on
+                    log.error("cache invalidation subscriber failed: %s",
+                              e)
+        if dead:
+            with self._lock:
+                self._subs = [r for r in self._subs if r() is not None]
+        with self._lock:
+            self._published += len(items)
+            self._delivered += delivered
+        return delivered
+
     def subscriber_count(self) -> int:
         with self._lock:
             return sum(1 for r in self._subs if r() is not None)

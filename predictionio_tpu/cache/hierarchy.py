@@ -156,6 +156,21 @@ class ServingCache:
             self._bump_global()
             self.query.flush()
 
+    def invalidate_entities(self, entity_type: str, entity_ids) -> None:
+        """Per-entity invalidation OUTSIDE the ingest bus: the
+        streaming trainer's delta apply (ISSUE 10) calls this after
+        hot-swapping folded factor rows — a result for a touched
+        entity cached between its ingest (which the bus already
+        invalidated) and the fold-in was computed by the pre-fold
+        model and must not survive to the TTL. Same epoch discipline
+        as :meth:`on_event`: bump BEFORE removal so in-flight fills
+        drop themselves."""
+        for eid in entity_ids:
+            tag = entity_tag(entity_type, eid)
+            self._bump_tag(tag)
+            self.query.invalidate_tag(tag)
+            self.features.invalidate_tag(tag)
+
     # -- flush (rebind / operator) ------------------------------------------
     def flush_namespace(self, namespace: str) -> int:
         """Wipe one release arm's query results (promote/rollback of

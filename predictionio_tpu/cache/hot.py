@@ -125,6 +125,19 @@ class HotEntityTier:
                 if self._refresh_done is not None:
                     self._refresh_done.set()
 
+    def invalidate(self, keys) -> int:
+        """Drop the pinned handles for ``keys`` only — their factor
+        rows changed under the pin (a streaming fold-in rewrote them,
+        ISSUE 10) so a pinned serve would read the OLD rows. Hit stats
+        survive: the entities are as hot as ever and the next refresh
+        re-pins them from the updated table."""
+        dropped = 0
+        with self._lock:
+            for k in keys:
+                if self._pinned.pop(k, None) is not None:
+                    dropped += 1
+        return dropped
+
     def flush(self) -> int:
         """Drop pins and hit stats (model rebind / operator flush)."""
         with self._lock:

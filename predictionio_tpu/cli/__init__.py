@@ -323,7 +323,14 @@ def cmd_deploy(args, storage: Storage) -> int:
         feature_ttl_sec=args.feature_ttl,
         hot_entities=args.hot_entities,
         debug_locks=args.debug_locks,
-        serving_mode=args.serving_mode)
+        serving_mode=args.serving_mode,
+        streaming=args.stream,
+        stream_app_name=args.stream_app or None,
+        stream_interval_ms=args.stream_interval_ms,
+        stream_max_events=args.stream_max_events,
+        stream_consumer=args.stream_consumer,
+        stream_drift_threshold=args.stream_drift_threshold,
+        stream_canary_probes=args.stream_canary_probes)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -736,6 +743,25 @@ def cmd_status(args, storage: Storage) -> int:
                      f"({st.get('candidateMode')} at "
                      f"{float(st.get('fraction') or 0) * 100:.0f}%)")
         _out(line)
+    if getattr(args, "ip", ""):
+        # model-lineage satellite (ISSUE 10): when pointed at a live
+        # engine server, show what blend of batch + stream is actually
+        # serving — base retrain, fold-in generations, staleness
+        try:
+            status_payload = _server_call(args, "/status.json")
+        except Exception as e:  # noqa: BLE001 — liveness is optional
+            _err(f"engine server at {args.ip}:{args.port} unreachable "
+                 f"({e}); skipping lineage")
+            status_payload = None
+        lin = (status_payload or {}).get("lineage") or {}
+        if lin:
+            line = (f"Serving [{status_payload.get('engineId', '?')}]: "
+                    f"base {lin.get('baseInstanceId', '?')} "
+                    f"+{lin.get('incrementalGeneration', 0)} fold-ins "
+                    f"({lin.get('incrementalRows', 0)} rows), "
+                    f"staleness {lin.get('stalenessSec', '?')}s"
+                    + (", stream live" if lin.get("streaming") else ""))
+            _out(line)
     _out("(sleeping 0 seconds) Your system is all ready to go.")
     return 0
 
@@ -877,6 +903,73 @@ def cmd_cache(args, storage: Storage) -> int:
                                      for k, v in removed.items()))
         return 0
     _err(f"Unknown cache subcommand {sub!r}")
+    return 1
+
+
+def cmd_stream(args, storage: Storage) -> int:
+    """``ptpu stream`` — operate a running engine server's streaming
+    incremental trainer (ISSUE 10, docs/streaming.md): attach, stop,
+    and inspect the event→model loop."""
+    sub = args.stream_command
+    if sub == "status":
+        try:
+            payload = _server_call(args, "/stream.json")
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"engine server at {args.ip}:{args.port} unreachable: "
+                 f"{_http_err_detail(e)}")
+            return 1
+        _out(json.dumps(payload, indent=2))
+        lin = (payload or {}).get("lineage") or {}
+        if lin:
+            line = (f"serving: base {lin.get('baseInstanceId', '?')} "
+                    f"+{lin.get('incrementalGeneration', 0)} fold-ins "
+                    f"({lin.get('incrementalRows', 0)} rows), "
+                    f"staleness {lin.get('stalenessSec', '?')}s")
+            _out(line)
+        if not (payload or {}).get("running"):
+            _out("Streaming trainer is OFF (ptpu stream start --app "
+                 "<app>, or deploy with --stream).")
+        return 0
+    if sub == "start":
+        body = {}
+        if args.app:
+            body["appName"] = args.app
+        if args.channel:
+            body["channelName"] = args.channel
+        if args.consumer:
+            body["consumer"] = args.consumer
+        if args.interval_ms is not None:
+            body["intervalMs"] = args.interval_ms
+        if args.max_events is not None:
+            body["maxEvents"] = args.max_events
+        if args.drift_threshold is not None:
+            body["driftThreshold"] = args.drift_threshold
+        if args.canary_probes is not None:
+            body["canaryProbes"] = args.canary_probes
+        try:
+            resp = _server_call(args, "/stream/start", method="POST",
+                                body=body)
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"stream start failed: {_http_err_detail(e)}")
+            return 1
+        st = (resp or {}).get("stream") or {}
+        _out(f"Streaming trainer started (app "
+             f"{st.get('appName', '?')}, consumer "
+             f"{st.get('consumer', '?')}, interval "
+             f"{st.get('intervalMs', '?')}ms). Watch: ptpu stream "
+             f"status.")
+        return 0
+    if sub == "stop":
+        try:
+            resp = _server_call(args, "/stream/stop", method="POST")
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"stream stop failed: {_http_err_detail(e)}")
+            return 1
+        _out((resp or {}).get("message", "Stopped."))
+        _out("The durable cursor keeps its position; a later start "
+             "with the same consumer resumes exactly there.")
+        return 0
+    _err(f"Unknown stream subcommand {sub!r}")
     return 1
 
 
@@ -1302,6 +1395,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(batch, model) mesh (models > one HBM); "
                         "auto = sharded when the model exceeds the "
                         "per-device HBM headroom, else replicated")
+    s.add_argument("--stream", action="store_true",
+                   help="streaming incremental training "
+                        "(docs/streaming.md): a trainer daemon tails "
+                        "the event log and folds fresh events into "
+                        "the serving model within seconds")
+    s.add_argument("--stream-app", default="",
+                   help="app whose event log the trainer tails "
+                        "(defaults to --feedback-app-name)")
+    s.add_argument("--stream-interval-ms", type=float, default=500.0,
+                   help="fold-in poll fallback; in-process ingest "
+                        "wakes the trainer immediately via the bus")
+    s.add_argument("--stream-max-events", type=int, default=2048,
+                   help="events consumed per fold-in micro-batch")
+    s.add_argument("--stream-consumer", default="stream-trainer",
+                   help="durable cursor identity (resume point "
+                        "survives restarts under this name)")
+    s.add_argument("--stream-drift-threshold", type=float, default=1.0,
+                   help="DriftMonitor score that flags a full retrain")
+    s.add_argument("--stream-canary-probes", type=int, default=8,
+                   help="touched-entity probes gating each fold-in "
+                        "delta (0 disables the canary gate)")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -1378,6 +1492,34 @@ def build_parser() -> argparse.ArgumentParser:
         c.add_argument("--https", action="store_true")
         c.add_argument("--insecure", action="store_true")
 
+    s = sub.add_parser(
+        "stream", help="streaming incremental training: attach/stop/"
+                       "inspect the event→model loop on a running "
+                       "engine server (docs/streaming.md)")
+    stream_sub = s.add_subparsers(dest="stream_command", required=True)
+    for name, helptext in (
+            ("start", "attach the incremental trainer"),
+            ("status", "trainer state, cursor, drift, model lineage"),
+            ("stop", "stop the trainer (the durable cursor stays)")):
+        c = stream_sub.add_parser(name, help=helptext)
+        c.add_argument("--ip", default="127.0.0.1")
+        c.add_argument("--port", type=int, default=8000)
+        c.add_argument("--accesskey", default="")
+        c.add_argument("--https", action="store_true")
+        c.add_argument("--insecure", action="store_true")
+        if name == "start":
+            c.add_argument("--app", default="",
+                           help="app whose event log to tail (falls "
+                                "back to the server's deploy config)")
+            c.add_argument("--channel", default="")
+            c.add_argument("--consumer", default="",
+                           help="durable cursor identity")
+            c.add_argument("--interval-ms", type=float, default=None)
+            c.add_argument("--max-events", type=int, default=None)
+            c.add_argument("--drift-threshold", type=float,
+                           default=None)
+            c.add_argument("--canary-probes", type=int, default=None)
+
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)
     s.add_argument("--input", required=True)
@@ -1437,7 +1579,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--pid-dir", default="")
     s.add_argument("--stop-timeout", type=float, default=10.0)
 
-    sub.add_parser("status", help="check environment and storage")
+    s = sub.add_parser("status", help="check environment and storage")
+    s.add_argument("--ip", default="",
+                   help="also query a live engine server's "
+                        "/status.json for serving model lineage")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--accesskey", default="")
+    s.add_argument("--https", action="store_true")
+    s.add_argument("--insecure", action="store_true")
 
     s = sub.add_parser("export", help="export events to a JSON-lines file")
     s.add_argument("--appid", type=int, default=0)
@@ -1499,6 +1648,7 @@ COMMANDS = {
     "undeploy": cmd_undeploy,
     "release": cmd_release,
     "cache": cmd_cache,
+    "stream": cmd_stream,
     "batchpredict": cmd_batchpredict,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

@@ -165,8 +165,12 @@ def parse_iso(s: str) -> datetime:
     return t
 
 
-#: Entity types the framework itself writes (prediction feedback entities).
-BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+#: Entity types the framework itself writes: prediction feedback
+#: entities (``pio_pr``, the serving feedback loop) and the streaming
+#: trainer's durable consumer cursors (``pio_stream``, ISSUE 10 —
+#: persisted through EVENTDATA so they survive restarts with the log
+#: they index).
+BUILTIN_ENTITY_TYPES = frozenset({"pio_pr", "pio_stream"})
 
 #: Reserved name prefix for entity types and property names.
 RESERVED_PREFIX = "pio_"

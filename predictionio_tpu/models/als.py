@@ -1956,3 +1956,250 @@ def predict_rating(model: ALSModel, user_index: int, item_index: int) -> float:
     u = np.asarray(model.user_factors[user_index])
     v = np.asarray(model.item_factors[item_index])
     return float(u @ v)
+
+
+# -- streaming fold-in (ISSUE 10) --------------------------------------------
+#
+# The incremental-training primitives the StreamTrainer
+# (predictionio_tpu/streaming/) folds fresh events in with: per-entity
+# regularized least-squares solves against the FIXED opposite factor
+# table — one half-iteration of ALS restricted to the affected rows.
+# Because each row is re-solved from its FULL history, folding the same
+# events in twice lands on the same row: replay after a crash is
+# idempotent, which is what makes the cursor's at-least-once delivery
+# effectively exactly-once (docs/streaming.md).
+
+def dedupe_pairs(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Collapse repeated ``(row, col)`` pairs to the LAST value
+    (last-write-wins, in input order). A burst of identical events must
+    not multiply a pair's weight in the normal equations: under
+    implicit ALS every duplicate adds another ``alpha·r`` of confidence
+    for the SAME observation, and under explicit ALS the duplicated
+    entry counts as extra evidence — both skew the fold-in relative to
+    the batch trainer, whose input is one rating per (user, item)
+    (regression-tested by tests/test_streaming.py)."""
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    if len(rows) == 0:
+        return rows, cols, vals
+    # np.unique keeps the FIRST occurrence per key; index from the back
+    # so "first of reversed" is the last write
+    key = np.stack([rows[::-1], cols[::-1]], axis=1)
+    _, first_of_rev = np.unique(key, axis=0, return_index=True)
+    keep = np.sort(len(rows) - 1 - first_of_rev)
+    return rows[keep], cols[keep], vals[keep]
+
+
+def fixed_gramian(fixed, params: "ALSParams"):
+    """The implicit-path baseline Gramian FᵀF of the fixed side, for
+    callers that amortize it across fold-in micro-batches (it depends
+    only on the fixed table, not on which rows are being re-solved).
+    Explicit models need none — returns None."""
+    if not params.implicit_prefs:
+        return None
+    arr = jnp.asarray(fixed)
+    bf16 = params.matmul_dtype == "bfloat16"
+    if _is_row_sharded(arr):
+        with _mesh_dispatch_lock:  # the reduction launches collectives
+            return _fixed_gramian(arr, None, params.gram_mode, bf16)
+    return _fixed_gramian(arr, None, params.gram_mode, bf16)
+
+
+def _pow2_ceil(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+def fold_in_rows(fixed, indices: np.ndarray, values: np.ndarray,
+                 counts: np.ndarray, params: "ALSParams",
+                 G=None) -> np.ndarray:
+    """Batched per-row fold-in: solve ``[B]`` rows' normal equations
+    against the fixed opposite factor table — the streaming increment's
+    device path. Routes through :func:`_update_block` (and therefore
+    :func:`_lhs_fn`), so it shares the fused gather+Gramian kernel, the
+    bf16 gather shadow and the implicit/explicit weighting with the
+    batch trainer — the two solvers can never drift apart.
+
+    ``indices``/``values`` are ``[B, L]`` histories (padding slots
+    carry index 0 / value 0 and are masked by ``counts``). The batch
+    and history axes pad to the pow2 ladder so arbitrary micro-batch
+    shapes reuse O(log²) compilations. ``G`` (optional) is a
+    precomputed fixed-side Gramian (:func:`fixed_gramian`); implicit
+    callers that fold many micro-batches against one model should pass
+    it rather than paying the O(n·r²) reduction per batch.
+
+    Returns host ``[B, rank]`` f32 rows.
+    """
+    indices = np.asarray(indices, dtype=np.int32)
+    values = np.asarray(values, dtype=np.float32)
+    counts = np.asarray(counts, dtype=np.int32)
+    B, L = indices.shape
+    if B == 0:
+        return np.zeros((0, fixed.shape[-1]), np.float32)
+    Bp = _pow2_ceil(B)
+    Lp = _pow2_ceil(max(L, 1), lo=8)
+    idx = np.zeros((1, Bp, Lp), dtype=np.int32)
+    val = np.zeros((1, Bp, Lp), dtype=np.float32)
+    cnt = np.zeros((1, Bp), dtype=np.int32)
+    idx[0, :B, :L] = indices
+    val[0, :B, :L] = values
+    cnt[0, :B] = counts
+    implicit = params.implicit_prefs
+    bf16 = params.matmul_dtype == "bfloat16"
+    table = jnp.asarray(fixed)
+
+    def _solve():
+        nonlocal G
+        if implicit and G is None:
+            G = _fixed_gramian(table, None, params.gram_mode, bf16)
+        if not implicit:
+            # static-arg shape filler, exactly like _update_side_split
+            G = jnp.zeros((table.shape[-1],) * 2, jnp.float32)
+        gsrc = table.astype(jnp.bfloat16) \
+            if params.gather_dtype == "bfloat16" else table
+        new = _update_block(gsrc, G, idx, val, cnt, params.reg,
+                            params.alpha, implicit,
+                            params.scale_reg_by_count, bf16=bf16,
+                            gram=params.gram_mode, mesh=None)
+        return np.asarray(jax.device_get(new[0][:B]), dtype=np.float32)
+
+    if _is_row_sharded(table):
+        # row-sharded serving table (ISSUE 6): GSPMD resolves the
+        # gathers with collectives — launches must not interleave with
+        # a concurrent serving dispatch's, exactly like recommend_*
+        with _mesh_dispatch_lock:
+            return _solve()
+    return _solve()
+
+
+def _scatter_rows(table: jax.Array, row_idx: np.ndarray,
+                  rows: np.ndarray) -> jax.Array:
+    """Functional device row update (NO donation: the previous table
+    may still be serving through the old binding until the swap
+    lands). The index axis pads to the pow2 ladder — duplicates of
+    slot 0 re-write the same value, so padding is inert."""
+    B = len(row_idx)
+    Bp = _pow2_ceil(max(B, 1))
+    idx = np.empty(Bp, dtype=np.int64)
+    idx[:B] = row_idx
+    idx[B:] = row_idx[0] if B else 0
+    vals = np.empty((Bp, rows.shape[-1]), dtype=np.float32)
+    vals[:B] = rows
+    vals[B:] = rows[0] if B else 0.0
+    return _scatter_rows_fn(jnp.asarray(table), idx, vals)
+
+
+@jax.jit
+def _scatter_rows_fn(table: jax.Array, idx: jax.Array,
+                     rows: jax.Array) -> jax.Array:
+    return table.at[idx].set(rows.astype(table.dtype))
+
+
+def apply_row_updates(model: ALSModel, side: str, row_idx: np.ndarray,
+                      rows: np.ndarray) -> ALSModel:
+    """A NEW model with ``side``'s factor rows at ``row_idx`` replaced
+    by ``rows`` — the delta the streaming trainer hot-swaps into the
+    serving binding. Purely functional: the input model (possibly still
+    bound and serving) is never mutated, so a reader holding the old
+    binding keeps a consistent table.
+
+    Host-resident tables copy-and-write (numpy); device tables scatter
+    through a compiled ``at[].set`` (no donation — see above); row-
+    sharded tables run the same scatter under ``_mesh_dispatch_lock``
+    (GSPMD keeps the output sharding) so a concurrent serving dispatch
+    can't interleave collective launches."""
+    import dataclasses
+
+    if side not in ("user", "item"):
+        raise ValueError(f"side must be 'user' or 'item', got {side!r}")
+    name = "user_factors" if side == "user" else "item_factors"
+    table = getattr(model, name)
+    row_idx = np.asarray(row_idx, dtype=np.int64)
+    rows = np.asarray(rows, dtype=np.float32)
+    if len(row_idx) == 0:
+        return model
+    if isinstance(table, np.ndarray):
+        new = table.copy()
+        new[row_idx] = rows
+    elif _is_row_sharded(table):
+        with _mesh_dispatch_lock:
+            new = _scatter_rows(table, row_idx, rows)
+            new.block_until_ready()
+    else:
+        new = _scatter_rows(table, row_idx, rows)
+    return dataclasses.replace(model, **{name: new})
+
+
+#: cold-start capacity growth floor: when a side's table has no free
+#: padding rows left, it grows by at least this many zero rows at once
+#: so per-entity appends don't re-allocate (and re-place) the table on
+#: every single new user/item
+COLD_START_GROW_MIN = 64
+
+
+def extend_factor_rows(model: ALSModel, side: str, new_keys: Sequence[str],
+                       rows: np.ndarray) -> ALSModel:
+    """Cold-start row insertion (ISSUE 10): register ``new_keys`` as
+    fresh entities on ``side`` with the given factor rows. Training
+    pads factor tables past ``n_users``/``n_items`` for even sharding —
+    those zero padding rows are CLAIMED first (no reallocation, no new
+    compiled serving shapes beyond the n_items bump); only when the
+    table is full does it grow, by pow2-rounded chunks
+    (:data:`COLD_START_GROW_MIN`), with the new capacity again zero-
+    padded. Returns a new model: extended id map, bumped real count,
+    rows written via :func:`apply_row_updates`."""
+    import dataclasses
+
+    from ..data.bimap import BiMap
+
+    if side not in ("user", "item"):
+        raise ValueError(f"side must be 'user' or 'item', got {side!r}")
+    new_keys = list(new_keys)
+    if not new_keys:
+        return model
+    name = "user_factors" if side == "user" else "item_factors"
+    ids_name = "user_ids" if side == "user" else "item_ids"
+    count_name = "n_users" if side == "user" else "n_items"
+    table = getattr(model, name)
+    ids = getattr(model, ids_name)
+    n_real = getattr(model, count_name)
+    rows = np.asarray(rows, dtype=np.float32)
+    if rows.shape[0] != len(new_keys):
+        raise ValueError(f"{len(new_keys)} keys but {rows.shape[0]} rows")
+    for k in new_keys:
+        if ids is not None and k in ids:
+            raise ValueError(f"{side} {k!r} already indexed; fold in "
+                             f"through apply_row_updates instead")
+    n_after = n_real + len(new_keys)
+    capacity = int(table.shape[0])
+    if n_after > capacity:
+        grow = _pow2_ceil(max(n_after - capacity, COLD_START_GROW_MIN))
+        mesh = getattr(model, "mesh", None)
+        if isinstance(table, np.ndarray):
+            table = np.vstack([table, np.zeros((grow, table.shape[-1]),
+                                               table.dtype)])
+        elif mesh is not None and _is_row_sharded(table):
+            # sharded growth: pull the shards together once, extend to
+            # a device multiple, re-place row-sharded (the same
+            # placement shard_model derives)
+            host = jax.device_get(table)
+            n_dev = mesh.devices.size
+            host = np.vstack([host, np.zeros((grow, host.shape[-1]),
+                                             host.dtype)])
+            host = _pad_rows(host, n_dev)
+            table = jax.device_put(
+                host, NamedSharding(mesh, rows_spec(mesh)))
+        else:
+            pad = jnp.zeros((grow, table.shape[-1]), table.dtype)
+            table = jnp.concatenate([jnp.asarray(table), pad], axis=0)
+    fwd = dict(ids.items()) if ids is not None else {}
+    for i, k in enumerate(new_keys):
+        fwd[k] = n_real + i
+    model = dataclasses.replace(
+        model, **{name: table, ids_name: BiMap(fwd), count_name: n_after})
+    return apply_row_updates(
+        model, side, np.arange(n_real, n_after, dtype=np.int64), rows)

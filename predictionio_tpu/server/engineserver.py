@@ -194,6 +194,27 @@ class ServerConfig:
     #: "auto" — sharded when the model's resident bytes exceed the
     #: per-device HBM headroom, else replicated on >1 device.
     serving_mode: str = "single"
+    #: Streaming incremental training (ISSUE 10, docs/streaming.md):
+    #: start a :class:`~predictionio_tpu.streaming.StreamTrainer` with
+    #: the deploy — it tails ``stream_app_name``'s event log behind a
+    #: durable cursor, folds fresh events into the bound ALS model via
+    #: per-entity least-squares solves, canaries each delta, and
+    #: hot-swaps the updated rows into this serving binding. Off by
+    #: default; ``ptpu stream start`` attaches one to a live server.
+    streaming: bool = False
+    #: App whose event log the trainer tails (required when
+    #: ``streaming``; falls back to ``feedback_app_name``).
+    stream_app_name: Optional[str] = None
+    #: Poll fallback between fold-in passes; in-process ingest wakes
+    #: the trainer immediately through the invalidation bus.
+    stream_interval_ms: float = 500.0
+    stream_max_events: int = 2048      # events per fold-in micro-batch
+    #: durable cursor identity (two trainers sharing a consumer name
+    #: fight over one cursor)
+    stream_consumer: str = "stream-trainer"
+    stream_drift_threshold: float = 1.0  # DriftMonitor retrain trigger
+    #: touched-entity probes per fold-in canary check (0 disables)
+    stream_canary_probes: int = 8
 
 
 @dataclass
@@ -420,6 +441,12 @@ class QueryServer:
         else:
             self.warm_done.set()
             self.recompile_sentinel.arm()
+        # streaming incremental training (ISSUE 10): the deploy-time
+        # trainer. Fail fast on a bad config — a deploy that silently
+        # drops its freshness contract is worse than one that errors.
+        self.stream = None
+        if self.config.streaming:
+            self.start_stream()
 
     def _warm_serving(self, gen: int) -> None:
         """Pre-compile the serving path's device shapes (single query +
@@ -467,6 +494,14 @@ class QueryServer:
                 self.cache.flush_all()
             self.engine_params = engine_params
             self.instance = instance
+            # stream lineage (ISSUE 10): a rebind installs a fresh
+            # full-retrain base — the incremental generation restarts
+            # from it (the StreamTrainer notices the new instance id
+            # and re-folds pending events against the new base)
+            self._stream_generation = 0
+            self._stream_rows = 0
+            self._stream_last_apply: Optional[float] = None
+            self._stream_base_bound_at = time.time()
             self.algorithms = self.engine.make_algorithms(engine_params)
             for algo in self.algorithms:
                 algo.bind_serving(self.ctx)
@@ -1433,6 +1468,180 @@ class QueryServer:
         self._shadow_mirrors.inc()
         pool.submit(_mirror)
 
+    # -- streaming incremental training (ISSUE 10) --------------------------
+    def stream_snapshot(self, algo_index: int = 0):
+        """The streaming trainer's read side: ``(instance_id, model)``
+        of the CURRENT stable binding, or None when the indexed
+        algorithm's model is not foldable (no id maps — not an ALS
+        factor model). The pair is snapshotted under the binding lock
+        so the fold-in solves against a model that actually served
+        together with that instance id; the apply re-checks the id."""
+        with self._lock:
+            if not (0 <= algo_index < len(self.models)):
+                return None
+            model = self.models[algo_index]
+            instance_id = self.instance.id
+        if getattr(model, "user_ids", None) is None \
+                or getattr(model, "item_ids", None) is None:
+            return None
+        return instance_id, model
+
+    def apply_stream_delta(self, algo_index: int, new_model: Any,
+                           touched_entities: List[str],
+                           base_instance_id: str,
+                           rows_updated: int = 0,
+                           rows_inserted: int = 0) -> bool:
+        """Hot-swap a fold-in delta into the serving binding: the
+        streaming twin of promote's ``_bind``, scoped to one
+        algorithm's model. Under the binding lock the base instance id
+        is re-checked — a reload/promote that raced the fold-in wins
+        and the apply returns False (the trainer's unadvanced cursor
+        re-folds against the new base). Replicated lanes re-derive
+        their per-device copies from the folded model so every lane
+        serves the new rows. After the swap, cached results and pinned
+        hot-tier rows for exactly the touched entities are
+        invalidated (docs/streaming.md)."""
+        with self._lock:
+            if self.instance.id != base_instance_id:
+                return False
+            if not (0 <= algo_index < len(self.algorithms)):
+                return False
+            has_lanes = bool(self.lane_models)
+            rep = (getattr(self.algorithms[algo_index],
+                           "replicate_serving_model", None)
+                   if has_lanes else None)
+            devices = list(self.lane_devices) if has_lanes else []
+        # per-device replication OUTSIDE the lock: device_put of a
+        # whole factor table must not stall queries, and the algorithm
+        # hook is dynamically bound. The id re-check below voids the
+        # copies if a rebind raced us.
+        lane_copies = ([rep(new_model, dev) for dev in devices]
+                       if rep is not None
+                       else [new_model] * len(devices))
+        with self._lock:
+            if self.instance.id != base_instance_id:
+                return False
+            self.models[algo_index] = new_model
+            if self.lane_models:
+                for lane, copy in enumerate(lane_copies):
+                    self.lane_models[lane][algo_index] = copy
+            self._stream_generation += 1
+            self._stream_rows += int(rows_updated) + int(rows_inserted)
+            self._stream_last_apply = time.time()
+            cache = self.cache
+        if cache is not None and touched_entities:
+            # per-entity, not a flush: untouched entities' cached
+            # results are still exactly right — that precision is the
+            # point of folding rows instead of rebinding
+            cache.invalidate_entities("user", touched_entities)
+            if cache.hot is not None:
+                cache.hot.invalidate(touched_entities)
+                cache.hot.refresh(wait=False)  # re-pin from new rows
+        return True
+
+    def start_stream(self, config=None):
+        """Attach (and start) the streaming trainer. ``config`` is a
+        :class:`~predictionio_tpu.streaming.StreamConfig`; None builds
+        one from the ``ServerConfig.stream_*`` knobs. Raises
+        ``ValueError`` on a bad app/channel (deploy fails fast) and
+        ``HTTPError`` 409 when one is already running."""
+        from ..streaming import StreamConfig, StreamTrainer
+
+        with self._lock:
+            if self.stream is not None and self.stream.running:
+                raise HTTPError(
+                    409, "streaming trainer already running (consumer "
+                         f"{self.stream.config.consumer!r}); stop it "
+                         f"first")
+        cfg = config or StreamConfig(
+            interval_ms=self.config.stream_interval_ms,
+            max_events=self.config.stream_max_events,
+            consumer=self.config.stream_consumer,
+            drift_threshold=self.config.stream_drift_threshold,
+            canary_probes=self.config.stream_canary_probes)
+        if not cfg.app_name:
+            cfg.app_name = (self.config.stream_app_name
+                            or self.config.feedback_app_name or "")
+        if not cfg.app_name:
+            raise ValueError(
+                "streaming requires an app name (ServerConfig."
+                "stream_app_name, --stream-app, or the request's "
+                "appName) — the app whose event log the trainer tails")
+        trainer = StreamTrainer(self, cfg)
+        with self._lock:
+            self.stream = trainer
+            instance_id = self.instance.id
+        trainer.start()
+        try:
+            self.releases.record(
+                "stream-start", instance_id=instance_id,
+                actor=f"stream-trainer:{cfg.consumer}",
+                reason=f"tailing app {cfg.app_name!r} every "
+                       f"{cfg.interval_ms:g}ms")
+        except Exception as e:  # noqa: BLE001 — history is best-effort
+            log.error("release history write failed on stream-start: "
+                      "%s", e)
+        log.info("streaming trainer started (app %s, consumer %s)",
+                 cfg.app_name, cfg.consumer)
+        return trainer
+
+    def stop_stream(self, timeout: float = 10.0) -> bool:
+        """Stop and detach the streaming trainer; False when none is
+        attached. The durable cursor stays in EVENTDATA — a later
+        start with the same consumer resumes exactly where this one
+        stopped."""
+        with self._lock:
+            trainer = self.stream
+            self.stream = None
+            instance_id = self.instance.id
+        if trainer is None:
+            return False
+        trainer.stop(timeout=timeout)
+        try:
+            self.releases.record(
+                "stream-stop", instance_id=instance_id,
+                actor=f"stream-trainer:{trainer.config.consumer}",
+                reason=f"{trainer.applies} deltas applied, "
+                       f"{trainer.events_consumed} events consumed")
+        except Exception as e:  # noqa: BLE001 — history is best-effort
+            log.error("release history write failed on stream-stop: "
+                      "%s", e)
+        return True
+
+    def stream_lineage(self) -> dict:
+        """What blend of batch + stream is actually serving (ISSUE 10
+        satellite): the base full-retrain instance, how many fold-in
+        generations sit on top of it, and how stale the serving model
+        is — seconds since it last absorbed data (the last fold-in,
+        else the base retrain's completion)."""
+        with self._lock:
+            base = self.instance
+            gen = self._stream_generation
+            rows = self._stream_rows
+            last = self._stream_last_apply
+            bound = self._stream_base_bound_at
+            trainer = self.stream
+        now = time.time()
+        trained = getattr(base, "end_time", None)
+        if last is not None:
+            staleness = now - last
+        elif trained is not None:
+            try:
+                staleness = max(0.0, now - trained.timestamp())
+            except (OSError, OverflowError, ValueError):
+                staleness = now - bound
+        else:
+            staleness = now - bound
+        return {
+            "baseInstanceId": base.id,
+            "incrementalGeneration": gen,
+            "incrementalRows": rows,
+            "lastFoldInSecAgo": (round(now - last, 3)
+                                 if last is not None else None),
+            "stalenessSec": round(staleness, 3),
+            "streaming": trainer is not None and trainer.running,
+        }
+
     def remote_log(self, message: str, wait: bool = False) -> None:
         """Ship an error to the configured log collector
         (``remoteLog``, ``CreateServer.scala:435-446``); failures to ship
@@ -1578,6 +1787,21 @@ def build_app(server: QueryServer) -> HTTPApp:
             parts.append(f"deadline sheds {p['deadlineExceeded']}")
         return "<li>" + html.escape(" · ".join(parts)) + "</li>"
 
+    def _stream_line() -> str:
+        """One status-page line on the batch+stream blend serving
+        right now (ISSUE 10): base instance, fold-in generations,
+        staleness."""
+        lin = server.stream_lineage()
+        parts = [f"model lineage: base {lin['baseInstanceId']}"]
+        if lin["incrementalGeneration"]:
+            parts.append(f"+{lin['incrementalGeneration']} fold-ins "
+                         f"({lin['incrementalRows']} rows)")
+        parts.append(f"staleness {lin['stalenessSec']:.1f}s")
+        if lin["streaming"]:
+            parts.append("stream live")
+        return ("<li>" + html.escape(" · ".join(parts))
+                + " (<a href='/stream.json'>stream.json</a>)</li>")
+
     def _cache_line() -> str:
         if server.cache is None:
             return ""
@@ -1684,7 +1908,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
-{_pipeline_line()}{_cache_line()}
+{_pipeline_line()}{_stream_line()}{_cache_line()}
 </ul>{_mesh_panel()}{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
@@ -1708,12 +1932,74 @@ def build_app(server: QueryServer) -> HTTPApp:
             "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
             "pipeline": server.pipeline_status(),
+            "lineage": server.stream_lineage(),
+            "stream": (server.stream.status()
+                       if server.stream is not None
+                       else {"running": False}),
             "mesh": server.mesh_status(),
             "hbm": hbm_stats(),
             "cache": (server.cache.stats() if server.cache is not None
                       else {"enabled": False}),
             **_phase_table(),
         })
+
+    # -- streaming incremental training (ISSUE 10) ---------------------------
+    @app.route("GET", "/stream.json")
+    def stream_json(req: Request) -> Response:
+        """Streaming-trainer state + model lineage (what ``ptpu stream
+        status`` prints)."""
+        trainer = server.stream
+        if trainer is None:
+            return json_response({
+                "running": False,
+                "lineage": server.stream_lineage(),
+                "hint": "POST /stream/start {\"appName\": ...} (or "
+                        "deploy with --stream) to attach the "
+                        "incremental trainer"})
+        return json_response({**trainer.status(),
+                              "lineage": server.stream_lineage()})
+
+    @app.route("POST", "/stream/start")
+    def stream_start(req: Request) -> Response:
+        """Attach the streaming trainer to this live server:
+        ``{"appName": ..., "channelName": ..., "intervalMs": ...,
+        "maxEvents": ..., "consumer": ..., "driftThreshold": ...,
+        "canaryProbes": ...}`` — every field optional when the deploy
+        config already names the app."""
+        from ..streaming import StreamConfig
+
+        _auth(req)
+        try:
+            body = req.json() or {}
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        scfg = StreamConfig(
+            app_name=str(body.get("appName")
+                         or cfg.stream_app_name
+                         or cfg.feedback_app_name or ""),
+            channel_name=body.get("channelName") or None,
+            consumer=str(body.get("consumer") or cfg.stream_consumer),
+            interval_ms=float(body.get("intervalMs",
+                                       cfg.stream_interval_ms)),
+            max_events=int(body.get("maxEvents",
+                                    cfg.stream_max_events)),
+            drift_threshold=float(body.get("driftThreshold",
+                                           cfg.stream_drift_threshold)),
+            canary_probes=int(body.get("canaryProbes",
+                                       cfg.stream_canary_probes)))
+        try:
+            trainer = server.start_stream(scfg)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return json_response({"message": "Streaming trainer started.",
+                              "stream": trainer.status()})
+
+    @app.route("POST", "/stream/stop")
+    def stream_stop(req: Request) -> Response:
+        _auth(req)
+        if not server.stop_stream():
+            raise HTTPError(409, "no streaming trainer is running")
+        return json_response({"message": "Streaming trainer stopped."})
 
     # -- serving cache operations (ISSUE 4) ----------------------------------
     @app.route("GET", "/cache.json")
@@ -1883,6 +2169,7 @@ def build_app(server: QueryServer) -> HTTPApp:
         _auth(req)
         if server.rollout is not None:
             server.rollout.stop()  # loop only; bindings die with us
+        server.stop_stream()  # cursor already persisted; no-op if off
 
         def delayed_shutdown():
             # grace period so THIS response flushes before the listener

@@ -119,6 +119,22 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
             invalidations_pub.inc()
         except Exception as e:  # noqa: BLE001
             log.error("invalidation publish failed: %s", e)
+
+    def _publish_batch(app_id: int, events: List[Event]) -> None:
+        """Coalesced publish for an accepted batch (ISSUE 10
+        satellite): one subscriber snapshot + one stats update for the
+        whole batch instead of a full publish (two lock passes + a
+        dead-ref sweep) per event. Tag semantics are exactly those of
+        N single publishes — every subscriber still sees every item."""
+        if not events:
+            return
+        try:
+            inval_bus.publish_many(
+                app_id, [(e.entity_type, e.entity_id, e.event)
+                         for e in events])
+            invalidations_pub.inc(len(events))
+        except Exception as e:  # noqa: BLE001
+            log.error("invalidation publish failed: %s", e)
     mount_metrics(app, registry, server_name="eventserver",
                   status=lambda: {"status": "alive",
                                   "statsEnabled": bool(collector)})
@@ -239,11 +255,12 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                     [e for _, e in valid], auth.app_id, auth.channel_id)
             except Exception:  # noqa: BLE001 — isolate per event
                 ids = None
+            accepted: list = []  # published ONCE, after the loop
             if ids is not None:
                 for (pos, event), eid in zip(valid, ids):
                     results[pos] = {"status": 201, "eventId": eid}
                     ingested.labels(route="batch").inc()
-                    _publish(auth.app_id, event)
+                    accepted.append(event)
                     if collector:
                         collector.bookkeeping(auth.app_id, 201, event)
             else:
@@ -253,11 +270,12 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                                                  auth.channel_id)
                         results[pos] = {"status": 201, "eventId": eid}
                         ingested.labels(route="batch").inc()
-                        _publish(auth.app_id, event)
+                        accepted.append(event)
                         if collector:
                             collector.bookkeeping(auth.app_id, 201, event)
                     except Exception as e:  # noqa: BLE001
                         results[pos] = {"status": 500, "message": str(e)}
+            _publish_batch(auth.app_id, accepted)
         return json_response(results)
 
     @app.route("GET", "/stats.json")

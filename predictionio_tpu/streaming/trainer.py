@@ -1,0 +1,433 @@
+"""StreamTrainer: the daemon that closes the event→model loop.
+
+Consumes accepted ingests behind a durable :class:`~.cursor.EventCursor`
+(the correctness path — catch-up is a cursor read, so no event is lost
+across restarts), with the serving cache's
+:class:`~predictionio_tpu.cache.bus.InvalidationBus` as the
+low-latency wake signal (the same publish the event server already
+makes on every accepted ingest). Each wake folds the pending
+micro-batch into the bound ALS model through per-entity least-squares
+solves (:mod:`.foldin` →
+:func:`~predictionio_tpu.models.als.fold_in_rows`), canaries the
+folded model against the serving one with a
+:class:`~predictionio_tpu.rollout.HealthPolicy` probe, and hot-swaps
+the updated rows into the live ``QueryServer`` binding through its
+delta-apply path — invalidating cached results and pinned hot-tier
+rows for exactly the touched entities.
+
+A :class:`~.drift.DriftMonitor` watches fold-in residuals and
+rating-distribution shift; past threshold it flags ``retrain_due`` (and
+fires the optional ``on_retrain`` hook) — full retrains become a
+drift-triggered background job instead of the freshness path.
+
+Threading: ONE daemon loop owns consume→fold→apply→advance; the bus
+callback only sets a wake event (never does work on the ingest
+thread). The loop's model snapshot/swap goes through
+``QueryServer.apply_stream_delta``, which re-checks the binding
+identity under the server lock — a reload/promote racing a fold-in
+aborts the apply and the (unadvanced) cursor retries against the new
+base on the next tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..cache.bus import InvalidationBus, default_bus
+from ..data.event import to_millis
+from ..obs import DEFAULT_LATENCY_BOUNDS
+from ..rollout.policy import ArmWindow, HealthPolicy
+from .cursor import EventCursor
+from .drift import DriftMonitor
+from .foldin import DEFAULT_EVENT_WEIGHTS, fold_in_events
+
+log = logging.getLogger(__name__)
+
+__all__ = ["StreamConfig", "StreamTrainer"]
+
+
+@dataclass
+class StreamConfig:
+    """Knobs of the incremental trainer (``ptpu deploy --stream*``)."""
+
+    #: app whose event log is tailed (defaults to the engine's
+    #: datasource app at start_stream time)
+    app_name: str = ""
+    channel_name: Optional[str] = None
+    #: durable cursor identity — two trainers with the same consumer
+    #: name share (and fight over) one cursor; name them apart
+    consumer: str = "stream-trainer"
+    #: micro-batch window: the poll fallback when no bus wake arrives
+    #: (in-process ingest wakes the loop immediately)
+    interval_ms: float = 500.0
+    #: events consumed per fold-in pass (backlog drains at this rate)
+    max_events: int = 2048
+    #: per-entity history cap at fold-in assembly (most recent kept)
+    max_history: int = 512
+    #: event → rating projection; None = the recommendation template's
+    #: default ({"rate": None, "buy": 4.0})
+    event_weights: Optional[Dict[str, Optional[float]]] = None
+    #: DriftMonitor trigger (docs/streaming.md)
+    drift_threshold: float = 1.0
+    #: touched-entity probes per canary check (0 disables the gate)
+    canary_probes: int = 8
+    #: which bound algorithm the deltas apply to
+    algo_index: int = 0
+
+
+class StreamTrainer:
+    def __init__(self, server, config: Optional[StreamConfig] = None,
+                 bus: Optional[InvalidationBus] = None,
+                 policy: Optional[HealthPolicy] = None,
+                 on_retrain: Optional[Callable[[dict], None]] = None):
+        self.server = server
+        self.config = config or StreamConfig()
+        storage = server.ctx.storage
+        app_name = self.config.app_name
+        if not app_name:
+            raise ValueError("StreamConfig.app_name required (the app "
+                             "whose event log the trainer tails)")
+        app = storage.apps().get_by_name(app_name)
+        if app is None:
+            raise ValueError(f"app {app_name!r} does not exist")
+        self.app_id = app.id
+        self.channel_id = None
+        if self.config.channel_name:
+            chans = storage.channels().get_by_app_id(app.id)
+            match = next((c for c in chans
+                          if c.name == self.config.channel_name), None)
+            if match is None:
+                raise ValueError(
+                    f"channel {self.config.channel_name!r} does not "
+                    f"exist in app {app_name!r}")
+            self.channel_id = match.id
+        self.weights = (dict(self.config.event_weights)
+                        if self.config.event_weights
+                        else dict(DEFAULT_EVENT_WEIGHTS))
+        self.cursor = EventCursor(storage, self.app_id,
+                                  self.config.consumer, self.channel_id)
+        self.drift = DriftMonitor(threshold=self.config.drift_threshold)
+        #: probe-scale gate: one window per fold-in, judged on the
+        #: probe set — min_queries=1 so tiny batches still get a verdict
+        self.policy = policy or HealthPolicy(min_queries=1)
+        self.on_retrain = on_retrain
+        self._retrain_fired = False
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._G = None          # cached implicit fixed-side Gramian
+        self._base_seen = None  # full-retrain instance the cache is for
+        self._last_lag = 0
+        self._last_error: Optional[str] = None
+        self._last_batch: dict = {}
+        self.applies = 0
+        self.rejects = 0
+        self.events_consumed = 0
+        self._register_metrics(server.metrics)
+        self.bus = bus if bus is not None else default_bus()
+        self.bus.subscribe(self, "on_ingest")
+
+    # -- metrics -------------------------------------------------------------
+    def _register_metrics(self, registry) -> None:
+        self._m_consumed = registry.counter(
+            "pio_stream_events_consumed_total",
+            "Events consumed from the log by the streaming trainer")
+        self._m_foldin = registry.histogram(
+            "pio_stream_foldin_seconds",
+            "Wall time of one fold-in pass (assembly + device solves "
+            "+ delta apply)", bounds=DEFAULT_LATENCY_BOUNDS)
+        self._m_freshness = registry.histogram(
+            "pio_stream_freshness_seconds",
+            "Event→servable freshness: ingest creation time to the "
+            "moment the folded rows were serving",
+            bounds=DEFAULT_LATENCY_BOUNDS)
+        self._m_applies = registry.counter(
+            "pio_stream_applies_total",
+            "Fold-in deltas hot-swapped into the serving binding")
+        self._m_rows = registry.counter(
+            "pio_stream_rows_updated_total",
+            "Factor rows written by fold-in, by kind "
+            "(updated / user_cold / item_cold)")
+        self._m_rejects = registry.counter(
+            "pio_stream_canary_rejects_total",
+            "Fold-in deltas the HealthPolicy probe gate refused to "
+            "swap in")
+        registry.gauge(
+            "pio_stream_cursor_lag",
+            "Unconsumed relevant events behind the durable cursor at "
+            "the last pass (scan-capped)",
+            fn=lambda: float(self._last_lag))
+        registry.gauge(
+            "pio_stream_drift_score",
+            "DriftMonitor score (>= threshold flags a full retrain)",
+            fn=lambda: self.drift.score())
+        registry.gauge(
+            "pio_stream_running",
+            "1 while the streaming trainer loop is alive",
+            fn=lambda: 1.0 if self.running else 0.0)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> "StreamTrainer":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="stream-trainer")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def on_ingest(self, app_id, entity_type: str, entity_id: str,
+                  event_name: str = "") -> None:
+        """Bus subscriber: an accepted ingest for our app wakes the
+        loop NOW (the low-latency path); anything else is covered by
+        the interval poll (the correctness path). Never does work on
+        the ingest thread."""
+        if app_id is not None and app_id != self.app_id:
+            return
+        if event_name and event_name not in self.weights:
+            return
+        self._wake.set()
+
+    def _run(self) -> None:
+        interval = max(self.config.interval_ms, 1.0) / 1000.0
+        while not self._stop.is_set():
+            self._wake.wait(timeout=interval)
+            if self._stop.is_set():
+                break
+            self._wake.clear()
+            try:
+                n = self.consume_once()
+                if n >= self.config.max_events:
+                    self._wake.set()  # backlog: keep draining
+            except Exception as e:  # noqa: BLE001 — the loop survives
+                self._last_error = str(e)
+                log.exception("stream fold-in pass failed: %s", e)
+
+    # -- one pass ------------------------------------------------------------
+    def consume_once(self) -> int:
+        """One consume→fold→canary→apply→advance pass; returns how
+        many events were consumed (0 = nothing pending or the apply
+        lost a rebind race and will retry)."""
+        events = self.cursor.pending(event_names=list(self.weights),
+                                     entity_type="user",
+                                     limit=self.config.max_events)
+        self._last_lag = len(events)
+        if not events:
+            return 0
+        t0 = time.monotonic()
+        snap = self.server.stream_snapshot(self.config.algo_index)
+        if snap is None:
+            return 0  # no foldable model bound (non-ALS algorithm)
+        base_instance, model = snap
+        if base_instance != self._base_seen:
+            # a new full retrain is serving: its distribution is the
+            # new baseline and any cached Gramian is for dead factors
+            self._base_seen = base_instance
+            self._G = None
+            self._retrain_fired = False
+            self.drift.reset()
+        new_model, report = fold_in_events(
+            model, events, self.server.ctx.storage, self.app_id,
+            channel_id=self.channel_id, weights=self.weights,
+            max_history=self.config.max_history, G=self._G)
+        if model.params.implicit_prefs and report.items_inserted == 0 \
+                and self._G is None:
+            from ..models.als import fixed_gramian
+
+            # amortize the fixed-side Gramian across batches that
+            # didn't change the item table
+            self._G = fixed_gramian(new_model.item_factors,
+                                    new_model.params)
+        elif report.items_inserted:
+            self._G = None
+        self.drift.observe(report.values, report.residual)
+        touched = sorted({e.entity_id for e in events
+                          if e.entity_type == "user"})
+        if report.events_relevant == 0:
+            # nothing projectable (e.g. unrelated event names that
+            # slipped the filter): just move the cursor past them
+            self.cursor.advance(events)
+            self.cursor.save()
+            return len(events)
+        verdict = self._canary_check(model, new_model, touched)
+        if verdict is not None and verdict.action == "rollback":
+            # refuse the delta, move on (retrying the same solve
+            # yields the same rows), and escalate to the drift lane —
+            # repeated probe failures are exactly "incremental quality
+            # decayed"
+            self.rejects += 1
+            self._m_rejects.inc()
+            self._record_release("stream-reject", base_instance,
+                                 verdict.reason)
+            self.cursor.advance(events)
+            self.cursor.save()
+            self._maybe_retrain()
+            return len(events)
+        applied = self.server.apply_stream_delta(
+            self.config.algo_index, new_model, touched,
+            base_instance_id=base_instance,
+            rows_updated=report.users_updated,
+            rows_inserted=report.users_inserted + report.items_inserted)
+        if not applied:
+            # the binding moved under us (reload/promote): nothing
+            # consumed — the next pass re-folds against the new base
+            self._wake.set()
+            return 0
+        self.cursor.advance(events)
+        self.cursor.save()
+        dt = time.monotonic() - t0
+        now_ms = time.time() * 1000.0
+        for e in events:
+            fresh = max(0.0, (now_ms - to_millis(e.creation_time))
+                        / 1000.0)
+            self._m_freshness.observe(fresh)
+        self.events_consumed += len(events)
+        self.applies += 1
+        self._m_consumed.inc(len(events))
+        self._m_applies.inc()
+        self._m_foldin.observe(dt)
+        self._m_rows.labels(kind="updated").inc(report.users_updated)
+        if report.users_inserted:
+            self._m_rows.labels(kind="user_cold").inc(
+                report.users_inserted)
+        if report.items_inserted:
+            self._m_rows.labels(kind="item_cold").inc(
+                report.items_inserted)
+        self._last_batch = {
+            "events": len(events),
+            "relevant": report.events_relevant,
+            "usersUpdated": report.users_updated,
+            "usersInserted": report.users_inserted,
+            "itemsInserted": report.items_inserted,
+            "residual": report.residual,
+            "foldinMs": round(dt * 1000, 3),
+        }
+        self._maybe_retrain()
+        return len(events)
+
+    def _maybe_retrain(self) -> None:
+        if not self.drift.retrain_due or self._retrain_fired:
+            return
+        self._retrain_fired = True  # once per base model
+        status = self.drift.status()
+        log.warning("stream drift %.3f passed threshold %.3f: full "
+                    "retrain due", status["score"], status["threshold"])
+        self._record_release(
+            "retrain-due", self._base_seen or "",
+            f"drift score {status['score']} >= {status['threshold']}")
+        if self.on_retrain is not None:
+            try:
+                self.on_retrain(status)
+            except Exception as e:  # noqa: BLE001 — the hook is advisory
+                log.error("on_retrain hook failed: %s", e)
+
+    def _record_release(self, action: str, instance_id: str,
+                        reason: str) -> None:
+        try:
+            self.server.releases.record(action, instance_id=instance_id,
+                                        actor=f"stream-trainer:"
+                                              f"{self.config.consumer}",
+                                        reason=reason[:500])
+        except Exception as e:  # noqa: BLE001 — history is best-effort
+            log.error("release history write failed on %s: %s",
+                      action, e)
+
+    # -- canary gate ---------------------------------------------------------
+    def _canary_check(self, old_model, new_model, touched):
+        """Probe the folded model against the serving one on the
+        touched entities (plus padding from the known-user head):
+        per-probe latency and failure (exception / non-finite scores /
+        empty where the old model answered) build one
+        :class:`ArmWindow` per arm, judged by the HealthPolicy — the
+        same gate a full-release canary passes, at fold-in scale."""
+        n = self.config.canary_probes
+        if n <= 0:
+            return None
+        from ..models.als import recommend_products
+
+        probe_keys = [u for u in touched
+                      if new_model.user_ids and u in new_model.user_ids]
+        probe_keys = probe_keys[:n]
+        if not probe_keys:
+            return None
+
+        def probe(model, key) -> tuple:
+            """(seconds, bad, answerable, n_results); ``answerable``
+            False when the model has no row for the key (a cold-start
+            user the OLD model can't serve — not an error, and its
+            instant return must not enter the latency window)."""
+            t0 = time.monotonic()
+            try:
+                uidx = model.user_ids.get(key)
+                if uidx is None:
+                    return time.monotonic() - t0, False, False, 0
+                ids, scores = recommend_products(
+                    model, int(uidx), min(10, model.n_items))
+                bad = not np.all(np.isfinite(np.asarray(scores)))
+                return time.monotonic() - t0, bad, True, len(ids)
+            except Exception:  # noqa: BLE001 — counted as an error
+                return time.monotonic() - t0, True, True, 0
+
+        stable_lats, stable_q, stable_errs = [], 0, 0
+        cand_lats, cand_errs = [], 0
+        for key in probe_keys:
+            o_dt, o_bad, o_can, o_n = probe(old_model, key)
+            # probe the candidate twice and keep the faster sample: a
+            # grown factor table's FIRST dispatch pays an XLA compile
+            # the steady-state serving path never sees — the gate must
+            # judge steady-state latency, not one-off tracing
+            c_dt0, _, _, _ = probe(new_model, key)
+            c_dt, c_bad, c_can, c_n = probe(new_model, key)
+            cand_lats.append(min(c_dt0, c_dt))
+            # a folded model answering EMPTY (or garbage) where the
+            # serving one answered is a regression; a cold-start key
+            # the old model can't serve only judges the candidate's
+            # absolute health
+            if c_bad or (not c_can) or (o_can and o_n and not c_n):
+                cand_errs += 1
+            if o_can:
+                stable_q += 1
+                stable_lats.append(o_dt)
+                stable_errs += 1 if o_bad else 0
+        stable = ArmWindow(
+            queries=stable_q, errors=stable_errs,
+            p99=max(stable_lats) if stable_lats else None)
+        candidate = ArmWindow(
+            queries=len(probe_keys), errors=cand_errs,
+            p99=max(cand_lats) if cand_lats else None)
+        return self.policy.evaluate(stable, candidate)
+
+    # -- observability -------------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "running": self.running,
+            "appName": self.config.app_name,
+            "consumer": self.config.consumer,
+            "intervalMs": self.config.interval_ms,
+            "cursor": self.cursor.status(),
+            "cursorLag": self._last_lag,
+            "eventsConsumed": self.events_consumed,
+            "applies": self.applies,
+            "canaryRejects": self.rejects,
+            "drift": self.drift.status(),
+            "lastBatch": self._last_batch,
+            "lastError": self._last_error,
+        }
