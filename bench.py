@@ -589,6 +589,23 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, don't die
             streaming = {"error": _clean_err(e, 300)}
 
+    # elastic reliability (ISSUE 11): the serving lane-kill drill —
+    # inject a dead replicated lane under real HTTP load, require zero
+    # failed in-deadline queries, and measure the recovery-time-
+    # objective (lane death → lane rejoined) from the server's own
+    # degraded transitions
+    reliability = None
+    if os.environ.get("BENCH_RELIABILITY", "1") == "1":
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks"))
+            import reliability_smoke as rel_smoke
+
+            reliability = rel_smoke.measure()
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            reliability = {"error": _clean_err(e, 300)}
+
     # roofline accounting (VERDICT r4 weak #3: "memory-bound" was an
     # excuse, not a measurement): XLA's post-fusion bytes-accessed over
     # the steady-state iteration time vs the chip's HBM peak, PLUS the
@@ -685,6 +702,10 @@ def main():
         "event_to_servable_ms": (streaming or {}).get(
             "event_to_servable_p50_ms"),
         "streaming": streaming,
+        # lane-kill recovery-time-objective (ISSUE 11): degraded-mode
+        # entry→exit with zero failed in-deadline queries required
+        "rto_ms": (reliability or {}).get("rto_ms"),
+        "reliability": reliability,
         "serving": serving,
         "roofline": roofline,
         "device": jax.devices()[0].device_kind,

@@ -1,0 +1,381 @@
+"""Elastic-reliability failure drills (ISSUE 11) — the CI gate for
+docs/reliability.md, and the source of the BENCH line's ``rto_ms``.
+
+Two drills, both against REAL failure mechanics (fault registry +
+``os._exit`` — no mocks):
+
+1. **Train drill (2-process CPU mesh, gloo):** two worker processes
+   join one JAX system, train mesh-sharded ALS with the distributed
+   checkpointer, and process 1 is crash-injected (``os._exit(42)`` —
+   the ``kill -9``/preemption simulator) at the entry of its 3rd save,
+   leaving a TORN step on disk. The parent reaps both processes,
+   relaunches the pair, and the run must resume from the last
+   COMMITTED step and finish with factors BITWISE equal to an
+   uninterrupted 2-process run. ``train_resume_ms`` measures
+   relaunch→trained (the restart-side recovery cost).
+
+2. **Serving drill (replicated lanes, real HTTP):** a replicated
+   multi-lane server takes steady query load while lane 1 is
+   fault-injected dead. Required: ZERO failed in-deadline queries
+   (dispatch fails over to surviving lanes during detection), a
+   visible degraded block on /status.json, ``pio_lane_restarts_total``
+   counting the recovery, and ``rto_ms`` — lane-death→lane-rejoined,
+   measured from the degraded transitions.
+
+Prints one JSON line; exits non-zero on any violation. Needs >= 2
+visible devices for the serving drill (CI forces host devices via
+XLA_FLAGS); with one device that drill reports skipped=true.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+from datetime import datetime, timezone
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_TRAIN_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    ckdir = sys.argv[3]
+    outdir = sys.argv[4]
+    mode = sys.argv[5]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    if mode == "crash" and pid == 1:
+        # preemption: process 1 vanishes at the entry of its 3rd save,
+        # leaving step 3 TORN (its shards never written, no commit
+        # marker) — the restart must fall back to committed step 2
+        os.environ["PTPU_FAULTS"] = "checkpoint.save=crash,after=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from predictionio_tpu.models.als import (
+        ALSParams, RatingsCOO, pack_ratings, train_als)
+    from predictionio_tpu.parallel.multihost import global_mesh
+
+    rng = np.random.default_rng(17)
+    nnz, n_users, n_items = 800, 48, 32
+    ratings = RatingsCOO(
+        rng.integers(0, n_users, nnz).astype(np.int32),
+        rng.integers(0, n_items, nnz).astype(np.int32),
+        rng.random(nnz).astype(np.float32) * 4 + 1,
+        n_users, n_items)
+    mesh = global_mesh(data=8)
+    params = ALSParams(rank=4, num_iterations=6, reg=0.05, seed=11)
+    packed = pack_ratings(ratings, params, mesh)
+    U, V = train_als(ratings, params, mesh=mesh, packed=packed,
+                     checkpoint_dir=ckdir, checkpoint_every=1)
+
+    rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+    if pid == 0:
+        np.savez(os.path.join(outdir, f"factors_{mode}.npz"),
+                 U=np.asarray(rep(U).addressable_data(0)),
+                 V=np.asarray(rep(V).addressable_data(0)))
+        json.dump({"ok": True},
+                  open(os.path.join(outdir, f"ok_{mode}.json"), "w"))
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_pair(workdir: str, ckdir: str, mode: str, tag: str):
+    worker = os.path.join(workdir, "drill_worker.py")
+    with open(worker, "w") as f:
+        f.write(_TRAIN_WORKER)
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PTPU_FAULTS")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return [subprocess.Popen(
+        [sys.executable, worker, str(i), str(port), ckdir, workdir,
+         mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)], tag
+
+
+def train_drill(workdir: str) -> dict:
+    """kill -9 one of two mesh processes mid-save → resume-from-commit
+    parity (module docstring, drill 1)."""
+    out: dict = {}
+    os.makedirs(workdir, exist_ok=True)
+    ck_ref = os.path.join(workdir, "ck_ref")
+    ck_crash = os.path.join(workdir, "ck_crash")
+
+    # uninterrupted 2-process reference
+    procs, _ = _spawn_pair(workdir, ck_ref, "ref", "ref")
+    for p in procs:
+        stdout, _ = p.communicate(timeout=300)
+        if p.returncode != 0:
+            out["error"] = ("reference run failed: "
+                            + stdout.decode()[-1500:])
+            return out
+
+    # crash-injected run: p1 exits 42 during save 3; p0 is left
+    # waiting on a dead peer and gets reaped by the drill (the
+    # surviving host of a preempted pair is torn down by the platform)
+    procs, _ = _spawn_pair(workdir, ck_crash, "crash", "crash")
+    p1_out, _ = procs[1].communicate(timeout=300)
+    out["crash_exit_code"] = procs[1].returncode
+    try:
+        procs[0].wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()  # the kill -9 of the surviving peer
+        procs[0].wait(timeout=30)
+    out["crash_injected"] = procs[1].returncode == 42
+    if not out["crash_injected"]:
+        out["error"] = "no injected crash: " + p1_out.decode()[-1500:]
+        return out
+
+    # the torn step is on disk (shards at most, never a commit marker);
+    # committed steps end at 2
+    from predictionio_tpu.workflow.checkpoint import (
+        DistributedCheckpointer,
+    )
+
+    ck = DistributedCheckpointer(ck_crash, process_index=0,
+                                 process_count=2)
+    committed = ck.all_steps()
+    out["committed_steps"] = committed
+    out["resumed_from_step"] = max(committed) if committed else 0
+    out["committed_before_crash"] = bool(committed) \
+        and max(committed) == 2
+
+    # relaunch the pair: resume from the last committed step
+    t0 = time.monotonic()
+    procs, _ = _spawn_pair(workdir, ck_crash, "resumed", "resumed")
+    for p in procs:
+        stdout, _ = p.communicate(timeout=300)
+        if p.returncode != 0:
+            out["error"] = ("resume run failed: "
+                            + stdout.decode()[-1500:])
+            return out
+    out["train_resume_ms"] = round((time.monotonic() - t0) * 1000, 1)
+
+    ref = np.load(os.path.join(workdir, "factors_ref.npz"))
+    res = np.load(os.path.join(workdir, "factors_resumed.npz"))
+    out["factors_bitwise_equal"] = bool(
+        np.array_equal(ref["U"], res["U"])
+        and np.array_equal(ref["V"], res["V"]))
+    out["ok"] = out["crash_injected"] and out["committed_before_crash"] \
+        and out["factors_bitwise_equal"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serving drill
+# ---------------------------------------------------------------------------
+
+def _call(port, method, path, body=None, timeout=60):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else (
+        b"" if method == "POST" else None)
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def serving_drill(duration_s: float = 4.0) -> dict:
+    """Kill a replicated serving lane under load over real HTTP
+    (module docstring, drill 2); returns checks + rto_ms."""
+    import jax
+
+    from predictionio_tpu import faults
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.data.storage.base import (
+        STATUS_COMPLETED,
+        EngineInstance,
+    )
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        create_engine_server,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+
+    out: dict = {}
+    if len(jax.devices()) < 2:
+        return {"skipped": True, "ok": True,
+                "note": "one device visible; no lanes to kill (CI "
+                        "forces host devices via XLA_FLAGS)"}
+
+    rng = np.random.default_rng(1)
+    n_users, n_items, rank = 2_000, 20_000, 16
+    model = ALSModel(
+        user_factors=jax.device_put(rng.standard_normal(
+            (n_users, rank)).astype(np.float32)),
+        item_factors=jax.device_put(rng.standard_normal(
+            (n_items, rank)).astype(np.float32)),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "reldrill"))
+    ctx = Context(app_name="reldrill", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="reldrill", status=STATUS_COMPLETED, start_time=now,
+        end_time=now, engine_id="reldrill", engine_version="1",
+        engine_variant="engine.json", engine_factory="synthetic")
+    storage.engine_instances().insert(inst)
+    qs = QueryServer(
+        ctx, recommendation_engine(),
+        default_engine_params("reldrill", rank=rank),
+        [model], inst,
+        ServerConfig(batching=True, max_batch=8, batch_window_ms=1.0,
+                     serving_mode="replicated", warm_start=False,
+                     queue_deadline_ms=30_000.0,
+                     lane_fail_threshold=2,
+                     lane_restart_backoff_ms=40.0))
+    srv = create_engine_server(qs, "127.0.0.1", 0).start_background()
+    n_lanes = len(qs.lane_models)
+    out["lanes"] = n_lanes
+    try:
+        statuses: list = []
+        statuses_lock = threading.Lock()
+        stop = threading.Event()
+
+        def load(i: int) -> None:
+            k = 0
+            while not stop.is_set():
+                k += 1
+                try:
+                    code, _ = _call(srv.port, "POST", "/queries.json",
+                                    {"user": f"u{(i * 97 + k) % 500}",
+                                     "num": 5})
+                except urllib.error.HTTPError as e:  # noqa: PERF203
+                    code = e.code
+                except Exception as e:  # noqa: BLE001
+                    code = str(e)
+                with statuses_lock:
+                    statuses.append(code)
+
+        import urllib.error
+
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # steady state before the fault
+
+        # kill lane 1: the next `lane_fail_threshold` dispatches on it
+        # fail, then it is dead; the spent budget lets the FIRST
+        # restart probe succeed — rto_ms is death→rejoined
+        faults.inject("serving.lane", "error",
+                      match={"lane": "1"}, times=2,
+                      message="drill: lane 1 device lost")
+        t_fault = time.monotonic()
+        t_dead = t_recovered = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            _, status = _call(srv.port, "GET", "/status.json")
+            degraded = status.get("degraded") or {}
+            if t_dead is None and degraded.get("active"):
+                t_dead = time.monotonic()
+            if t_dead is not None and not degraded.get("active"):
+                t_recovered = time.monotonic()
+                break
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        out["detected_ms"] = (round((t_dead - t_fault) * 1000, 1)
+                              if t_dead else None)
+        out["rto_ms"] = (round((t_recovered - t_dead) * 1000, 1)
+                         if t_dead and t_recovered else None)
+        out["queries"] = len(statuses)
+        out["failed_queries"] = sum(1 for s in statuses if s != 200)
+        out["zero_failed_in_deadline"] = out["failed_queries"] == 0
+        _, status = _call(srv.port, "GET", "/status.json")
+        out["degraded_cleared"] = not (status.get("degraded")
+                                       or {}).get("active", True)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        restarts = [ln for ln in text.splitlines()
+                    if ln.startswith("pio_lane_restarts_total")
+                    and 'lane="1"' in ln]
+        out["lane_restart_counted"] = bool(
+            restarts and float(restarts[0].rsplit(" ", 1)[1]) >= 1.0)
+        out["fault_series_exported"] = \
+            "pio_fault_injections_total" in text \
+            and "pio_serving_degraded" in text
+        out["ok"] = bool(
+            out["zero_failed_in_deadline"] and out["rto_ms"] is not None
+            and out["degraded_cleared"] and out["lane_restart_counted"]
+            and out["queries"] > 20)
+    finally:
+        faults.clear()
+        srv.shutdown()
+    return out
+
+
+def measure(duration_s: float = 4.0) -> dict:
+    """The bench.py hook: the serving lane-kill drill's RTO on THIS
+    process's devices (replicated lanes; needs >= 2)."""
+    drill = serving_drill(duration_s)
+    return {
+        "rto_ms": drill.get("rto_ms"),
+        "detected_ms": drill.get("detected_ms"),
+        "zero_failed_in_deadline": drill.get("zero_failed_in_deadline"),
+        "lanes": drill.get("lanes"),
+        "skipped": drill.get("skipped", False),
+        "ok": drill.get("ok", False),
+    }
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="reliability_drill_") as d:
+        train = train_drill(d)
+    serving = serving_drill()
+    ok = bool(train.get("ok")) and bool(serving.get("ok"))
+    print(json.dumps({"bench": "reliability_smoke", "ok": ok,
+                      "train_drill": train,
+                      "serving_drill": serving}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -800,6 +800,106 @@ def rule_config_drift(mod: ModuleInfo, ctx: CheckContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# rule: unbounded-retry
+# ---------------------------------------------------------------------------
+
+#: directories whose loops talk to failable dependencies (ISSUE 11):
+#: a swallow-and-continue loop here is a wedged-daemon generator
+RETRY_SCOPE_PARTS = {"server", "streaming", "storage"}
+
+#: attribute calls that pace (block/sleep) or bound a loop iteration —
+#: their presence anywhere in the loop body means the retry is not a
+#: hot spin; ``*_nowait`` variants deliberately do NOT count
+_PACING_ATTRS = {"sleep", "wait", "get", "join", "acquire", "select",
+                 "accept", "recv", "poll"}
+_PACING_NAMES = {"time.sleep", "select.select"}
+#: the shared bounded-backoff helpers (utils/retrying.py)
+_PACING_SUFFIXES = ("retry_call", "backoff_delays")
+
+
+def _walk_same_scope(node):
+    """Walk a loop body without descending into nested function
+    definitions (their loops are judged where they are defined)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_same_scope(child)
+
+
+def _loop_unbounded(mod: ModuleInfo, node: ast.AST) -> bool:
+    if isinstance(node, ast.While):
+        t = node.test
+        return isinstance(t, ast.Constant) and bool(t.value)
+    if isinstance(node, ast.For):
+        it = node.iter
+        return isinstance(it, ast.Call) \
+            and mod.resolve(it.func) == "itertools.count"
+    return False
+
+
+def _is_pacing_call(mod: ModuleInfo, call: ast.Call) -> bool:
+    name = mod.resolve(call.func) or ""
+    if name in _PACING_NAMES or name.endswith(_PACING_SUFFIXES):
+        return True
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        return attr in _PACING_ATTRS and not attr.endswith("_nowait")
+    return False
+
+
+def rule_unbounded_retry(mod: ModuleInfo,
+                         ctx: CheckContext) -> List[Finding]:
+    """``while True`` (or ``itertools.count``) loops in server/,
+    streaming/, or storage/ code that swallow exceptions and loop again
+    with NO max-attempts bound and NO pacing call (sleep / blocking
+    wait / the shared retry helpers): a failing dependency turns such a
+    loop into a hot spin or a silently wedged daemon. Bound it with
+    ``utils.retrying.retry_call`` (bounded exponential backoff) or add
+    explicit pacing."""
+    parts = set(mod.path.split("/")[:-1])
+    if not parts & RETRY_SCOPE_PARTS:
+        return []
+    findings: List[Finding] = []
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.While, ast.For)) \
+                or not _loop_unbounded(mod, loop):
+            continue
+        body_nodes = [n for stmt in loop.body
+                      for n in [stmt, *_walk_same_scope(stmt)]]
+        swallows = None
+        for n in body_nodes:
+            if not isinstance(n, ast.Try):
+                continue
+            for handler in n.handlers:
+                escapes = any(isinstance(h, (ast.Raise, ast.Return,
+                                             ast.Break))
+                              for stmt in handler.body
+                              for h in [stmt, *_walk_same_scope(stmt)])
+                if not escapes:
+                    swallows = handler
+                    break
+            if swallows is not None:
+                break
+        if swallows is None:
+            continue
+        paced = any(isinstance(n, ast.Call) and _is_pacing_call(mod, n)
+                    for n in body_nodes)
+        if paced:
+            continue
+        findings.append(Finding(
+            "unbounded-retry", mod.path, swallows.lineno,
+            swallows.col_offset,
+            "unbounded retry: this loop swallows the exception and "
+            "re-runs with no max-attempts bound and no backoff/pacing "
+            "— a failing dependency becomes a hot spin or a wedged "
+            "daemon; bound it with utils.retrying.retry_call (bounded "
+            "exponential backoff) or add explicit pacing"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # registry (JAX rules here; concurrency rule family in .concurrency)
 # ---------------------------------------------------------------------------
 
@@ -843,6 +943,11 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
     Rule("config-drift",
          "jax.config.update outside utils/platform.py",
          rule_config_drift),
+    Rule("unbounded-retry",
+         "swallow-and-continue retry loops in server/, streaming/, or "
+         "storage/ code with no max-attempts bound and no "
+         "backoff/pacing (route through utils/retrying.py)",
+         rule_unbounded_retry),
     Rule("vmem-overbudget",
          "pallas_call whose statically-evaluated VMEM working set "
          "(BlockSpec tiles double-buffered + scratch) exceeds the "

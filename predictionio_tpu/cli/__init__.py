@@ -330,7 +330,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         stream_max_events=args.stream_max_events,
         stream_consumer=args.stream_consumer,
         stream_drift_threshold=args.stream_drift_threshold,
-        stream_canary_probes=args.stream_canary_probes)
+        stream_canary_probes=args.stream_canary_probes,
+        faults=args.faults or None)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -1416,6 +1417,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--stream-canary-probes", type=int, default=8,
                    help="touched-entity probes gating each fold-in "
                         "delta (0 disables the canary gate)")
+    s.add_argument("--faults", default="",
+                   help="fault-injection spec for failure drills "
+                        "(docs/reliability.md), e.g. "
+                        "'serving.lane=error,lane=1,times=5'; the "
+                        "PTPU_FAULTS env var works on every server")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")

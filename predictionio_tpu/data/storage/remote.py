@@ -31,6 +31,8 @@ import urllib.parse
 import urllib.request
 from typing import Iterator, List, Optional, Sequence
 
+from ...faults import declare, fire
+from ...utils.retrying import RetryPolicy, retry_call
 from ..event import Event
 from .base import (
     AccessKeysDAO,
@@ -50,6 +52,19 @@ from .wire import (
     entity_to_doc,
     filter_to_doc,
 )
+
+
+F_REMOTE = declare("storage.remote",
+                   "one HTTP round trip of the remote-storage client "
+                   "(op=/path= label the request)")
+
+
+class _Transient(Exception):
+    """Internal retry marker wrapping a retryable StorageError."""
+
+    def __init__(self, error: StorageError):
+        super().__init__(str(error))
+        self.error = error
 
 
 class RemoteClient:
@@ -80,19 +95,22 @@ class RemoteClient:
                 headers: Optional[dict] = None,
                 timeout: Optional[float] = None,
                 idempotent: bool = True):
-        """(status, headers, body). Connection errors retry with backoff
-        ONLY for ``idempotent`` requests — a lost RESPONSE means the
-        server may have committed, so a blind replay of a non-idempotent
-        call (e.g. a metadata insert that auto-assigns ids) would
-        duplicate it. Event inserts stay retryable because the client
-        assigns event ids up front (replays become id-keyed upserts)."""
+        """(status, headers, body). Connection errors retry with
+        bounded exponential backoff (:mod:`~...utils.retrying`) ONLY
+        for ``idempotent`` requests — a lost RESPONSE means the server
+        may have committed, so a blind replay of a non-idempotent call
+        (e.g. a metadata insert that auto-assigns ids) would duplicate
+        it. Event inserts stay retryable because the client assigns
+        event ids up front (replays become id-keyed upserts). A 503
+        from the server (its backing store down, ISSUE 11) is retryable
+        the same way — the server told us to come back."""
+        fire(F_REMOTE, op=method, path=path)
         hdrs = {"Content-Type": "application/json"}
         if self.secret:
             hdrs["X-PIO-Storage-Secret"] = self.secret
         hdrs.update(headers or {})
-        last: Exception = StorageError("unreachable")
-        retries = self.retries if idempotent else 0
-        for attempt in range(retries + 1):
+
+        def attempt():
             req = urllib.request.Request(
                 self.url + path, data=body, method=method, headers=hdrs)
             try:
@@ -110,13 +128,22 @@ class RemoteClient:
                 err = StorageError(
                     f"storage server {e.code} on {path}: {detail}")
                 err.status = e.code  # callers branch on 404 (version skew)
+                if e.code == 503 and idempotent:
+                    raise _Transient(err) from e
                 raise err from e
             except (urllib.error.URLError, ConnectionError, OSError) as e:
-                last = e
-                if attempt < retries:
-                    time.sleep(0.2 * (attempt + 1))
-        raise StorageError(
-            f"storage server unreachable at {self.url}: {last}")
+                raise _Transient(StorageError(
+                    f"storage server unreachable at {self.url}: {e}")) \
+                    from e
+
+        policy = RetryPolicy(
+            max_attempts=(self.retries + 1) if idempotent else 1,
+            base_ms=200.0, cap_ms=2000.0)
+        try:
+            return retry_call(attempt, policy=policy,
+                              retry_on=(_Transient,))
+        except _Transient as t:
+            raise t.error from t
 
     def rpc(self, path: str, doc: Optional[dict] = None,
             idempotent: bool = True) -> dict:

@@ -16,6 +16,7 @@ import sqlite3
 import threading
 from typing import Iterator, List, Optional
 
+from ...faults import fire
 from ..datamap import DataMap
 from ..event import Event, from_millis, new_event_id, to_millis
 from .base import (
@@ -248,6 +249,7 @@ class SQLiteEventStore(EventStore):
 
     def insert_batch(self, events, app_id: int,
                      channel_id: Optional[int] = None) -> List[str]:
+        fire("storage.io", op="insert", backend="sqlite")
         rows, ids = [], []
         for e in events:
             eid = e.event_id or new_event_id()
@@ -662,6 +664,7 @@ class SQLiteEventStore(EventStore):
 
     def find(self, app_id: int, channel_id: Optional[int] = None,
              filter: EventFilter = EventFilter()) -> Iterator[Event]:
+        fire("storage.io", op="find", backend="sqlite")
         clauses, params = [], []
         if filter.start_time is not None:
             clauses.append("event_time >= ?")

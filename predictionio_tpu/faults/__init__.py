@@ -1,0 +1,42 @@
+"""Fault injection for failure drills (ISSUE 11, docs/reliability.md).
+
+A process-wide registry of *named injection points* threaded through
+the subsystems that matter for elasticity — storage I/O, device
+dispatch, serving lanes, stream-trainer passes, checkpoint
+save/commit/restore, multihost collectives — so tests and CI drills
+script **real** failures (a storage backend that raises, a serving lane
+that dies, a process that vanishes mid-checkpoint) instead of mocks.
+
+Zero overhead when off: every instrumented site calls :func:`fire`,
+which is a single global-bool check until something is injected.
+"""
+
+from .registry import (
+    FaultError,
+    FaultSpec,
+    POINTS,
+    clear,
+    declare,
+    enabled,
+    fire,
+    inject,
+    inject_spec,
+    parse_specs,
+    registry,
+    status,
+)
+
+__all__ = [
+    "FaultError",
+    "FaultSpec",
+    "POINTS",
+    "clear",
+    "declare",
+    "enabled",
+    "fire",
+    "inject",
+    "inject_spec",
+    "parse_specs",
+    "registry",
+    "status",
+]

@@ -1263,7 +1263,10 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
         import hashlib
         import json as _json
 
-        from ..workflow.checkpoint import Checkpointer
+        from ..workflow.checkpoint import (
+            DistributedCheckpointer,
+            make_checkpointer,
+        )
 
         if checkpoint_every <= 0:
             checkpoint_every = 1  # a checkpoint dir implies checkpointing
@@ -1303,7 +1306,11 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
                 and isinstance(item_h, PaddedHistories):
             accepted += (hashlib.sha256(
                 _json.dumps(legacy_base).encode()).hexdigest()[:16],)
-        ckpt = Checkpointer(checkpoint_dir)
+        # multi-process runs get the preemption-safe distributed
+        # container (per-process shard files + rendezvous commit,
+        # ISSUE 11): every host writes only its local factor rows and
+        # a kill -9 at any instant costs at most the step in flight
+        ckpt = make_checkpointer(checkpoint_dir)
         meta = ckpt.get_metadata()
         if meta is not None \
                 and meta.get("fingerprint") not in accepted:
@@ -1312,15 +1319,18 @@ def train_als(ratings: RatingsCOO, params: ALSParams,
                 f"ALS run (params/dataset/history-layout mismatch); use "
                 f"a fresh dir")
         ckpt.set_metadata({"fingerprint": fingerprint})
-        # resume from the largest step within this run's iteration budget
-        steps = [s for s in ckpt.all_steps()
-                 if s <= params.num_iterations]
-        if steps:
-            latest = max(steps)
-            state = ckpt.restore(latest, like={"U": U, "V": V})
-            U = _shard(state["U"], mesh, rows_spec(mesh))
-            V = _shard(state["V"], mesh, rows_spec(mesh))
-            start = int(latest)
+        # resume from the newest RESTORABLE step within this run's
+        # iteration budget — a torn step (crash mid-save) is skipped
+        # and the walk falls back to the previous committed one
+        start, state = ckpt.restore_latest(
+            like={"U": U, "V": V}, max_step=params.num_iterations)
+        if state is not None:
+            if isinstance(ckpt, DistributedCheckpointer):
+                # restore already reassembled + placed the local shards
+                U, V = state["U"], state["V"]
+            else:
+                U = _shard(state["U"], mesh, rows_spec(mesh))
+                V = _shard(state["V"], mesh, rows_spec(mesh))
 
     def _kind(h) -> str:
         if isinstance(h, (BucketedHistories, _LayoutOnlyBucketed)):

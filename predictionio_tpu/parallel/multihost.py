@@ -17,7 +17,29 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..faults import declare, fire
+
 log = logging.getLogger(__name__)
+
+F_COLLECTIVE = declare(
+    "multihost.collective",
+    "entry of a host-side cross-process collective (allgather/"
+    "broadcast/barrier); op= label names which")
+
+
+def barrier(tag: str) -> None:
+    """Rendezvous every process at ``tag`` (no-op single-process) —
+    the commit fence of the distributed checkpointer: nothing after
+    the barrier happens until everything before it (on every process)
+    has."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    fire(F_COLLECTIVE, op="barrier", tag=tag)
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -122,6 +144,7 @@ def _allgather_parts(x: np.ndarray) -> list:
     x = np.ascontiguousarray(x)
     if jax.process_count() == 1:
         return [x]
+    fire(F_COLLECTIVE, op="allgather")
     from jax.experimental import multihost_utils
 
     raw = np.frombuffer(x.tobytes(), dtype=np.uint8)
@@ -138,6 +161,7 @@ def broadcast_str(s: str, max_len: int = 256) -> str:
 
     if jax.process_count() == 1:
         return s
+    fire(F_COLLECTIVE, op="broadcast")
     from jax.experimental import multihost_utils
 
     buf = np.zeros(max_len, np.uint8)

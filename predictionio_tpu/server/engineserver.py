@@ -29,6 +29,7 @@ from typing import Any, List, Optional
 from ..concurrency import (
     instrument_locks,
     locks_instrumented,
+    new_lock,
     new_rlock,
     register_lock_metrics,
 )
@@ -37,6 +38,9 @@ from ..controller.engine import Engine
 from ..controller.params import EngineParams
 from ..data.event import Event, utcnow
 from ..data.storage.base import STATUS_COMPLETED, EngineInstance
+from ..faults import declare, fire
+from ..faults import registry as fault_registry
+from ..utils.retrying import RetryPolicy, backoff_delays
 from ..obs import (
     DEFAULT_LATENCY_BOUNDS,
     POW2_COUNT_BOUNDS,
@@ -60,6 +64,29 @@ from .http import (
 from .plugins import EngineServerPlugins
 
 log = logging.getLogger(__name__)
+
+F_LANE = declare("serving.lane",
+                 "one micro-batch dispatch on a replicated serving "
+                 "lane (lane= labels the device ordinal) — injecting "
+                 "here simulates a dead device/lane")
+F_LANE_RESTART = declare("serving.lane_restart",
+                         "a lane-restart probe (lane=): injecting here "
+                         "keeps a dead lane down")
+F_DISPATCH = declare("serving.dispatch",
+                     "one batched device dispatch (any serving mode)")
+
+
+def pick_live_lane(lane: int, n_lanes: int, dead) -> int:
+    """Route traffic for ``lane`` to a surviving lane: identity while
+    healthy; a dead lane's batches redistribute deterministically
+    across the survivors (round-robin by ordinal). With every lane
+    dead there is nothing better than the original."""
+    if n_lanes <= 0 or lane not in dead:
+        return lane
+    alive = [i for i in range(n_lanes) if i not in dead]
+    if not alive:
+        return lane
+    return alive[lane % len(alive)]
 
 
 def _gen_pr_id() -> str:
@@ -215,6 +242,23 @@ class ServerConfig:
     stream_drift_threshold: float = 1.0  # DriftMonitor retrain trigger
     #: touched-entity probes per fold-in canary check (0 disables)
     stream_canary_probes: int = 8
+    #: Fault injection (ISSUE 11, docs/reliability.md): a
+    #: ``PTPU_FAULTS``-grammar spec string armed into the process-wide
+    #: fault registry at server construction, so failure drills script
+    #: real storage/lane/dispatch faults against a deployed server
+    #: (``ptpu deploy --faults``). None = nothing armed (the env var
+    #: still works).
+    faults: Optional[str] = None
+    #: consecutive failed dispatches on one replicated lane before the
+    #: lane is declared dead and its traffic redistributed across the
+    #: surviving lanes (degraded mode — pio_serving_degraded)
+    lane_fail_threshold: int = 3
+    #: lane-restart probe schedule: bounded exponential backoff from
+    #: this base, capped at 32x — a dead lane is probed (restart =
+    #: fault-point probe + per-device model re-replication) until it
+    #: comes back or the attempt budget is spent
+    lane_restart_backoff_ms: float = 100.0
+    lane_restart_max_attempts: int = 8
 
 
 @dataclass
@@ -256,6 +300,12 @@ class QueryServer:
                 raise ValueError(
                     f"feedback app {app_name!r} does not exist")
         self.plugins = plugins or EngineServerPlugins()
+        if self.config.faults:
+            # failure drills (ISSUE 11): arm the requested injections
+            # BEFORE anything that might be their target exists
+            from ..faults import inject_spec
+
+            inject_spec(self.config.faults)
         if self.config.debug_locks and not locks_instrumented():
             # flip the factories BEFORE any serving-stack lock exists
             # so the cache/rollout/batcher locks built below are all
@@ -353,6 +403,40 @@ class QueryServer:
             "Per-device serving lanes active (0 = single/sharded "
             "binding)",
             fn=lambda: float(len(self.lane_models)))
+        # graceful degradation (ISSUE 11, docs/reliability.md): lane
+        # supervision state + the telemetry that makes a dead lane an
+        # alert instead of a mystery latency cliff. _lane_health guards
+        # the dead-set and failure streaks; the binding lock is NOT
+        # reused here because lane death is detected on the dispatch
+        # hot path.
+        self._lane_health = new_lock("QueryServer._lane_health")
+        self._dead_lanes: dict = {}        # lane → {"since", "reason"}
+        self._lane_streaks: dict = {}      # lane → consecutive failures
+        self._lane_restarts = self.metrics.counter(
+            "pio_lane_restarts_total",
+            "Successful restarts of a dead serving lane, by lane")
+        self._lane_failures = self.metrics.counter(
+            "pio_lane_failures_total",
+            "Failed micro-batch dispatches per serving lane (the "
+            "streak that crosses lane_fail_threshold kills the lane)")
+        self.metrics.gauge(
+            "pio_serving_degraded",
+            "1 while one or more replicated serving lanes are dead "
+            "and their traffic is redistributed across survivors",
+            fn=lambda: 1.0 if self._dead_lanes else 0.0)
+        # fault-injection observability: injections delivered anywhere
+        # in this process, attributed by point and mode
+        self._fault_injections = self.metrics.counter(
+            "pio_fault_injections_total",
+            "Fault-registry injections delivered, by point and mode "
+            "(drills only; 0 in production)")
+        fault_registry().add_listener(
+            lambda point, mode: self._fault_injections.labels(
+                point=point, mode=mode).inc())
+        self.metrics.gauge(
+            "pio_fault_enabled",
+            "1 while any fault-injection spec is armed in this process",
+            fn=lambda: 1.0 if fault_registry().enabled() else 0.0)
         # progressive delivery (ISSUE 3): per-release-arm series the
         # rollout health gate windows over, the release registry this
         # server's deploy/reload/promote/rollback actions are recorded
@@ -599,6 +683,13 @@ class QueryServer:
         self.serving_mesh = None
         self.lane_devices: List[Any] = []
         self.lane_models: List[List[Any]] = []
+        # a rebind replicates every lane fresh: prior lane deaths are
+        # about models/devices that no longer serve (the constructor's
+        # first _bind runs before the health state exists)
+        if getattr(self, "_lane_health", None) is not None:
+            with self._lane_health:
+                self._dead_lanes.clear()
+                self._lane_streaks.clear()
         mode = self.config.serving_mode
         if mode == "single":
             self.serving_mode_resolved = "single"
@@ -828,6 +919,131 @@ class QueryServer:
             out["lanes"] = lanes
         return out
 
+    # -- lane supervision / graceful degradation (ISSUE 11) -----------------
+    def live_lane(self, lane: int) -> int:
+        """Where a batch assigned to ``lane`` should actually run:
+        identity while the lane is healthy, a surviving lane while it
+        is dead (docs/reliability.md)."""
+        with self._lock:
+            n = len(self.lane_models)
+        with self._lane_health:
+            return pick_live_lane(lane, n, self._dead_lanes)
+
+    def lane_attempt_order(self, lane: int) -> List[int]:
+        """Dispatch-failover order for a batch assigned to ``lane``:
+        its live mapping first, then every other lane (healthy ones
+        before dead ones as a last resort) — each tried at most once,
+        so one batch can never loop."""
+        with self._lock:
+            n = len(self.lane_models)
+        if n <= 0:
+            return [lane]
+        with self._lane_health:
+            dead = set(self._dead_lanes)
+        first = pick_live_lane(lane % n, n, dead)
+        rest = [i for i in range(n) if i != first]
+        rest.sort(key=lambda i: (i in dead, i))
+        return [first] + rest
+
+    def _lane_ok(self, lane: int) -> None:
+        with self._lane_health:
+            self._lane_streaks.pop(lane, None)
+
+    def _lane_error(self, lane: int, exc: Exception) -> None:
+        """A dispatch on ``lane`` failed: count the streak and declare
+        the lane dead at ``lane_fail_threshold`` consecutive failures
+        (then start its restarter)."""
+        self._lane_failures.labels(lane=str(lane)).inc()
+        threshold = max(self.config.lane_fail_threshold, 1)
+        with self._lane_health:
+            if lane in self._dead_lanes:
+                return
+            streak = self._lane_streaks.get(lane, 0) + 1
+            self._lane_streaks[lane] = streak
+            if streak < threshold:
+                return
+            self._dead_lanes[lane] = {
+                "since": time.time(),
+                "reason": f"{type(exc).__name__}: {exc}"[:300],
+                "failures": streak,
+            }
+        log.error("serving lane %d declared dead after %d consecutive "
+                  "dispatch failures (%s); redistributing its traffic "
+                  "and starting the restarter", lane, streak, exc)
+        threading.Thread(target=self._lane_restarter, args=(lane,),
+                         daemon=True,
+                         name=f"lane-restarter-{lane}").start()
+
+    def _lane_restarter(self, lane: int) -> None:
+        """Probe a dead lane back to life: bounded-exponential-backoff
+        attempts, each probing the lane's fault point (a still-armed
+        injection keeps it down) and re-replicating the serving models
+        onto the lane's device. Success rejoins the lane and counts
+        ``pio_lane_restarts_total``; an exhausted budget leaves it dead
+        (degraded mode persists — the operator sees it on
+        /status.json)."""
+        cfg = self.config
+        policy = RetryPolicy(
+            max_attempts=max(cfg.lane_restart_max_attempts, 1),
+            base_ms=max(cfg.lane_restart_backoff_ms, 1.0),
+            cap_ms=max(cfg.lane_restart_backoff_ms, 1.0) * 32)
+        delays = list(backoff_delays(policy)) + [0.0]
+        for delay in delays:
+            time.sleep(delay)
+            with self._lock:
+                if lane >= len(self.lane_devices):
+                    return  # a rebind changed the lane layout
+                dev = self.lane_devices[lane]
+                algorithms = self.algorithms
+                models = self.models
+                instance_id = self.instance.id
+            try:
+                # the probe: if the injected (or real) fault is still
+                # there, this raises and we back off
+                fire(F_LANE_RESTART, lane=str(lane))
+                fire(F_LANE, lane=str(lane))
+                fresh = []
+                for a, m in zip(algorithms, models):
+                    rep = getattr(a, "replicate_serving_model", None)
+                    fresh.append(rep(m, dev) if rep is not None else m)
+            except Exception as e:  # noqa: BLE001 — still down
+                log.warning("lane %d restart probe failed: %s", lane, e)
+                continue
+            with self._lock:
+                if self.instance.id != instance_id \
+                        or lane >= len(self.lane_models):
+                    return  # binding swapped mid-restart: the rebind
+                    # already rebuilt every lane and reset health
+                self.lane_models[lane] = fresh
+            with self._lane_health:
+                self._dead_lanes.pop(lane, None)
+                self._lane_streaks.pop(lane, None)
+            self._lane_restarts.labels(lane=str(lane)).inc()
+            log.info("serving lane %d restarted and rejoined", lane)
+            return
+        log.error("serving lane %d restart budget exhausted (%d "
+                  "attempts); staying degraded", lane,
+                  policy.max_attempts)
+
+    def degraded_status(self) -> dict:
+        """The degraded block of ``/status.json``: dead lanes, restart
+        and failure totals, and whether fault injection is armed."""
+        with self._lane_health:
+            dead = [{"lane": int(k), "since": v["since"],
+                     "reason": v["reason"]}
+                    for k, v in sorted(self._dead_lanes.items())]
+
+        def _total(fam) -> int:
+            return int(sum(child.value for _, child in fam.children()))
+
+        return {
+            "active": bool(dead),
+            "deadLanes": dead,
+            "laneRestarts": _total(self._lane_restarts),
+            "laneFailures": _total(self._lane_failures),
+            "faultInjection": fault_registry().enabled(),
+        }
+
     def spans_summary(self) -> dict:
         """Percentile rows for the status page: each query phase plus
         end-to-end latency, from the live bounded histograms."""
@@ -1004,6 +1220,9 @@ class QueryServer:
         phases["assemble"] = time.monotonic() - t0
         per_query_ms: List[dict] = [{} for _ in query_jsons]
         if ok_rows:
+            if lane is not None:
+                fire(F_LANE, lane=str(lane))
+            fire(F_DISPATCH)
             with self._transfer_guard():
                 served = predict_serve_batch(algorithms, models, serving,
                                              parsed, timings=phases)
@@ -1937,6 +2156,7 @@ def build_app(server: QueryServer) -> HTTPApp:
                        if server.stream is not None
                        else {"running": False}),
             "mesh": server.mesh_status(),
+            "degraded": server.degraded_status(),
             "hbm": hbm_stats(),
             "cache": (server.cache.stats() if server.cache is not None
                       else {"enabled": False}),
@@ -2368,15 +2588,29 @@ class MicroBatcher:
                 if e.obs is not None:
                     e.obs["queueWaitMs"] = round(wait * 1000, 3)
                 obs_list.append(e.obs)
-            try:
-                results = self.server.query_batch(
-                    [e.query_json for e in batch], obs_list=obs_list,
-                    lane=lane)
-            except Exception as exc:  # noqa: BLE001 — isolate to batch
-                self.server.remote_log(str(exc))  # once for the batch
-                err = HTTPError(500, str(exc))
-                err._remote_logged = True
-                results = [err] * len(batch)
+            # lane supervision (ISSUE 11): redistribute a dead lane's
+            # traffic at pickup and fail a dispatch over to surviving
+            # lanes before failing the batch (mirrors StagedPipeline)
+            attempts = ([None] if lane is None
+                        else self.server.lane_attempt_order(lane))
+            results = None
+            for n_try, eff in enumerate(attempts):
+                try:
+                    results = self.server.query_batch(
+                        [e.query_json for e in batch], obs_list=obs_list,
+                        lane=eff)
+                    if eff is not None:
+                        self.server._lane_ok(eff)
+                    break
+                except Exception as exc:  # noqa: BLE001 — isolate batch
+                    if eff is not None:
+                        self.server._lane_error(eff, exc)
+                    if n_try + 1 < len(attempts):
+                        continue
+                    self.server.remote_log(str(exc))  # once per batch
+                    err = HTTPError(500, str(exc))
+                    err._remote_logged = True
+                    results = [err] * len(batch)
             for e, result in zip(batch, results):
                 e.slot[0] = result
                 e.done.set()
@@ -2619,26 +2853,48 @@ class StagedPipeline:
             server._pipeline_qdepth.labels(queue="dispatch").observe(
                 self._dispatch_q.qsize() + 1)
             if lane is not None and ab.lane_models:
-                ab.lane = lane % len(ab.lane_models)
+                # lane supervision (ISSUE 11): a dead lane's batches
+                # redistribute across survivors at pickup, and a
+                # dispatch failure fails over to the other lanes
+                # before it is allowed to fail the batch — during
+                # detection no caller sees an error as long as one
+                # lane still serves
+                attempts = server.lane_attempt_order(lane)
+                ab.lane = attempts[0]
                 models = ab.lane_models[ab.lane]
                 server._lane_depth.labels(lane=str(ab.lane)).observe(
                     self._dispatch_q.qsize() + 1)
             else:
+                attempts = [None]
                 models = ab.models
             t0 = time.monotonic()
             in_flight_before = server.overlap.enter("device")
-            try:
-                with server._transfer_guard():
-                    resolvers = dispatch_batch(
-                        ab.algorithms, models, ab.supplemented,
-                        timings=ab.phases) if ab.live else []
-                ab.pending = PendingBatch(ab.queries, ab.serving,
-                                          ab.out, ab.live, resolvers)
-            except Exception as e:  # noqa: BLE001 — one dispatch,
-                for i in ab.live:   # whole batch
-                    ab.out[i] = e
-                ab.pending = PendingBatch(ab.queries, ab.serving,
-                                          ab.out, [], [])
+            for n_try, eff in enumerate(attempts):
+                if eff is not None:
+                    ab.lane = eff
+                    models = ab.lane_models[eff]
+                try:
+                    if eff is not None:
+                        fire(F_LANE, lane=str(eff))
+                    fire(F_DISPATCH)
+                    with server._transfer_guard():
+                        resolvers = dispatch_batch(
+                            ab.algorithms, models, ab.supplemented,
+                            timings=ab.phases) if ab.live else []
+                    ab.pending = PendingBatch(ab.queries, ab.serving,
+                                              ab.out, ab.live, resolvers)
+                    if eff is not None:
+                        server._lane_ok(eff)
+                    break
+                except Exception as e:  # noqa: BLE001 — one dispatch,
+                    if eff is not None:  # count + maybe fail over
+                        server._lane_error(eff, e)
+                    if n_try + 1 < len(attempts):
+                        continue
+                    for i in ab.live:   # whole batch, no lane left
+                        ab.out[i] = e
+                    ab.pending = PendingBatch(ab.queries, ab.serving,
+                                              ab.out, [], [])
             if in_flight_before > 0:
                 # launched while an earlier batch was still on the
                 # device: the continuous-batching overlap, counted

@@ -22,9 +22,17 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..concurrency import new_lock
+from ..data.storage.base import StorageError
+from ..faults import FaultError
 
 __all__ = ["Request", "Response", "HTTPApp", "AppServer", "json_response",
            "mount_metrics"]
+
+#: Retry-After seconds on a 503 caused by an unavailable backing store
+#: (docs/reliability.md): short enough that a recovered store is back
+#: in rotation fast, long enough that a retrying client is not the one
+#: that keeps it down
+RETRY_AFTER_SECONDS = 1
 
 #: Structured JSON access log — one line per request with the request id
 #: and any per-phase timings the handler attached (``Request.obs``).
@@ -246,6 +254,19 @@ class HTTPApp:
                     except HTTPError as e:
                         return (json_response({"message": e.message},
                                               e.status), raw)
+                    except (StorageError, FaultError) as e:
+                        # an unavailable backing store is a RETRYABLE
+                        # dependency outage, not a server bug: 503 with
+                        # Retry-After (and a clean message — never a
+                        # traceback body) instead of a bare 500, so
+                        # well-behaved clients back off and retry
+                        # (ISSUE 11 satellite)
+                        resp = json_response(
+                            {"message": "backing store unavailable: "
+                                        f"{e}"}, 503)
+                        resp.headers["Retry-After"] = str(
+                            RETRY_AFTER_SECONDS)
+                        return resp, raw
                     except Exception as e:  # noqa: BLE001 — server boundary
                         return json_response({"message": str(e)}, 500), raw
         if path_matched:

@@ -41,8 +41,11 @@ import numpy as np
 
 from ..cache.bus import InvalidationBus, default_bus
 from ..data.event import to_millis
+from ..data.storage.base import StorageError
+from ..faults import FaultError, declare, fire
 from ..obs import DEFAULT_LATENCY_BOUNDS
 from ..rollout.policy import ArmWindow, HealthPolicy
+from ..utils.retrying import RetryPolicy, retry_call
 from .cursor import EventCursor
 from .drift import DriftMonitor
 from .foldin import DEFAULT_EVENT_WEIGHTS, fold_in_events
@@ -50,6 +53,17 @@ from .foldin import DEFAULT_EVENT_WEIGHTS, fold_in_events
 log = logging.getLogger(__name__)
 
 __all__ = ["StreamConfig", "StreamTrainer"]
+
+F_PASS = declare("stream.pass",
+                 "entry of one consume→fold→canary→apply→advance pass")
+
+#: transient-storage retry budget for the cursor's log reads/writes
+#: (bounded + backed off — docs/reliability.md): a blip in the event
+#: store costs one short stall, not a failed pass; a persistent outage
+#: surfaces after a finite budget and the loop's own error backoff
+#: paces the next try
+_STORAGE_RETRY = RetryPolicy(max_attempts=3, base_ms=25.0, cap_ms=500.0)
+_STORAGE_ERRORS = (StorageError, FaultError, ConnectionError, OSError)
 
 
 @dataclass
@@ -208,6 +222,7 @@ class StreamTrainer:
 
     def _run(self) -> None:
         interval = max(self.config.interval_ms, 1.0) / 1000.0
+        error_streak = 0
         while not self._stop.is_set():
             self._wake.wait(timeout=interval)
             if self._stop.is_set():
@@ -215,20 +230,39 @@ class StreamTrainer:
             self._wake.clear()
             try:
                 n = self.consume_once()
+                error_streak = 0
                 if n >= self.config.max_events:
                     self._wake.set()  # backlog: keep draining
             except Exception as e:  # noqa: BLE001 — the loop survives
                 self._last_error = str(e)
                 log.exception("stream fold-in pass failed: %s", e)
+                # bounded-exponential backoff on consecutive failures:
+                # with the bus setting _wake on every ingest, a
+                # persistently failing dependency would otherwise spin
+                # this loop hot; cap keeps recovery detection prompt
+                error_streak += 1
+                backoff = min(5.0, 0.05 * (2 ** min(error_streak, 7)))
+                self._stop.wait(backoff)
+
+    def _advance_durable(self, events) -> None:
+        """Advance + persist the cursor with the bounded storage retry:
+        a transient store blip must not strand the cursor behind events
+        the model already absorbed (the next pass would re-fold them —
+        idempotent, but wasted device work)."""
+        self.cursor.advance(events)
+        retry_call(self.cursor.save, policy=_STORAGE_RETRY,
+                   retry_on=_STORAGE_ERRORS)
 
     # -- one pass ------------------------------------------------------------
     def consume_once(self) -> int:
         """One consume→fold→canary→apply→advance pass; returns how
         many events were consumed (0 = nothing pending or the apply
         lost a rebind race and will retry)."""
-        events = self.cursor.pending(event_names=list(self.weights),
-                                     entity_type="user",
-                                     limit=self.config.max_events)
+        fire(F_PASS, consumer=self.config.consumer)
+        events = retry_call(
+            self.cursor.pending, event_names=list(self.weights),
+            entity_type="user", limit=self.config.max_events,
+            policy=_STORAGE_RETRY, retry_on=_STORAGE_ERRORS)
         self._last_lag = len(events)
         if not events:
             return 0
@@ -264,8 +298,7 @@ class StreamTrainer:
         if report.events_relevant == 0:
             # nothing projectable (e.g. unrelated event names that
             # slipped the filter): just move the cursor past them
-            self.cursor.advance(events)
-            self.cursor.save()
+            self._advance_durable(events)
             return len(events)
         verdict = self._canary_check(model, new_model, touched)
         if verdict is not None and verdict.action == "rollback":
@@ -277,8 +310,7 @@ class StreamTrainer:
             self._m_rejects.inc()
             self._record_release("stream-reject", base_instance,
                                  verdict.reason)
-            self.cursor.advance(events)
-            self.cursor.save()
+            self._advance_durable(events)
             self._maybe_retrain()
             return len(events)
         applied = self.server.apply_stream_delta(
@@ -291,8 +323,7 @@ class StreamTrainer:
             # consumed — the next pass re-folds against the new base
             self._wake.set()
             return 0
-        self.cursor.advance(events)
-        self.cursor.save()
+        self._advance_durable(events)
         dt = time.monotonic() - t0
         now_ms = time.time() * 1000.0
         for e in events:

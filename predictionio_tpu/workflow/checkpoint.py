@@ -3,27 +3,87 @@
 The reference has NO mid-training checkpointing (SURVEY §5: MLlib's
 ``setCheckpointInterval`` only guards RDD lineage depth; a crashed
 training leaves the EngineInstance in INIT and starts over). Here
-training loops save their state pytree every k steps through Orbax and
-resume from the latest step after a crash.
+training loops save their state pytree every k steps and resume from
+the latest *committed* step after a crash.
 
-API shape is deliberately small — ``save``/``restore``/``latest_step`` —
-so algorithm loops stay one-liner instrumented:
+API shape is deliberately small — ``save``/``restore``/``latest_step``/
+``restore_latest`` — so algorithm loops stay one-liner instrumented:
 
-    ckpt = Checkpointer(dir)
-    start = ckpt.latest_step() or 0
-    state = ckpt.restore(start, like=state) if start else state
+    ckpt = make_checkpointer(dir)
+    start, state = ckpt.restore_latest(like=state)
     for step in range(start, n):
         state = update(state)
         ckpt.maybe_save(step + 1, state, every=k)
+
+Two containers behind that contract:
+
+- :class:`Checkpointer` — single-process: Orbax when available, else
+  pickle files written via temp-file + atomic rename + fsync (a crash
+  mid-save can never leave a truncated pickle that poisons the next
+  restore; the stale ``.tmp`` is garbage-collected, not trusted).
+- :class:`DistributedCheckpointer` — preemption-safe multihost
+  (ISSUE 11, docs/reliability.md): every process writes ONLY its local
+  shards of the mesh-sharded pytree, then all processes rendezvous,
+  then process 0 writes a ``COMMIT.json`` marker LAST. A step without
+  a valid commit marker is *torn* — a process died mid-save — and is
+  detected and discarded on restore, falling back to the previous
+  committed step. ``kill -9`` at ANY instant loses at most the step in
+  flight.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-from typing import Any, Optional
+import pickle
+import shutil
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..faults import declare, fire
 
 log = logging.getLogger(__name__)
+
+F_SAVE = declare("checkpoint.save",
+                 "entry of a checkpoint save (before any bytes hit disk)")
+F_COMMIT = declare("checkpoint.commit",
+                   "after all shards are written/synced, before the "
+                   "commit marker — the torn-checkpoint window")
+F_RESTORE = declare("checkpoint.restore", "entry of a checkpoint restore")
+
+
+class TornCheckpointError(RuntimeError):
+    """A step directory exists but is not a committed, readable
+    checkpoint (crash mid-save); callers fall back to an earlier step."""
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record a rename/creation in its directory (best-effort
+    on filesystems without directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """temp-file + fsync + atomic rename + directory fsync: after this
+    returns, ``path`` durably holds exactly ``data``; a crash at any
+    earlier instant leaves the previous content (or nothing) — never a
+    truncated file."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
 
 
 class Checkpointer:
@@ -63,6 +123,7 @@ class Checkpointer:
 
     # -- orbax path --------------------------------------------------------
     def save(self, step: int, state: Any) -> None:
+        fire(F_SAVE, step=step)
         if self._mgr is not None:
             # async: only wait for the PREVIOUS save before issuing this
             # one, so writes overlap the next training step; close()
@@ -70,28 +131,33 @@ class Checkpointer:
             self._mgr.wait_until_finished()
             self._mgr.save(step, args=self._ocp.args.StandardSave(state))
             return
-        import pickle
-
         from .persistence import to_host
 
         path = os.path.join(self.directory, f"step_{step}.pkl")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(to_host(state), f, protocol=4)
-        os.replace(tmp, path)
+        payload = pickle.dumps(to_host(state), protocol=4)
+        fire(F_COMMIT, step=step)
+        _atomic_write(path, payload)
         self._prune_pickles()
 
     def restore(self, step: int, like: Optional[Any] = None) -> Any:
+        fire(F_RESTORE, step=step)
         if self._mgr is not None:
             if like is not None:
                 return self._mgr.restore(
                     step, args=self._ocp.args.StandardRestore(like))
             return self._mgr.restore(step)
-        import pickle
-
         with open(os.path.join(self.directory, f"step_{step}.pkl"),
                   "rb") as f:
             return pickle.load(f)
+
+    def restore_latest(self, like: Optional[Any] = None,
+                       max_step: Optional[int] = None
+                       ) -> Tuple[int, Optional[Any]]:
+        """``(step, state)`` of the newest RESTORABLE checkpoint at or
+        below ``max_step`` — a torn/corrupt step (crash mid-save, a
+        truncated container) is logged and skipped, falling back to the
+        previous committed one; ``(0, None)`` when nothing restores."""
+        return _restore_latest(self, like, max_step)
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
@@ -104,17 +170,10 @@ class Checkpointer:
 
     # -- run metadata (fingerprint guard against foreign checkpoints) ------
     def set_metadata(self, meta: dict) -> None:
-        import json
-
-        path = os.path.join(self.directory, "run_metadata.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(meta, f)
-        os.replace(tmp, path)
+        _atomic_write(os.path.join(self.directory, "run_metadata.json"),
+                      json.dumps(meta).encode("utf-8"))
 
     def get_metadata(self) -> Optional[dict]:
-        import json
-
         path = os.path.join(self.directory, "run_metadata.json")
         if not os.path.exists(path):
             return None
@@ -148,3 +207,333 @@ class Checkpointer:
         steps = sorted(self._pickle_steps())
         for s in steps[: -self.keep]:
             os.remove(os.path.join(self.directory, f"step_{s}.pkl"))
+
+
+def _restore_latest(ckpt, like, max_step) -> Tuple[int, Optional[Any]]:
+    """Shared newest-restorable-step walk (desc order, torn steps
+    skipped) for both checkpointer flavors."""
+    steps = [s for s in ckpt.all_steps()
+             if max_step is None or s <= max_step]
+    for s in sorted(steps, reverse=True):
+        try:
+            return int(s), ckpt.restore(s, like=like)
+        except Exception as e:  # noqa: BLE001 — torn/corrupt step:
+            # fall back to the previous committed one
+            log.warning("checkpoint step %s unreadable (%s); falling "
+                        "back to the previous committed step", s, e)
+    return 0, None
+
+
+# ---------------------------------------------------------------------------
+# Preemption-safe distributed checkpointing (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+_COMMIT = "COMMIT.json"
+
+
+def _serialize_index(index) -> list:
+    """A shard's global-array slice tuple as JSON ``[[start, stop], …]``
+    (None start/stop normalized against the dimension elsewhere — JAX
+    addressable-shard indices are always concrete slices)."""
+    out = []
+    for sl in index:
+        out.append([None if sl.start is None else int(sl.start),
+                    None if sl.stop is None else int(sl.stop)])
+    return out
+
+
+def _norm_index(index, shape) -> tuple:
+    """Hashable normalized form of a shard index for matching saved
+    shards to the restore sharding's addressable devices."""
+    out = []
+    for i, sl in enumerate(index):
+        start = 0 if sl[0] is None else int(sl[0])
+        stop = int(shape[i]) if sl[1] is None else int(sl[1])
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _is_jax_array(leaf: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(leaf, jax.Array)
+    except Exception:  # noqa: BLE001 — no jax: nothing is a jax array
+        return False
+
+
+class DistributedCheckpointer:
+    """Per-process sharded checkpoints of mesh-sharded pytrees with a
+    rendezvous commit marker (module docstring). Layout::
+
+        <dir>/step_00000003/shard_p0.npz   # process 0's local shards
+        <dir>/step_00000003/shard_p0.json  # its per-leaf shard index
+        <dir>/step_00000003/shard_p1.npz
+        <dir>/step_00000003/shard_p1.json
+        <dir>/step_00000003/COMMIT.json    # written LAST, by process 0
+
+    The directory must be shared across processes (NFS/GCS on a pod;
+    one tmpdir in the CI drill). Replicated leaves (plain numpy, or a
+    fully-replicated jax.Array) are written once, by the process that
+    owns replica 0 of each shard; restore reads ANY process's files, so
+    process/shard layout may be re-derived from the ``like`` pytree's
+    shardings as long as every saved shard index is covered.
+    """
+
+    def __init__(self, directory: str, keep: int = 2,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = keep
+        if process_index is None or process_count is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+            except Exception:  # noqa: BLE001 — no backend: single
+                process_index, process_count = 0, 1
+        self.pid = int(process_index)
+        self.n_proc = int(process_count)
+
+    # -- rendezvous --------------------------------------------------------
+    def _barrier(self, tag: str) -> None:
+        if self.n_proc <= 1:
+            return
+        from ..parallel.multihost import barrier
+
+        barrier(f"ckpt:{os.path.basename(self.directory)}:{tag}")
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{int(step):08d}")
+
+    # -- save --------------------------------------------------------------
+    def save(self, step: int, state: Any) -> None:
+        import jax
+
+        fire(F_SAVE, step=step)
+        step_dir = self._step_dir(step)
+        os.makedirs(step_dir, exist_ok=True)
+        leaves, _ = jax.tree_util.tree_flatten(state)
+        arrays: dict = {}
+        index: List[dict] = []
+        for i, leaf in enumerate(leaves):
+            if _is_jax_array(leaf) and getattr(leaf, "sharding", None) \
+                    is not None and not leaf.is_fully_replicated:
+                for shard in leaf.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue  # another device holds the same rows
+                    key = f"l{i}_s{len(index)}"
+                    arrays[key] = np.asarray(shard.data)
+                    index.append({
+                        "leaf": i, "key": key,
+                        "index": _serialize_index(shard.index),
+                        "shape": [int(d) for d in leaf.shape]})
+            else:
+                # replicated/host leaf: ONE writer (the lowest process)
+                if self.pid == 0:
+                    key = f"l{i}_full"
+                    arrays[key] = np.asarray(leaf)
+                    index.append({"leaf": i, "key": key, "index": None})
+        # npz then json, each atomic+fsynced; the json names the npz so
+        # a reader never trusts a shard file without its manifest
+        import io
+
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        npz_name = f"shard_p{self.pid}.npz"
+        _atomic_write(os.path.join(step_dir, npz_name), buf.getvalue())
+        _atomic_write(
+            os.path.join(step_dir, f"shard_p{self.pid}.json"),
+            json.dumps({"process": self.pid, "npz": npz_name,
+                        "entries": index}).encode("utf-8"))
+        # every process's shards durable BEFORE anyone may commit
+        self._barrier(f"save:{step}")
+        fire(F_COMMIT, step=step)
+        if self.pid == 0:
+            _atomic_write(
+                os.path.join(step_dir, _COMMIT),
+                json.dumps({
+                    "step": int(step),
+                    "processes": self.n_proc,
+                    "manifests": [f"shard_p{p}.json"
+                                  for p in range(self.n_proc)],
+                }).encode("utf-8"))
+            _fsync_dir(self.directory)
+        # nobody races ahead (and prunes/overwrites) before the commit
+        # marker exists
+        self._barrier(f"commit:{step}")
+        if self.pid == 0:
+            self._prune()
+
+    # -- restore -----------------------------------------------------------
+    def _read_commit(self, step: int) -> dict:
+        path = os.path.join(self._step_dir(step), _COMMIT)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                commit = json.load(f)
+        except (OSError, ValueError) as e:
+            raise TornCheckpointError(
+                f"step {step}: no valid commit marker ({e}) — save was "
+                f"interrupted; discarding") from e
+        return commit
+
+    def restore(self, step: int, like: Optional[Any] = None) -> Any:
+        """Rebuild the pytree for THIS process: sharded leaves are
+        reassembled from the saved shards matching ``like``'s sharding
+        (device_put per local shard), replicated leaves come back as
+        host numpy. Raises :class:`TornCheckpointError` on a step with
+        a missing/invalid commit marker or missing shard data."""
+        import jax
+
+        fire(F_RESTORE, step=step)
+        if like is None:
+            raise ValueError("DistributedCheckpointer.restore needs "
+                             "like= (the tree/sharding template)")
+        commit = self._read_commit(step)
+        step_dir = self._step_dir(step)
+        # leaf → {normalized index or None → np.ndarray}
+        shards: dict = {}
+        for manifest_name in commit["manifests"]:
+            try:
+                with open(os.path.join(step_dir, manifest_name),
+                          "r", encoding="utf-8") as f:
+                    manifest = json.load(f)
+                data = np.load(os.path.join(step_dir, manifest["npz"]))
+            except (OSError, ValueError) as e:
+                raise TornCheckpointError(
+                    f"step {step}: shard manifest {manifest_name} "
+                    f"unreadable ({e})") from e
+            for entry in manifest["entries"]:
+                per_leaf = shards.setdefault(int(entry["leaf"]), {})
+                if entry["index"] is None:
+                    per_leaf[None] = data[entry["key"]]
+                else:
+                    per_leaf[_norm_index(entry["index"],
+                                         entry["shape"])] = \
+                        data[entry["key"]]
+        leaves, treedef = jax.tree_util.tree_flatten(like)
+        out: List[Any] = []
+        for i, leaf in enumerate(leaves):
+            per_leaf = shards.get(i)
+            if per_leaf is None:
+                raise TornCheckpointError(
+                    f"step {step}: leaf {i} missing from every shard "
+                    f"manifest")
+            if _is_jax_array(leaf) and not leaf.is_fully_replicated:
+                sharding = leaf.sharding
+                idx_map = sharding.addressable_devices_indices_map(
+                    leaf.shape)
+                pieces = []
+                for dev, idx in idx_map.items():
+                    want = _norm_index(_serialize_index(idx), leaf.shape)
+                    if want not in per_leaf:
+                        raise TornCheckpointError(
+                            f"step {step}: leaf {i} shard {want} not in "
+                            f"the saved set (process/mesh layout "
+                            f"changed?)")
+                    pieces.append(jax.device_put(per_leaf[want], dev))
+                out.append(jax.make_array_from_single_device_arrays(
+                    leaf.shape, sharding, pieces))
+            else:
+                full = per_leaf.get(None)
+                if full is None:
+                    raise TornCheckpointError(
+                        f"step {step}: replicated leaf {i} missing")
+                out.append(full)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def restore_latest(self, like: Optional[Any] = None,
+                       max_step: Optional[int] = None
+                       ) -> Tuple[int, Optional[Any]]:
+        """``(step, state)`` of the newest COMMITTED restorable step at
+        or below ``max_step``; torn steps are skipped (and every
+        process falls back identically — ``all_steps`` only lists
+        committed markers, so the walk is deterministic across the
+        mesh); ``(0, None)`` when none restores."""
+        return _restore_latest(self, like, max_step)
+
+    def discard_torn(self) -> List[int]:
+        """Delete step dirs without a valid commit marker (process 0
+        only — others observe); returns the discarded step numbers."""
+        torn = []
+        for name in sorted(os.listdir(self.directory)):
+            if not name.startswith("step_"):
+                continue
+            try:
+                step = int(name[5:])
+            except ValueError:
+                continue
+            try:
+                self._read_commit(step)
+            except TornCheckpointError:
+                torn.append(step)
+                if self.pid == 0:
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+        return torn
+
+    # -- bookkeeping -------------------------------------------------------
+    def all_steps(self) -> list:
+        """Committed steps only — an uncommitted (torn) dir is not a
+        checkpoint."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and os.path.exists(
+                    os.path.join(self.directory, name, _COMMIT)):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return max(steps) if steps else None
+
+    def maybe_save(self, step: int, state: Any, every: int) -> bool:
+        if every and step % every == 0:
+            self.save(step, state)
+            return True
+        return False
+
+    def set_metadata(self, meta: dict) -> None:
+        if self.pid == 0:
+            _atomic_write(
+                os.path.join(self.directory, "run_metadata.json"),
+                json.dumps(meta).encode("utf-8"))
+        self._barrier("metadata")
+
+    def get_metadata(self) -> Optional[dict]:
+        path = os.path.join(self.directory, "run_metadata.json")
+        if not os.path.exists(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def close(self) -> None:
+        pass
+
+    def _prune(self) -> None:
+        steps = self.all_steps()
+        for s in steps[: -self.keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+
+def make_checkpointer(directory: str, keep: int = 2):
+    """The factory training loops call: the distributed container when
+    this is a multi-process run (or ``PTPU_DIST_CKPT=1`` forces it —
+    drills and tests exercise the sharded layout single-process), else
+    the single-process :class:`Checkpointer`."""
+    force = os.environ.get("PTPU_DIST_CKPT", "") == "1"
+    n = 1
+    try:
+        import jax
+
+        n = jax.process_count()
+    except Exception:  # noqa: BLE001 — no backend yet: single-process
+        pass
+    if force or n > 1:
+        return DistributedCheckpointer(directory, keep=keep)
+    return Checkpointer(directory, keep=keep)

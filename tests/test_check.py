@@ -371,6 +371,132 @@ class TestConfigDrift:
         assert check_source(code, path=COLD) == []
 
 
+class TestUnboundedRetry:
+    RETRY = "predictionio_tpu/streaming/loop.py"  # in-scope dir
+
+    def test_positive_hot_spin_retry(self):
+        code = src("""
+            def tail(store):
+                while True:
+                    try:
+                        return store.read()
+                    except Exception:
+                        continue
+        """)
+        findings = check_source(code, path=self.RETRY)
+        assert rules_of(findings) == ["unbounded-retry"]
+        assert "retry_call" in findings[0].message
+
+    def test_positive_itertools_count(self):
+        code = src("""
+            import itertools
+
+            def tail(store):
+                for _ in itertools.count():
+                    try:
+                        return store.read()
+                    except OSError:
+                        pass
+        """)
+        findings = check_source(code, path=self.RETRY)
+        assert rules_of(findings) == ["unbounded-retry"]
+
+    def test_negative_backoff_sleep(self):
+        code = src("""
+            import time
+
+            def tail(store):
+                while True:
+                    try:
+                        return store.read()
+                    except Exception:
+                        time.sleep(0.5)
+        """)
+        assert check_source(code, path=self.RETRY) == []
+
+    def test_negative_bounded_attempts(self):
+        code = src("""
+            def tail(store):
+                for attempt in range(5):
+                    try:
+                        return store.read()
+                    except Exception:
+                        continue
+                raise RuntimeError("gave up")
+        """)
+        assert check_source(code, path=self.RETRY) == []
+
+    def test_negative_blocking_get_paces(self):
+        code = src("""
+            def drain(q, store):
+                while True:
+                    item = q.get()
+                    try:
+                        store.write(item)
+                    except Exception:
+                        continue
+        """)
+        assert check_source(code, path=self.RETRY) == []
+
+    def test_negative_nowait_does_not_pace(self):
+        code = src("""
+            def drain(q, store):
+                while True:
+                    try:
+                        store.write(q.get_nowait())
+                    except Exception:
+                        continue
+        """)
+        findings = check_source(code, path=self.RETRY)
+        assert rules_of(findings) == ["unbounded-retry"]
+
+    def test_negative_reraise_escapes(self):
+        code = src("""
+            def tail(store):
+                while True:
+                    try:
+                        return store.read()
+                    except Exception:
+                        raise
+        """)
+        assert check_source(code, path=self.RETRY) == []
+
+    def test_negative_retry_call_helper(self):
+        code = src("""
+            from predictionio_tpu.utils.retrying import retry_call
+
+            def tail(store):
+                while True:
+                    try:
+                        return retry_call(store.read)
+                    except Exception:
+                        continue
+        """)
+        assert check_source(code, path=self.RETRY) == []
+
+    def test_negative_out_of_scope_dir(self):
+        code = src("""
+            def tail(store):
+                while True:
+                    try:
+                        return store.read()
+                    except Exception:
+                        continue
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            def tail(store):
+                while True:
+                    try:
+                        return store.read()
+                    except Exception:  # ptpu: allow[unbounded-retry]
+                        continue
+        """)
+        assert check_source(code, path=self.RETRY) == []
+
+
 class TestPragmaGeneral:
     def test_wildcard_allows_every_rule(self):
         code = src("""
@@ -478,7 +604,7 @@ class TestRepoWide:
         assert set(RULES) == {
             "host-sync-in-hot-path", "recompile-hazard",
             "missing-donation", "sharding-mismatch", "config-drift",
-            "materialized-gather",
+            "materialized-gather", "unbounded-retry",
             "unguarded-shared-state", "lock-order-inversion",
             "blocking-under-lock", "callback-under-lock",
             "vmem-overbudget", "dma-unwaited",

@@ -1,0 +1,171 @@
+"""Fault-injection registry (ISSUE 11): spec grammar, deterministic
+seeded schedules, point/label matching, modes, and the global fire()
+fast path."""
+
+import time
+
+import pytest
+
+from predictionio_tpu import faults
+from predictionio_tpu.faults import FaultError, FaultSpec, parse_specs
+from predictionio_tpu.faults.registry import FaultRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_registry():
+    yield
+    faults.clear()
+
+
+class TestSpecGrammar:
+    def test_basic(self):
+        (s,) = parse_specs("storage.io=error")
+        assert s.point == "storage.io" and s.mode == "error"
+        assert s.rate == 1.0 and s.times == -1 and s.after == 0
+
+    def test_options_and_labels(self):
+        (s,) = parse_specs(
+            "serving.lane=error,rate=0.5,times=3,after=2,seed=7,lane=1")
+        assert s.rate == 0.5 and s.times == 3 and s.after == 2
+        assert s.seed == 7 and s.match == {"lane": "1"}
+
+    def test_multiple_specs(self):
+        specs = parse_specs(
+            "checkpoint.commit=crash,after=2; storage.io=latency,"
+            "delay_ms=5")
+        assert [s.mode for s in specs] == ["crash", "latency"]
+        assert specs[1].delay_ms == 5.0
+
+    def test_bad_spec_raises(self):
+        with pytest.raises(ValueError):
+            parse_specs("nonsense")
+        with pytest.raises(ValueError):
+            parse_specs("p=error,rate=")
+        with pytest.raises(ValueError, match="mode"):
+            parse_specs("p=explode")
+        with pytest.raises(ValueError, match="rate"):
+            FaultSpec(point="p", rate=1.5)
+
+
+class TestSchedules:
+    def test_error_mode_raises_with_point(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="storage.io"))
+        with pytest.raises(FaultError) as ei:
+            r.fire("storage.io")
+        assert ei.value.point == "storage.io"
+
+    def test_times_bounds_injections(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="p", times=2))
+        for _ in range(2):
+            with pytest.raises(FaultError):
+                r.fire("p")
+        r.fire("p")  # budget spent: passes through
+        assert r.status()["injections"] == {"p|error": 2}
+
+    def test_after_skips_first_n(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="p", after=3, times=1))
+        for _ in range(3):
+            r.fire("p")
+        with pytest.raises(FaultError):
+            r.fire("p")
+        r.fire("p")
+
+    def test_rate_is_seed_deterministic(self):
+        def run():
+            r = FaultRegistry()
+            r.inject(FaultSpec(point="p", rate=0.4, seed=11))
+            hits = []
+            for i in range(50):
+                try:
+                    r.fire("p")
+                    hits.append(0)
+                except FaultError:
+                    hits.append(1)
+            return hits
+
+        a, b = run(), run()
+        assert a == b
+        assert 5 < sum(a) < 45  # actually probabilistic, not 0/1
+
+    def test_label_match(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="serving.lane", match={"lane": "1"}))
+        r.fire("serving.lane", lane=0)
+        with pytest.raises(FaultError):
+            r.fire("serving.lane", lane=1)
+
+    def test_glob_point(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="checkpoint.*", times=2))
+        with pytest.raises(FaultError):
+            r.fire("checkpoint.save")
+        with pytest.raises(FaultError):
+            r.fire("checkpoint.commit")
+        r.fire("storage.io")
+
+    def test_latency_mode_sleeps_then_proceeds(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="p", mode="latency", delay_ms=30))
+        t0 = time.monotonic()
+        r.fire("p")  # no raise
+        assert time.monotonic() - t0 >= 0.025
+
+    def test_clear(self):
+        r = FaultRegistry()
+        r.inject(FaultSpec(point="a"))
+        r.inject(FaultSpec(point="b"))
+        assert r.enabled()
+        assert r.clear("a") == 1
+        assert r.clear() == 1
+        assert not r.enabled()
+        r.fire("a")
+        r.fire("b")
+
+    def test_listener_observes_injections(self):
+        r = FaultRegistry()
+        seen = []
+        r.add_listener(lambda point, mode: seen.append((point, mode)))
+        r.inject(FaultSpec(point="p", times=1))
+        with pytest.raises(FaultError):
+            r.fire("p")
+        r.fire("p")
+        assert seen == [("p", "error")]
+
+
+class TestGlobalFire:
+    def test_noop_when_disarmed(self):
+        # must never raise or require the registry lock on the fast path
+        faults.fire("storage.io", op="insert")
+
+    def test_inject_spec_and_status(self):
+        faults.inject_spec("storage.io=error,times=1")
+        assert faults.enabled()
+        with pytest.raises(FaultError):
+            faults.fire("storage.io")
+        st = faults.status()
+        # >=: the process-wide registry accumulates counts across tests
+        assert st["fired"]["storage.io"] >= 1
+        assert st["injections"]["storage.io|error"] >= 1
+
+    def test_env_loading(self, monkeypatch):
+        monkeypatch.setenv("PTPU_FAULTS", "a.b=error,times=1")
+        r = FaultRegistry()
+        r.load_env()
+        r.load_env()  # idempotent: loads once
+        assert len(r.status()["armed"]) == 1
+
+    def test_points_catalog_populated(self):
+        # the instrumented subsystems declare their points at import
+        import predictionio_tpu.server.engineserver  # noqa: F401
+        import predictionio_tpu.streaming.trainer  # noqa: F401
+        import predictionio_tpu.workflow.checkpoint  # noqa: F401
+
+        for point in ("storage.io", "storage.remote", "serving.lane",
+                      "serving.lane_restart", "serving.dispatch",
+                      "stream.pass", "checkpoint.save",
+                      "checkpoint.commit", "checkpoint.restore",
+                      "multihost.collective"):
+            assert point in faults.POINTS, point
