@@ -697,6 +697,9 @@ def main():
         # (ISSUE 9): qps_x / p99_x and the device-idle fraction from
         # the staged server's own accounting
         "serving_pipeline": (serving or {}).get("pipeline"),
+        # flight-recorder overhead (ISSUE 12 acceptance ≤5%): host
+        # fast-path p50 with tracing on vs off, same load
+        "trace_overhead_pct": (serving or {}).get("trace_overhead_pct"),
         # event→servable freshness through the streaming trainer
         # (ISSUE 10): ingest to correct serve, real HTTP loop
         "event_to_servable_ms": (streaming or {}).get(

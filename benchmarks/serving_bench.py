@@ -354,6 +354,12 @@ def standard_battery(n_items_dev: int, rank: int, n_req: int,
         "host_fast_path": bench_config(
             host_model, ServerConfig(), max(n_req, 300), n_threads,
             "host_fast_path"),
+        # tracing A/B (ISSUE 12 acceptance: tracing adds ≤5% to the
+        # host fast-path p50): the same load with the flight recorder
+        # off — the ONLY config difference
+        "host_fast_path_untraced": bench_config(
+            host_model, ServerConfig(tracing=False), max(n_req, 300),
+            n_threads, "host_fast_path_untraced"),
         "per_query": bench_config(
             dev_model, ServerConfig(), n_req, n_threads,
             "device_per_query"),
@@ -372,6 +378,11 @@ def standard_battery(n_items_dev: int, rank: int, n_req: int,
     }
     out["pipeline"] = pipeline_block(out["microbatch"],
                                      out["microbatch_serial"])
+    traced = out["host_fast_path"].get("p50_ms")
+    untraced = out["host_fast_path_untraced"].get("p50_ms")
+    if traced and untraced:
+        out["trace_overhead_pct"] = round(
+            (traced / untraced - 1.0) * 100.0, 2)
     return out
 
 

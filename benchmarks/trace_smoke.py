@@ -1,0 +1,217 @@
+"""Flight-recorder smoke (ISSUE 12) — the CI gate for end-to-end
+tracing under real HTTP load.
+
+1. deploy a synthetic device-budget model with tracing on, drive a
+   concurrent load, and inject ONE latency fault into a device
+   dispatch — that query must come back 200 (the fault is just delay)
+   and its trace must be RETAINED (flagged ``fault``) while the
+   healthy bulk of the load is dropped;
+2. the retained trace's Perfetto export must validate: trace-event
+   JSON with the full stage timeline (dispatch + readback present),
+   every event carrying ``ph``/``ts``/``dur``, parented under the
+   batch span;
+3. ``pio_trace_*`` gauges are nonzero on /metrics and the OpenMetrics
+   negotiation carries a ``pio_query_latency_seconds`` bucket exemplar
+   pointing at a retained trace id.
+
+Prints one JSON line; exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+from datetime import datetime, timezone
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from predictionio_tpu.controller import Context  # noqa: E402
+from predictionio_tpu.data.bimap import BiMap  # noqa: E402
+from predictionio_tpu.data.storage import App, Storage  # noqa: E402
+from predictionio_tpu.data.storage.base import (  # noqa: E402
+    STATUS_COMPLETED,
+    EngineInstance,
+)
+from predictionio_tpu.faults import inject_spec, registry  # noqa: E402
+from predictionio_tpu.models.als import ALSModel, ALSParams  # noqa: E402
+from predictionio_tpu.obs.trace import (  # noqa: E402
+    format_traceparent,
+    parse_traceparent,
+)
+from predictionio_tpu.server.engineserver import (  # noqa: E402
+    QueryServer,
+    ServerConfig,
+    create_engine_server,
+)
+from predictionio_tpu.templates.recommendation import (  # noqa: E402
+    default_engine_params,
+    recommendation_engine,
+)
+
+FAULT_TRACE_ID = "f0" * 16
+
+
+def call(port, path, body=None, headers=None, timeout=120):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data,
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank = 5_000, 70_000, 32
+    import jax
+
+    model = ALSModel(
+        user_factors=jax.device_put(rng.standard_normal(
+            (n_users, rank)).astype(np.float32)),
+        item_factors=jax.device_put(rng.standard_normal(
+            (n_items, rank)).astype(np.float32)),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "tracesmoke"))
+    ctx = Context(app_name="tracesmoke", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="smoke", status=STATUS_COMPLETED, start_time=now,
+        end_time=now, engine_id="smoke", engine_version="1",
+        engine_variant="engine.json", engine_factory="synthetic")
+    storage.engine_instances().insert(inst)
+    qs = QueryServer(
+        ctx, recommendation_engine(),
+        default_engine_params("tracesmoke", rank=rank),
+        [model], inst,
+        ServerConfig(batching=True, max_batch=16, warm_start=False))
+    srv = create_engine_server(qs, "127.0.0.1", 0).start_background()
+    port = srv.port
+    checks = {}
+    try:
+        # warm the dispatch path so the injected-slow query is the
+        # outlier, not the compile
+        for u in (1, 2, 3):
+            call(port, "/queries.json", {"user": f"u{u}", "num": 5})
+
+        # ONE injected-slow dispatch, tagged with a known trace id so
+        # retention is attributable; armed while nothing else is in
+        # flight so the times=1 schedule hits THIS query's dispatch
+        inject_spec("serving.dispatch=latency,delay_ms=400,times=1")
+        try:
+            status, headers, _ = call(
+                port, "/queries.json", {"user": "u5", "num": 5},
+                headers={"traceparent": format_traceparent(
+                    FAULT_TRACE_ID, "11" * 8)})
+        finally:
+            registry().clear("serving.dispatch")
+
+        # then a healthy concurrent load the tail sampler should DROP
+        def load(i):
+            try:
+                call(port, "/queries.json",
+                     {"user": f"u{10 + i}", "num": 5})
+            except Exception:  # noqa: BLE001 — checks judge below
+                pass
+
+        threads = [threading.Thread(target=load, args=(i,))
+                   for i in range(48)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        checks["slow_query_answered_200"] = status == 200
+        echoed = parse_traceparent(headers.get("traceparent") or "")
+        checks["traceparent_adopted"] = (
+            echoed is not None and echoed[0] == FAULT_TRACE_ID)
+
+        # 1) the injected-slow query is retained and retrievable
+        status, _, body = call(port,
+                               f"/trace.json?id={FAULT_TRACE_ID}")
+        doc = json.loads(body)
+        checks["injected_query_retained"] = (
+            doc.get("otherData", {}).get("traceId") == FAULT_TRACE_ID)
+        checks["retained_as_fault_or_slow"] = (
+            doc.get("otherData", {}).get("retainedReason")
+            in ("fault", "slow"))
+
+        # 2) Perfetto export validates with the full stage timeline
+        events = doc.get("traceEvents") or []
+        checks["events_well_formed"] = bool(events) and all(
+            e.get("ph") == "X" and isinstance(e.get("ts"), (int, float))
+            and isinstance(e.get("dur"), (int, float))
+            for e in events)
+        names = {e["name"] for e in events}
+        checks["stage_timeline_complete"] = (
+            "dispatch" in names and "readback" in names
+            and "batch" in names)
+        batch = next((e for e in events if e["name"] == "batch"), {})
+        dispatch = next((e for e in events
+                         if e["name"] == "dispatch"), {})
+        checks["stages_parented_on_batch"] = (
+            dispatch.get("args", {}).get("parentId")
+            == batch.get("args", {}).get("spanId"))
+
+        # tail sampling actually sampled: most of the healthy load
+        # was dropped
+        _, _, body = call(port, "/trace.json")
+        st = json.loads(body)
+        checks["healthy_bulk_dropped"] = (
+            st["requests"] >= 50
+            and st["retained"] < st["requests"] / 2)
+
+        # 3) pio_trace_* gauges nonzero + OpenMetrics exemplar
+        _, _, body = call(port, "/metrics")
+        text = body.decode()
+
+        def series_value(name_prefix):
+            for ln in text.splitlines():
+                if ln.startswith(name_prefix):
+                    try:
+                        return float(ln.rsplit(" ", 1)[1])
+                    except ValueError:
+                        continue
+            return 0.0
+
+        checks["pio_trace_requests_nonzero"] = series_value(
+            "pio_trace_requests_total") > 0
+        checks["pio_trace_ring_nonzero"] = series_value(
+            "pio_trace_ring_size") > 0
+        checks["pio_trace_retained_nonzero"] = any(
+            series_value(f'pio_trace_retained_total{{reason="{r}"}}')
+            > 0 for r in ("fault", "slow", "error", "deadline"))
+        _, om_headers, body = call(
+            port, "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        om = body.decode()
+        checks["openmetrics_negotiated"] = om_headers[
+            "Content-Type"].startswith("application/openmetrics-text")
+        ex = [ln for ln in om.splitlines()
+              if "pio_query_latency_seconds_bucket" in ln
+              and "# {" in ln]
+        checks["exemplar_present"] = bool(ex) and bool(
+            re.search(r'# \{trace_id="[0-9a-f]{32}"\}', ex[0]))
+    finally:
+        srv.shutdown()
+
+    ok = all(bool(v) for v in checks.values())
+    print(json.dumps({"bench": "trace_smoke", "ok": ok, **checks}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,7 +331,12 @@ def cmd_deploy(args, storage: Storage) -> int:
         stream_consumer=args.stream_consumer,
         stream_drift_threshold=args.stream_drift_threshold,
         stream_canary_probes=args.stream_canary_probes,
-        faults=args.faults or None)
+        faults=args.faults or None,
+        tracing=not args.no_trace,
+        trace_ring=args.trace_ring,
+        trace_slow_ms=args.trace_slow_ms,
+        access_log_sample=args.access_log_sample,
+        profile_dir=args.profile_dir or None)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -974,6 +979,53 @@ def cmd_stream(args, storage: Storage) -> int:
     return 1
 
 
+def cmd_trace(args, storage: Storage) -> int:
+    """``ptpu trace`` — read a running server's tail-sampled flight
+    recorder (ISSUE 12, docs/tracing.md): recorder status, the N
+    slowest retained traces, or one trace exported as Chrome/Perfetto
+    trace-event JSON (load the file at ui.perfetto.dev)."""
+    try:
+        if args.id:
+            payload = _server_call(args, f"/trace.json?id={args.id}")
+        elif args.slowest is not None:
+            payload = _server_call(
+                args, f"/trace.json?slowest={args.slowest}")
+        else:
+            payload = _server_call(args, "/trace.json")
+    except Exception as e:  # noqa: BLE001 — report, don't traceback
+        _err(f"server at {args.ip}:{args.port} unreachable: "
+             f"{_http_err_detail(e)}")
+        return 1
+    if args.id:
+        out_path = args.output or f"trace-{args.id[:12]}.json"
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        n = len((payload or {}).get("traceEvents") or [])
+        _out(f"Wrote {n} trace events to {out_path} — load it at "
+             f"https://ui.perfetto.dev (or chrome://tracing).")
+        return 0
+    if args.slowest is not None:
+        traces = (payload or {}).get("traces") or []
+        if not traces:
+            _out("No retained traces yet (only slow / errored / "
+                 "deadline-503'd / fault-injected requests are kept).")
+            return 0
+        for t in traces:
+            _out(f"{t.get('traceId')}  {t.get('durationMs', '?')}ms  "
+                 f"status={t.get('status')}  "
+                 f"reason={t.get('reason')}  {t.get('name', '')}")
+        _out(f"Export one: ptpu trace --id {traces[0]['traceId']}")
+        return 0
+    _out(json.dumps(payload, indent=2))
+    p = payload or {}
+    _out(f"flight recorder: {p.get('retained', 0)}/"
+         f"{p.get('ringCapacity', '?')} retained of "
+         f"{p.get('requests', 0)} traced requests"
+         + (f", slow ≥ {p['slowThresholdMs']}ms"
+            if p.get("slowThresholdMs") is not None else ""))
+    return 0
+
+
 def _http_err_detail(e: Exception) -> str:
     """Surface the server's JSON error message instead of a bare
     'HTTP Error 409'."""
@@ -1422,6 +1474,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/reliability.md), e.g. "
                         "'serving.lane=error,lane=1,times=5'; the "
                         "PTPU_FAULTS env var works on every server")
+    s.add_argument("--no-trace", action="store_true",
+                   help="disable end-to-end request tracing "
+                        "(docs/tracing.md; on by default — every "
+                        "request traced, only slow/error/503/fault "
+                        "traces retained)")
+    s.add_argument("--trace-ring", type=int, default=512,
+                   help="retained traces the flight-recorder ring "
+                        "holds (oldest evicted)")
+    s.add_argument("--trace-slow-ms", type=float, default=0.0,
+                   help="fixed slow-retention threshold in ms; 0 = "
+                        "adaptive (live p99 of traced durations)")
+    s.add_argument("--access-log-sample", type=float, default=1.0,
+                   help="fraction of successful requests written to "
+                        "the JSON access log (errors/503s always "
+                        "log); 1.0 = every request")
+    s.add_argument("--profile-dir", default="",
+                   help="artifact dir for POST /profile device "
+                        "captures (default $PTPU_PROFILE_DIR or "
+                        "<tmp>/ptpu-profiles)")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -1525,6 +1596,24 @@ def build_parser() -> argparse.ArgumentParser:
             c.add_argument("--drift-threshold", type=float,
                            default=None)
             c.add_argument("--canary-probes", type=int, default=None)
+
+    s = sub.add_parser(
+        "trace", help="flight recorder: list the slowest retained "
+                      "traces or export one as Perfetto JSON "
+                      "(docs/tracing.md)")
+    s.add_argument("--ip", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--accesskey", default="")
+    s.add_argument("--https", action="store_true")
+    s.add_argument("--insecure", action="store_true")
+    s.add_argument("--id", default="",
+                   help="export this retained trace as Chrome/"
+                        "Perfetto trace-event JSON")
+    s.add_argument("--slowest", type=int, default=None,
+                   help="list the N slowest retained traces")
+    s.add_argument("-o", "--output", default="",
+                   help="output file for --id (default "
+                        "trace-<id>.json)")
 
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)
@@ -1655,6 +1744,7 @@ COMMANDS = {
     "release": cmd_release,
     "cache": cmd_cache,
     "stream": cmd_stream,
+    "trace": cmd_trace,
     "batchpredict": cmd_batchpredict,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

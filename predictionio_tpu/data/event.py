@@ -172,6 +172,12 @@ def parse_iso(s: str) -> datetime:
 #: they index).
 BUILTIN_ENTITY_TYPES = frozenset({"pio_pr", "pio_stream"})
 
+#: Reserved-prefix property names the framework itself stamps onto
+#: events. ``pio_traceparent`` carries the W3C trace context of the
+#: ingest request (ISSUE 12, docs/tracing.md) so a streaming fold-in
+#: can link the event's trace to the hot-swap that made it servable.
+BUILTIN_PROPERTY_NAMES = frozenset({"pio_traceparent"})
+
 #: Reserved name prefix for entity types and property names.
 RESERVED_PREFIX = "pio_"
 
@@ -214,7 +220,7 @@ def validate_event(e: Event) -> None:
                  f"targetEntityType {e.target_entity_type!r} is not allowed; "
                  f"{RESERVED_PREFIX!r} is a reserved prefix")
     for k in e.properties.keys():
-        _require(not _is_reserved(k),
+        _require(not _is_reserved(k) or k in BUILTIN_PROPERTY_NAMES,
                  f"property {k!r} is not allowed; "
                  f"{RESERVED_PREFIX!r} is a reserved prefix")
 

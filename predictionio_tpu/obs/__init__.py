@@ -22,20 +22,37 @@ from .registry import (
     format_value,
     render_histogram_lines,
 )
-from .runtime import hbm_stats, register_runtime_metrics
+from .runtime import build_info, hbm_stats, register_runtime_metrics
+from .trace import (
+    DeviceProfiler,
+    FlightRecorder,
+    Trace,
+    Tracer,
+    activate_traces,
+    add_stage_spans,
+    mark_active_traces,
+)
 
 __all__ = [
     "DEFAULT_LATENCY_BOUNDS",
     "POW2_COUNT_BOUNDS",
+    "DeviceProfiler",
+    "FlightRecorder",
     "MetricsRegistry",
     "OverlapTracker",
     "StreamingHistogram",
+    "Trace",
+    "Tracer",
     "TransferGuardCounter",
+    "activate_traces",
+    "add_stage_spans",
+    "build_info",
     "escape_label_value",
     "exponential_bounds",
     "format_value",
     "hbm_stats",
     "linear_bounds",
+    "mark_active_traces",
     "mount_span_metrics",
     "register_runtime_metrics",
     "render_histogram_lines",

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -55,7 +56,7 @@ class StreamingHistogram:
     """
 
     __slots__ = ("bounds", "_counts", "_count", "_sum", "_min", "_max",
-                 "_lock")
+                 "_lock", "_exemplars")
 
     def __init__(self,
                  bounds: Optional[Sequence[float]] = None) -> None:
@@ -72,6 +73,11 @@ class StreamingHistogram:
         self._min = math.inf
         self._max = -math.inf
         self._lock = threading.Lock()
+        # OpenMetrics exemplars: lazily allocated {bucket index →
+        # (trace_id, value, unix ts)} — histograms that never see a
+        # retained trace pay one None slot, nothing more
+        self._exemplars: Optional[Dict[int, Tuple[str, float,
+                                                  float]]] = None
 
     def record(self, value: float) -> None:
         """O(1): one bisect over the fixed bounds + one increment."""
@@ -88,6 +94,27 @@ class StreamingHistogram:
 
     # Prometheus naming for drop-in familiarity
     observe = record
+
+    def record_exemplar(self, value: float, trace_id: str,
+                        ts: Optional[float] = None) -> None:
+        """Attach (or replace) the exemplar of the bucket ``value``
+        falls in: last retained trace id per bucket, so a ``/metrics``
+        p99 bucket links straight to a ``/trace.json?id=`` lookup
+        (OpenMetrics exposition only renders these under
+        ``Accept: application/openmetrics-text``)."""
+        v = float(value)
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            if self._exemplars is None:
+                self._exemplars = {}
+            self._exemplars[i] = (str(trace_id), v,
+                                  ts if ts is not None else time.time())
+
+    def exemplars(self) -> Dict[int, Tuple[str, float, float]]:
+        """``{bucket index → (trace_id, value, ts)}``; index
+        ``len(bounds)`` is the overflow (+Inf) bucket."""
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
     @property
     def count(self) -> int:
@@ -174,6 +201,7 @@ class StreamingHistogram:
             self._sum = 0.0
             self._min = math.inf
             self._max = -math.inf
+            self._exemplars = None
 
 
 def window_quantile(start: List[Tuple[float, int]],

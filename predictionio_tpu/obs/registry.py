@@ -69,14 +69,25 @@ def _label_str(items: LabelItems,
 
 
 def render_histogram_lines(name: str, items: LabelItems,
-                           hist: StreamingHistogram) -> List[str]:
+                           hist: StreamingHistogram,
+                           openmetrics: bool = False) -> List[str]:
     """One labeled histogram child → its ``_bucket``/``_sum``/``_count``
-    exposition lines (shared by the registry and the span collector)."""
+    exposition lines (shared by the registry and the span collector).
+    Under OpenMetrics, buckets carrying an exemplar (last retained
+    trace id per bucket) render it as ``# {trace_id="…"} value ts`` —
+    the grammar Prometheus scrapes exemplars from (exemplars are
+    OpenMetrics-only; the 0.0.4 text format has no syntax for them)."""
+    exemplars = hist.exemplars() if openmetrics else {}
     lines = []
-    for le, cum in hist.bucket_counts():
+    for i, (le, cum) in enumerate(hist.bucket_counts()):
         le_item = 'le="' + format_value(le) + '"'
-        lines.append(
-            f"{name}_bucket{_label_str(items, le_item)} {cum}")
+        line = f"{name}_bucket{_label_str(items, le_item)} {cum}"
+        ex = exemplars.get(i)
+        if ex is not None:
+            trace_id, value, ts = ex
+            line += (f' # {{trace_id="{escape_label_value(trace_id)}"}}'
+                     f" {format_value(value)} {ts:.3f}")
+        lines.append(line)
     lines.append(f"{name}_sum{_label_str(items)} "
                  f"{format_value(hist.sum)}")
     lines.append(f"{name}_count{_label_str(items)} {hist.count}")
@@ -183,13 +194,21 @@ class _Family:
         with self._lock:
             return list(self._children.items())
 
-    def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
-                 f"# TYPE {self.name} {self.kind}"]
+    def render(self, openmetrics: bool = False) -> List[str]:
+        # OpenMetrics names a counter family WITHOUT the _total suffix
+        # (samples keep it); the 0.0.4 format uses the suffixed name
+        # everywhere. Rendering both from one registry is why the
+        # family keeps the suffixed name internally.
+        meta_name = self.name
+        if openmetrics and self.kind == "counter" \
+                and meta_name.endswith("_total"):
+            meta_name = meta_name[:-len("_total")]
+        lines = [f"# HELP {meta_name} {_escape_help(self.help)}",
+                 f"# TYPE {meta_name} {self.kind}"]
         for items, child in sorted(self.children()):
             if self.kind == "histogram":
-                lines.extend(render_histogram_lines(self.name, items,
-                                                    child))
+                lines.extend(render_histogram_lines(
+                    self.name, items, child, openmetrics=openmetrics))
             else:
                 lines.append(f"{self.name}{_label_str(items)} "
                              f"{format_value(child.value)}")
@@ -252,18 +271,24 @@ class MetricsRegistry:
         with self._lock:
             self._collectors.append(fn)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Text exposition: Prometheus 0.0.4 by default; OpenMetrics
+        1.0 (exemplars on histogram buckets, ``# EOF`` terminator,
+        suffix-aware counter metadata) when ``openmetrics`` — the
+        format ``Accept: application/openmetrics-text`` negotiates."""
         with self._lock:
             families = list(self._families.values())
             collectors = list(self._collectors)
         lines: List[str] = []
         for fam in families:
-            lines.extend(fam.render())
+            lines.extend(fam.render(openmetrics=openmetrics))
         for fn in collectors:
             try:
                 lines.extend(fn())
             except Exception:  # noqa: BLE001 — one bad collector must
                 continue       # not take down the whole scrape
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, Any]:

@@ -55,11 +55,56 @@ def hbm_stats() -> List[Dict[str, object]]:
     return out
 
 
+def build_info(server: str, version: Optional[str] = None
+               ) -> Dict[str, object]:
+    """The ``pio_build_info`` label set: package + jax versions, the
+    live backend, process_count, and local/global device counts (the
+    mesh denominators every bench line and trace is attributed
+    against). Backend-dependent labels degrade to ``"none"`` rather
+    than initializing a backend (the :func:`hbm_stats` discipline)."""
+    import sys
+
+    if version is None:
+        try:
+            from .. import __version__ as version
+        except Exception:  # noqa: BLE001
+            version = "unknown"
+    info: Dict[str, object] = {"server": server, "version": version}
+    if "jax" not in sys.modules:
+        info.update(jax="none", backend="none", process_count=0,
+                    devices=0)
+        return info
+    try:
+        import jax
+
+        info["jax"] = getattr(jax, "__version__", "unknown")
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized") \
+                and not xla_bridge.backends_are_initialized():
+            info.update(backend="none", process_count=0, devices=0)
+            return info
+        info["backend"] = jax.default_backend()
+        info["process_count"] = int(jax.process_count())
+        info["devices"] = int(jax.device_count())
+    except Exception:  # noqa: BLE001 — build info must never fail a
+        info.setdefault("jax", "unknown")        # scrape
+        info.setdefault("backend", "none")
+        info.setdefault("process_count", 0)
+        info.setdefault("devices", 0)
+    return info
+
+
 def register_runtime_metrics(reg: MetricsRegistry, server: str,
                              version: Optional[str] = None) -> None:
     """Mount the standard process-level series on ``reg``:
 
-    - ``pio_build_info{server,version}`` — constant 1
+    - ``pio_build_info{server,version,jax,backend,process_count,
+      devices}`` — constant-1 info gauge rendered at scrape time so
+      bench lines and retained traces are attributable to the exact
+      build/runtime that produced them; the jax/backend/device labels
+      appear only once a backend is live (scraping NEVER initializes
+      one) and refresh on the next scrape after deploy brings it up
     - ``pio_process_start_time_seconds``
     - ``pio_xla_compiles_total`` — lifetime XLA backend compiles
       (:class:`..server.stats.RecompileSentinel` listener)
@@ -79,9 +124,22 @@ def register_runtime_metrics(reg: MetricsRegistry, server: str,
             from .. import __version__ as version
         except Exception:  # noqa: BLE001
             version = "unknown"
-    reg.gauge("pio_build_info",
-              "Constant 1, labeled with server name and version"
-              ).labels(server=server, version=str(version)).set(1)
+    from .registry import escape_label_value as _esc
+
+    def _build_info_lines() -> List[str]:
+        # render-time collector, not a statically-bound gauge: the
+        # jax/backend/mesh labels describe whatever is live AT SCRAPE
+        # TIME (a backend deploy brings up after mount still shows),
+        # and a jax-free server never pays the import
+        info = build_info(server, str(version))
+        labels = ",".join(f'{k}="{_esc(str(v))}"'
+                          for k, v in sorted(info.items()))
+        return ["# HELP pio_build_info Constant 1; identifies the "
+                "build and runtime being scraped",
+                "# TYPE pio_build_info gauge",
+                "pio_build_info{%s} 1" % labels]
+
+    reg.register_collector(_build_info_lines)
     reg.gauge("pio_process_start_time_seconds",
               "Unix time this server process started"
               ).set(reg.start_time)

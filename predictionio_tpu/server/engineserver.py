@@ -48,6 +48,11 @@ from ..obs import (
     OverlapTracker,
     hbm_stats,
 )
+from ..obs.trace import (
+    activate_traces,
+    add_stage_spans,
+    mark_active_traces,
+)
 from ..rollout.registry import ReleaseRegistry
 from ..rollout.splitter import ARM_CANDIDATE, ARM_STABLE
 from ..utils.jsonutil import from_jsonable, to_jsonable
@@ -249,6 +254,27 @@ class ServerConfig:
     #: (``ptpu deploy --faults``). None = nothing armed (the env var
     #: still works).
     faults: Optional[str] = None
+    #: End-to-end request tracing (ISSUE 12, docs/tracing.md): every
+    #: request is traced into the tail-sampled flight recorder — only
+    #: slow (adaptive p99) / errored / deadline-503'd / fault-injected
+    #: traces are retained, served as Perfetto JSON on
+    #: ``GET /trace.json``. On by default: the per-request cost is a
+    #: handful of allocations (measured ≤5% on the host fast path);
+    #: off for A/B benches of that overhead.
+    tracing: bool = True
+    #: retained traces the flight-recorder ring holds (oldest evicted)
+    trace_ring: int = 512
+    #: fixed slow-retention threshold in ms; 0 = adaptive (the live
+    #: p99 of traced request durations)
+    trace_slow_ms: float = 0.0
+    #: probabilistic sampling of the structured JSON access log: 1.0
+    #: logs every request (the historical behavior), 0.01 logs ~1% —
+    #: errors and 503s ALWAYS log regardless. High-qps serving should
+    #: not pay a json.dumps per healthy request (ISSUE 12 satellite).
+    access_log_sample: float = 1.0
+    #: artifact directory for on-demand ``POST /profile`` device
+    #: captures (None: $PTPU_PROFILE_DIR, else <tmp>/ptpu-profiles)
+    profile_dir: Optional[str] = None
     #: consecutive failed dispatches on one replicated lane before the
     #: lane is declared dead and its traffic redistributed across the
     #: surviving lanes (degraded mode — pio_serving_degraded)
@@ -424,15 +450,31 @@ class QueryServer:
             "1 while one or more replicated serving lanes are dead "
             "and their traffic is redistributed across survivors",
             fn=lambda: 1.0 if self._dead_lanes else 0.0)
+        # end-to-end tracing (ISSUE 12, docs/tracing.md): the server
+        # owns the tracer (like the registry) so direct query() callers
+        # trace the same way HTTP traffic does; build_app mounts it on
+        # the request path + /trace.json. The profiler backs
+        # POST /profile (bounded-window jax.profiler captures).
+        from ..obs.trace import DeviceProfiler, Tracer
+        self.tracer = (Tracer(ring=self.config.trace_ring,
+                              slow_ms=self.config.trace_slow_ms)
+                       if self.config.tracing else None)
+        self.profiler = DeviceProfiler(self.config.profile_dir)
         # fault-injection observability: injections delivered anywhere
-        # in this process, attributed by point and mode
+        # in this process, attributed by point and mode — and flagged
+        # onto whatever traces the injected thread was working on, so
+        # a fault-injected request is retained by the flight recorder
         self._fault_injections = self.metrics.counter(
             "pio_fault_injections_total",
             "Fault-registry injections delivered, by point and mode "
             "(drills only; 0 in production)")
-        fault_registry().add_listener(
-            lambda point, mode: self._fault_injections.labels(
-                point=point, mode=mode).inc())
+
+        def _on_fault(point: str, mode: str) -> None:
+            self._fault_injections.labels(point=point, mode=mode).inc()
+            mark_active_traces("fault", faultPoint=point,
+                               faultMode=mode)
+
+        fault_registry().add_listener(_on_fault)
         self.metrics.gauge(
             "pio_fault_enabled",
             "1 while any fault-injection spec is armed in this process",
@@ -1087,6 +1129,14 @@ class QueryServer:
         self._observe_release(arm, dt, error=False)
         if obs is not None:
             obs["cache"] = "hit"
+            tr = self._trace_of(obs)
+            if tr is not None:
+                # a hit never touches the device: one span tells the
+                # whole story, and the tier rides as an attribute
+                tr.set_attr("arm", arm)
+                tr.set_attr("cacheTier", "query")
+                tr.add_span("cache_hit", t0, t0 + dt, tier="query")
+                tr.exemplar(self._latency_hist.labels(), dt)
         with self._lock:
             self.last_serving_sec = dt
             self.avg_serving_sec = (
@@ -1207,6 +1257,8 @@ class QueryServer:
                 lane = None
                 models = self.models
             instance_id = self.instance.id
+        traces = [self._trace_of(o) for o in (obs_list or [])]
+        traces += [None] * (len(query_jsons) - len(traces))
         query_cls = algorithms[0].query_class
         parsed: List[Any] = []
         out: List[Any] = [None] * len(query_jsons)
@@ -1223,7 +1275,7 @@ class QueryServer:
             if lane is not None:
                 fire(F_LANE, lane=str(lane))
             fire(F_DISPATCH)
-            with self._transfer_guard():
+            with activate_traces(traces), self._transfer_guard():
                 served = predict_serve_batch(algorithms, models, serving,
                                              parsed, timings=phases)
             for j, i in enumerate(ok_rows):
@@ -1275,6 +1327,24 @@ class QueryServer:
             if is_err:
                 self._query_errors.labels(
                     status=str(result.status)).inc()
+            if traces[i] is not None:
+                # per-batch AND per-query spans (ISSUE 12): one
+                # "batch" parent carrying the shared attributes, the
+                # stage children laid sequentially from the batch
+                # start (this serial path really is sequential)
+                tr = traces[i]
+                tr.set_attr("engineInstanceId", instance_id)
+                tr.set_attr("arm", ARM_STABLE)
+                if lane is not None:
+                    tr.set_attr("lane", lane)
+                parent = tr.add_span(
+                    "batch", t0, t0 + dt,
+                    batchSize=len(query_jsons),
+                    **({"lane": lane} if lane is not None else {}))
+                add_stage_spans(tr, t0, phases,
+                                parent_id=parent.span_id,
+                                skip=("queue_wait",))
+                tr.exemplar(self._latency_hist.labels(), dt)
             if obs_list is not None and i < len(obs_list) \
                     and obs_list[i] is not None:
                 obs_list[i].update(batch_obs)
@@ -1337,6 +1407,7 @@ class QueryServer:
             self._lane_latency.labels(lane=str(ab.lane)).observe(
                 now - ab.t_dispatched)
             self._lane_dispatches.labels(lane=str(ab.lane)).inc()
+        self._trace_pipeline_batch(ab, now)
         batch_obs = {"batchSize": len(ab.entries), "pipeline": "staged"}
         if ab.lane is not None:
             batch_obs["lane"] = ab.lane
@@ -1370,6 +1441,53 @@ class QueryServer:
                                          + total_dt) / (n + n_q))
                 self.request_count += n_q
 
+    def _trace_pipeline_batch(self, ab: "_AssembledBatch",
+                              now: float) -> None:
+        """Reconstruct the staged-pipeline timeline onto every traced
+        query of the batch (ISSUE 12): a ``batch`` parent span plus
+        stage children — ``queue_wait`` from each entry's own enqueue
+        time, host stages (assemble/supplement) from the pickup, and
+        device stages (dispatch/device_wait/serve/readback/feedback)
+        anchored at the REAL dispatch time, so the inter-stage queue
+        hops show up as gaps on the Perfetto timeline instead of being
+        smeared into the stages."""
+        if self.tracer is None:
+            return
+        phases = ab.phases
+        host = {k: phases[k] for k in ("assemble", "supplement")
+                if k in phases}
+        device = {k: phases[k]
+                  for k in ("dispatch", "device_wait", "serve",
+                            "readback", "feedback") if k in phases}
+        for entry in ab.entries:
+            tr = self._trace_of(entry.obs)
+            if tr is None:
+                continue
+            tr.set_attr("engineInstanceId", ab.instance_id)
+            tr.set_attr("arm", ARM_STABLE)
+            tr.set_attr("pipeline", "staged")
+            if ab.lane is not None:
+                tr.set_attr("lane", ab.lane)
+            wait = ((entry.obs or {}).get("queueWaitMs", 0.0)) / 1000.0
+            t_pick = entry.t_enq + wait
+            parent = tr.add_span(
+                "batch", t_pick, now, batchSize=len(ab.entries),
+                **({"lane": ab.lane} if ab.lane is not None else {}))
+            if wait > 0:
+                tr.add_span("queue_wait", entry.t_enq, t_pick,
+                            parent_id=parent.span_id)
+            add_stage_spans(tr, t_pick, host,
+                            order=("assemble", "supplement"),
+                            parent_id=parent.span_id)
+            add_stage_spans(
+                tr, ab.t_dispatched if ab.t_dispatched is not None
+                else t_pick, device,
+                order=("dispatch", "device_wait", "serve", "readback",
+                       "feedback"),
+                parent_id=parent.span_id)
+            tr.exemplar(self._latency_hist.labels(),
+                        now - entry.t_enq)
+
     def pipeline_status(self) -> dict:
         """Serving batch-path state for ``/status.json`` and the status
         page (ISSUE 9): architecture, deadline accounting, and the
@@ -1402,14 +1520,25 @@ class QueryServer:
             }
         return out
 
+    def _trace_of(self, obs: Optional[dict]):
+        """The live request trace riding the obs dict (None when the
+        caller is untraced or tracing is off)."""
+        if obs is None or self.tracer is None:
+            return None
+        return obs.get("_trace")
+
     # -- the per-query hot path (CreateServer.scala:484-633) ---------------
     def query(self, query_json: Any, obs: Optional[dict] = None) -> Any:
         t0 = time.monotonic()
         phases: dict = {}
+        trace = self._trace_of(obs)
         with self._lock:
             algorithms, models, serving = \
                 self.algorithms, self.models, self.serving
             instance_id = self.instance.id
+        if trace is not None:
+            trace.set_attr("engineInstanceId", instance_id)
+            trace.set_attr("arm", ARM_STABLE)
         query_cls = algorithms[0].query_class
         try:
             query = from_jsonable(query_cls, query_json)
@@ -1419,7 +1548,7 @@ class QueryServer:
         t1 = time.monotonic()
         phases["assemble"] = t1 - t0
         try:
-            with self._transfer_guard():
+            with activate_traces([trace]), self._transfer_guard():
                 supplemented = serving.supplement(query)
                 t2 = time.monotonic()
                 phases["supplement"] = t2 - t1
@@ -1446,12 +1575,19 @@ class QueryServer:
             self._observe_release(ARM_STABLE, time.monotonic() - t0,
                                   error=True)
             self._record_phases(phases)
+            add_stage_spans(trace, t0, phases)
             raise
 
         dt = time.monotonic() - t0
         self._record_phases(phases)
         self._latency_hist.observe(dt)
         self._observe_release(ARM_STABLE, dt, error=False)
+        if trace is not None:
+            # per-query child spans (ISSUE 12): the phases run
+            # back-to-back on this thread, so the sequential layout
+            # from t0 IS the real timeline
+            add_stage_spans(trace, t0, phases)
+            trace.exemplar(self._latency_hist.labels(), dt)
         if obs is not None:
             obs.update({f"{k}Ms": round(v * 1000, 3)
                         for k, v in phases.items()})
@@ -1664,6 +1800,11 @@ class QueryServer:
         self._observe_release(ARM_CANDIDATE, dt, error=False)
         if obs is not None:
             obs["releaseArm"] = ARM_CANDIDATE
+            tr = self._trace_of(obs)
+            if tr is not None:
+                tr.set_attr("arm", ARM_CANDIDATE)
+                tr.set_attr("engineInstanceId", cand.instance.id)
+                tr.add_span("candidate_serve", t0, t0 + dt)
         return result
 
     def mirror_to_candidate(self, query_json: Any) -> None:
@@ -2021,6 +2162,21 @@ def build_app(server: QueryServer) -> HTTPApp:
         return ("<li>" + html.escape(" · ".join(parts))
                 + " (<a href='/stream.json'>stream.json</a>)</li>")
 
+    def _trace_line() -> str:
+        """One status-page line on the flight recorder: retained
+        count/ring, live slow threshold, profiler state."""
+        if server.tracer is None:
+            return ""
+        t = server.tracer.status()
+        parts = [f"flight recorder: {t['retained']}/"
+                 f"{t['ringCapacity']} retained"]
+        if t.get("slowThresholdMs") is not None:
+            parts.append(f"slow ≥ {t['slowThresholdMs']:.1f}ms")
+        if server.profiler.active:
+            parts.append("device profile capturing")
+        return ("<li>" + html.escape(" · ".join(parts))
+                + " (<a href='/trace.json'>trace.json</a>)</li>")
+
     def _cache_line() -> str:
         if server.cache is None:
             return ""
@@ -2127,7 +2283,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
-{_pipeline_line()}{_stream_line()}{_cache_line()}
+{_pipeline_line()}{_stream_line()}{_cache_line()}{_trace_line()}
 </ul>{_mesh_panel()}{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
@@ -2151,6 +2307,9 @@ def build_app(server: QueryServer) -> HTTPApp:
             "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
             "pipeline": server.pipeline_status(),
+            "trace": (server.tracer.status()
+                      if server.tracer is not None
+                      else {"enabled": False}),
             "lineage": server.stream_lineage(),
             "stream": (server.stream.status()
                        if server.stream is not None
@@ -2423,9 +2582,48 @@ def build_app(server: QueryServer) -> HTTPApp:
             req.path_params["rest"])
         return json_response(plugin.handle_rest(args))
 
+    # -- on-demand device profiling (ISSUE 12, docs/tracing.md) -------------
+    @app.route("POST", "/profile")
+    def profile_start(req: Request) -> Response:
+        """Capture a ``jax.profiler`` device trace for a bounded window
+        into the served artifact dir: ``{"durationMs": 1000}``.
+        Key-guarded like every control route — profiles expose
+        internals and cost real overhead while running."""
+        _auth(req)
+        try:
+            body = req.json() or {}
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        try:
+            info = server.profiler.start(
+                float(body.get("durationMs", 1000.0)))
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        except RuntimeError as e:
+            raise HTTPError(409, str(e))
+        return json_response({
+            "message": "Profiling.", **info,
+            "hint": "poll GET /profile.json; load the artifact dir "
+                    "with TensorBoard's profile plugin or "
+                    "ui.perfetto.dev"}, 202)
+
+    @app.route("GET", "/profile.json")
+    def profile_json(req: Request) -> Response:
+        """Capture status + served artifacts + the per-executable
+        compile-time table (what ``pio_compiles_since_warm`` counts,
+        itemized)."""
+        return json_response({
+            **server.profiler.status(),
+            "compileTable": server.recompile_sentinel.compile_table(),
+        })
+
     # /metrics + request instrumentation through the server's own
-    # registry (the engine server keeps its bespoke /status.json above)
-    mount_metrics(app, server.metrics, server_name="engineserver")
+    # registry (the engine server keeps its bespoke /status.json above);
+    # the tracer mount adds traceparent propagation + GET /trace.json
+    mount_metrics(app, server.metrics, server_name="engineserver",
+                  tracer=(server.tracer if server.tracer is not None
+                          else False))
+    app.access_log_sample = cfg.access_log_sample
 
     app_server_ref: List[AppServer] = []
     app._server_ref = app_server_ref  # type: ignore[attr-defined]
@@ -2587,6 +2785,9 @@ class MicroBatcher:
                 phase.observe(wait)
                 if e.obs is not None:
                     e.obs["queueWaitMs"] = round(wait * 1000, 3)
+                    tr = self.server._trace_of(e.obs)
+                    if tr is not None:
+                        tr.add_span("queue_wait", e.t_enq, t_pick)
                 obs_list.append(e.obs)
             # lane supervision (ISSUE 11): redistribute a dead lane's
             # traffic at pickup and fail a dispatch over to surviving
@@ -2869,18 +3070,23 @@ class StagedPipeline:
                 models = ab.models
             t0 = time.monotonic()
             in_flight_before = server.overlap.enter("device")
+            # fault attribution (ISSUE 12): an injection delivered on
+            # this dispatch thread flags exactly this batch's traces
+            batch_traces = [server._trace_of(e.obs)
+                            for e in ab.entries]
             for n_try, eff in enumerate(attempts):
                 if eff is not None:
                     ab.lane = eff
                     models = ab.lane_models[eff]
                 try:
-                    if eff is not None:
-                        fire(F_LANE, lane=str(eff))
-                    fire(F_DISPATCH)
-                    with server._transfer_guard():
-                        resolvers = dispatch_batch(
-                            ab.algorithms, models, ab.supplemented,
-                            timings=ab.phases) if ab.live else []
+                    with activate_traces(batch_traces):
+                        if eff is not None:
+                            fire(F_LANE, lane=str(eff))
+                        fire(F_DISPATCH)
+                        with server._transfer_guard():
+                            resolvers = dispatch_batch(
+                                ab.algorithms, models, ab.supplemented,
+                                timings=ab.phases) if ab.live else []
                     ab.pending = PendingBatch(ab.queries, ab.serving,
                                               ab.out, ab.live, resolvers)
                     if eff is not None:

@@ -143,6 +143,27 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
     def _auth(req: Request) -> AuthData:
         return authenticate(st, req)
 
+    def _stamp_trace(req: Request, event: Event) -> Event:
+        """Stamp the ingest request's W3C trace context into the
+        accepted event (``pio_traceparent`` builtin property,
+        ISSUE 12): the streaming trainer adopts it at fold-in so the
+        event's trace, the fold-in pass, and the hot-swap that made it
+        servable are ONE trace — ``/trace.json?id=`` then shows
+        ingest → canary → swap end to end.
+
+        Only when the CALLER sent a ``traceparent`` (W3C semantics: a
+        request joins a trace, a server never imposes one) — an
+        untraced client's events read back byte-identical to what it
+        posted."""
+        if req.trace is None or req.trace.parent_span_id is None \
+                or "pio_traceparent" in event.properties:
+            return event  # untraced caller / a relaying stamp wins
+        from ..data.datamap import DataMap
+
+        return event.copy(properties=DataMap(
+            {**event.properties, "pio_traceparent":
+             req.trace.traceparent()}))
+
     @app.route("GET", "/")
     def index(req: Request) -> Response:
         return json_response({"status": "alive"})
@@ -178,6 +199,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
         if not _allowed(auth, event.event):
             return json_response(
                 {"message": f"{event.event} events are not allowed"}, 403)
+        event = _stamp_trace(req, event)
         plug.process_input(auth.app_id, auth.channel_id, event)
         event_id = st.events().insert(event, auth.app_id, auth.channel_id)
         ingested.labels(route="events").inc()
@@ -225,7 +247,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
         valid: list = []  # (position in results, event)
         for obj in payload:
             try:
-                event = Event.from_json(obj)
+                event = _stamp_trace(req, Event.from_json(obj))
             except (EventValidationError, TypeError, KeyError, ValueError) as e:
                 results.append({"status": 400, "message": str(e)})
                 continue
@@ -323,7 +345,7 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
                             "supported."}, 404)
         try:
             data = req.form() if is_form else req.json()
-            event = to_event(connector, data)
+            event = _stamp_trace(req, to_event(connector, data))
         except (ConnectorException, EventValidationError, ValueError) as e:
             raise HTTPError(400, str(e))
         event_id = st.events().insert(event, auth.app_id, auth.channel_id)

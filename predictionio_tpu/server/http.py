@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import re
 import secrets
 import threading
@@ -26,7 +27,7 @@ from ..data.storage.base import StorageError
 from ..faults import FaultError
 
 __all__ = ["Request", "Response", "HTTPApp", "AppServer", "json_response",
-           "mount_metrics"]
+           "mount_metrics", "mount_trace_routes"]
 
 #: Retry-After seconds on a 503 caused by an unavailable backing store
 #: (docs/reliability.md): short enough that a recovered store is back
@@ -54,8 +55,29 @@ class Request:
     #: slow query can be decomposed post-hoc.
     request_id: str = ""
     #: Handler-attached observability payload (per-phase timings etc.);
-    #: merged into this request's access-log line.
+    #: merged into this request's access-log line. Keys starting with
+    #: ``_`` are carriers for in-process objects (the live trace) and
+    #: never serialize into the log line.
     obs: Dict[str, Any] = field(default_factory=dict)
+    #: The live :class:`~predictionio_tpu.obs.trace.Trace` when the app
+    #: has a tracer mounted (every request does, cheaply; retention is
+    #: the sampled part — docs/tracing.md). Also threaded through
+    #: ``obs["_trace"]`` so batcher/pipeline code that only sees the
+    #: obs dict can attach stage spans.
+    trace: Any = None
+
+    def header(self, name: str, default: Optional[str] = None
+               ) -> Optional[str]:
+        """Case-insensitive header lookup (clients send
+        ``traceparent``, ``Traceparent``, ``TraceParent``…)."""
+        v = self.headers.get(name)
+        if v is not None:
+            return v
+        lower = name.lower()
+        for k, val in self.headers.items():
+            if k.lower() == lower:
+                return val
+        return default
 
     def json(self) -> Any:
         if not self.body:
@@ -220,6 +242,12 @@ class HTTPApp:
         self.metrics = None  # set by mount_metrics
         self._http_hist = None
         self._http_count = None
+        self.tracer = None  # set by mount_metrics (obs.trace.Tracer)
+        #: probabilistic sampling of the structured access log
+        #: (ISSUE 12 satellite): at high qps the per-request
+        #: ``json.dumps`` is real money — sample the successes, but
+        #: errors and 503s ALWAYS log (they are why the log exists)
+        self.access_log_sample = 1.0
 
     def route(self, method: str, pattern: str) -> Callable[[Handler], Handler]:
         compiled = re.compile(f"^{pattern}$")
@@ -277,22 +305,60 @@ class HTTPApp:
     def handle(self, req: Request) -> Response:
         req.request_id = (req.headers.get("X-Request-ID")
                           or secrets.token_hex(8))
+        tracer = self.tracer
+        if tracer is not None:
+            # W3C context propagation (ISSUE 12): continue the caller's
+            # trace when a valid ``traceparent`` rides in, else mint a
+            # fresh one — tied to X-Request-ID either way
+            req.trace = tracer.begin(
+                f"{req.method} {req.path}",
+                traceparent=req.header("traceparent"),
+                request_id=req.request_id, server=self.name)
+            req.obs["_trace"] = req.trace
         t0 = time.monotonic()
         resp, route = self._dispatch(req)
         dt = time.monotonic() - t0
         resp.headers.setdefault("X-Request-ID", req.request_id)
         if self.metrics is not None:
-            self._http_hist.labels(route=route).observe(dt)
+            hist = self._http_hist.labels(route=route)
+            hist.observe(dt)
             self._http_count.labels(route=route, method=req.method,
                                     status=str(resp.status)).inc()
-        if access_log.isEnabledFor(logging.INFO):
+            if req.trace is not None:
+                req.trace.exemplar(hist, dt)
+        if req.trace is not None:
+            req.trace.set_attr("route", route)
+            resp.headers.setdefault("traceparent",
+                                    req.trace.traceparent())
+            retained, reason = tracer.finish(req.trace,
+                                             status=resp.status,
+                                             duration=dt)
+            if retained:
+                resp.headers.setdefault("X-Trace-Retained", reason)
+        if access_log.isEnabledFor(logging.INFO) \
+                and self._log_this(resp.status):
             line = {"server": self.name, "requestId": req.request_id,
                     "method": req.method, "path": req.path,
                     "status": resp.status,
                     "durationMs": round(dt * 1000, 3)}
-            line.update(req.obs)
+            if req.trace is not None:
+                line["traceId"] = req.trace.trace_id
+            line.update((k, v) for k, v in req.obs.items()
+                        if not k.startswith("_"))
             access_log.info(json.dumps(line))
         return resp
+
+    def _log_this(self, status: int) -> bool:
+        """Access-log admission: errors/503s always; successes at the
+        configured sample rate (``ServerConfig.access_log_sample``)."""
+        if status >= 400:
+            return True
+        sample = self.access_log_sample
+        if sample >= 1.0:
+            return True
+        if sample <= 0.0:
+            return False
+        return random.random() < sample
 
 
 class HTTPError(Exception):
@@ -304,9 +370,15 @@ class HTTPError(Exception):
         self.message = message
 
 
+#: content type of the OpenMetrics exposition (the format that can
+#: carry exemplars); negotiated via the Accept header on /metrics
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
 def mount_metrics(app: HTTPApp, registry, server_name: Optional[str] = None,
                   status: Optional[Callable[[], Dict[str, Any]]] = None,
-                  runtime: bool = True) -> None:
+                  runtime: bool = True, tracer=None) -> None:
     """The shared telemetry mount every server goes through:
 
     - instruments the app's request path (latency histogram, status
@@ -314,21 +386,40 @@ def mount_metrics(app: HTTPApp, registry, server_name: Optional[str] = None,
     - registers the standard runtime series (build info, XLA compiles,
       transfer-guard violations, per-device HBM) and the global
       ``timed(name)`` span registry
-    - adds ``GET /metrics`` — Prometheus text format 0.0.4
+    - adds ``GET /metrics`` — Prometheus text format 0.0.4, or
+      OpenMetrics 1.0 (with bucket exemplars) when the scraper sends
+      ``Accept: application/openmetrics-text``
     - when ``status`` is given, adds ``GET /status.json`` returning its
       dict enriched with the registry snapshot (servers with a bespoke
       status route — the engine server — pass ``status=None`` and
       enrich their own)
+    - mounts a request :class:`~predictionio_tpu.obs.trace.Tracer` +
+      ``GET /trace.json`` (the flight-recorder read side,
+      docs/tracing.md). ``tracer=None`` builds a default one;
+      ``tracer=False`` disables tracing for this app.
     """
-    from ..obs import mount_span_metrics, register_runtime_metrics
+    from ..obs import Tracer, mount_span_metrics, register_runtime_metrics
 
     if runtime:
         register_runtime_metrics(registry, server_name or app.name)
         mount_span_metrics(registry)
     app.enable_metrics(registry)
+    if tracer is None:
+        tracer = Tracer()
+    if tracer is not False:
+        app.tracer = tracer
+        tracer.register_metrics(registry)
+        mount_trace_routes(app, tracer)
 
     @app.route("GET", "/metrics")
     def metrics(req: Request) -> Response:
+        # content negotiation (ISSUE 12 satellite): OpenMetrics is
+        # required for exemplar rendering; everything else gets the
+        # 0.0.4 text format it always got
+        accept = req.header("Accept") or ""
+        if "application/openmetrics-text" in accept:
+            return Response(body=registry.render(openmetrics=True),
+                            content_type=OPENMETRICS_CONTENT_TYPE)
         return Response(
             body=registry.render(),
             content_type="text/plain; version=0.0.4; charset=utf-8")
@@ -338,6 +429,38 @@ def mount_metrics(app: HTTPApp, registry, server_name: Optional[str] = None,
         def status_json(req: Request) -> Response:
             return json_response(dict(status(),
                                       metrics=registry.snapshot()))
+
+
+def mount_trace_routes(app: HTTPApp, tracer) -> None:
+    """``GET /trace.json`` — the flight recorder's read side:
+
+    - ``?id=<trace id>`` → that retained trace as Chrome/Perfetto
+      trace-event JSON (load it at ui.perfetto.dev)
+    - ``?slowest=N`` → summaries of the N slowest retained traces
+    - no params → recorder status (counts by reason, ring occupancy,
+      live slow threshold, recent retentions)
+    """
+
+    @app.route("GET", "/trace.json")
+    def trace_json(req: Request) -> Response:
+        trace_id = req.query.get("id")
+        if trace_id:
+            trace = tracer.recorder.get(trace_id)
+            if trace is None:
+                raise HTTPError(
+                    404, f"trace {trace_id!r} is not retained (it was "
+                         f"fast and healthy, or has aged out of the "
+                         f"ring)")
+            return json_response(trace.to_trace_events())
+        if "slowest" in req.query:
+            try:
+                n = int(req.query["slowest"])
+            except ValueError:
+                raise HTTPError(400, "slowest must be an integer")
+            return json_response({
+                "traces": [t.summary()
+                           for t in tracer.recorder.slowest(n)]})
+        return json_response(tracer.status())
 
 
 class _Handler(BaseHTTPRequestHandler):

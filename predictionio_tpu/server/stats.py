@@ -113,12 +113,38 @@ class RecompileSentinel:
     _total = 0
     _installed = False
     _available = False
+    #: per-event compile/trace-time table (ISSUE 12): every
+    #: ``jax.monitoring`` duration event keyed by its (bounded) event
+    #: name — count/total/max/last seconds. ``compile_table()`` serves
+    #: it on ``/profile.json`` so a ``pio_compiles_since_warm`` blip
+    #: can be itemized (which stage paid, how long) without a profiler
+    #: attach.
+    _durations: Dict[str, Dict[str, float]] = {}
+    MAX_TABLE_EVENTS = 64
 
     @classmethod
     def _listener(cls, name: str, *args, **kwargs) -> None:
-        if name == "/jax/core/compile/backend_compile_duration":
-            with cls._lock:
+        seconds = 0.0
+        if args:
+            try:
+                seconds = float(args[0])
+            except (TypeError, ValueError):
+                seconds = 0.0
+        with cls._lock:
+            if name == "/jax/core/compile/backend_compile_duration":
                 cls._total += 1
+            row = cls._durations.get(name)
+            if row is None:
+                if len(cls._durations) >= cls.MAX_TABLE_EVENTS:
+                    return  # bounded: never grow without limit
+                row = cls._durations[name] = {
+                    "count": 0, "total_sec": 0.0, "max_sec": 0.0,
+                    "last_sec": 0.0}
+            row["count"] += 1
+            row["total_sec"] += seconds
+            row["last_sec"] = seconds
+            if seconds > row["max_sec"]:
+                row["max_sec"] = seconds
 
     @classmethod
     def _install(cls) -> None:
@@ -156,6 +182,21 @@ class RecompileSentinel:
         if self._baseline is None:
             return 0
         return self.total_compiles() - self._baseline
+
+    @classmethod
+    def compile_table(cls) -> dict:
+        """Per-event duration rows (rounded, JSON-ready), most total
+        time first — the itemization behind ``pio_compiles_since_warm``
+        and the ``/profile.json`` compile-time table."""
+        with cls._lock:
+            rows = {k: dict(v) for k, v in cls._durations.items()}
+        return {
+            name: {"count": int(r["count"]),
+                   "totalSec": round(r["total_sec"], 4),
+                   "maxSec": round(r["max_sec"], 4),
+                   "lastSec": round(r["last_sec"], 4)}
+            for name, r in sorted(rows.items(),
+                                  key=lambda kv: -kv[1]["total_sec"])}
 
     def snapshot(self) -> dict:
         return {

@@ -253,12 +253,57 @@ class StreamTrainer:
         retry_call(self.cursor.save, policy=_STORAGE_RETRY,
                    retry_on=_STORAGE_ERRORS)
 
+    def _begin_pass_trace(self, events):
+        """Open the fold-in pass's trace (ISSUE 12, docs/tracing.md),
+        ADOPTING the trace id the event server stamped into the first
+        traced event (``pio_traceparent``) — the ingest request, this
+        fold-in, and the hot-swap that serves it become ONE trace, so
+        ``/trace.json?id=<ingest trace id>`` shows event→servable end
+        to end. Other events' trace ids ride along as a ``links``
+        attribute."""
+        tracer = getattr(self.server, "tracer", None)
+        if tracer is None:
+            return None
+        parents = []
+        for e in events:
+            tp = e.properties.get("pio_traceparent", default=None)
+            if tp:
+                parents.append(str(tp))
+        trace = tracer.begin(
+            "stream.foldin", traceparent=parents[0] if parents else None,
+            consumer=self.config.consumer, events=len(events))
+        if len(parents) > 1:
+            from ..obs.trace import parse_traceparent
+
+            links = []
+            for tp in parents[1:]:
+                parsed = parse_traceparent(tp)
+                if parsed and parsed[0] != trace.trace_id:
+                    links.append(parsed[0])
+            if links:
+                trace.set_attr("links", sorted(set(links))[:32])
+        return trace
+
+    def _finish_pass_trace(self, trace, outcome: str, **attrs) -> None:
+        tracer = getattr(self.server, "tracer", None)
+        if trace is None or tracer is None:
+            return
+        trace.set_attr("outcome", outcome)
+        for k, v in attrs.items():
+            trace.set_attr(k, v)
+        # applied/rejected passes are ALWAYS retained ("stream"): they
+        # are rare, and each is the serving-side half of some ingest
+        # trace; irrelevant-event passes go through the normal policy
+        force = "stream" if outcome in ("applied", "rejected") else None
+        tracer.finish(trace, force_reason=force)
+
     # -- one pass ------------------------------------------------------------
     def consume_once(self) -> int:
         """One consume→fold→canary→apply→advance pass; returns how
         many events were consumed (0 = nothing pending or the apply
         lost a rebind race and will retry)."""
         fire(F_PASS, consumer=self.config.consumer)
+        t_consume0 = time.monotonic()
         events = retry_call(
             self.cursor.pending, event_names=list(self.weights),
             entity_type="user", limit=self.config.max_events,
@@ -267,8 +312,13 @@ class StreamTrainer:
         if not events:
             return 0
         t0 = time.monotonic()
+        trace = self._begin_pass_trace(events)
+        if trace is not None:
+            trace.add_span("consume", t_consume0, t0,
+                           events=len(events))
         snap = self.server.stream_snapshot(self.config.algo_index)
         if snap is None:
+            self._finish_pass_trace(trace, "no-foldable-model")
             return 0  # no foldable model bound (non-ALS algorithm)
         base_instance, model = snap
         if base_instance != self._base_seen:
@@ -278,10 +328,17 @@ class StreamTrainer:
             self._G = None
             self._retrain_fired = False
             self.drift.reset()
+        t_fold0 = time.monotonic()
         new_model, report = fold_in_events(
             model, events, self.server.ctx.storage, self.app_id,
             channel_id=self.channel_id, weights=self.weights,
             max_history=self.config.max_history, G=self._G)
+        if trace is not None:
+            trace.set_attr("baseInstanceId", base_instance)
+            trace.add_span("fold_in", t_fold0, time.monotonic(),
+                           usersUpdated=report.users_updated,
+                           usersInserted=report.users_inserted,
+                           itemsInserted=report.items_inserted)
         if model.params.implicit_prefs and report.items_inserted == 0 \
                 and self._G is None:
             from ..models.als import fixed_gramian
@@ -299,8 +356,16 @@ class StreamTrainer:
             # nothing projectable (e.g. unrelated event names that
             # slipped the filter): just move the cursor past them
             self._advance_durable(events)
+            self._finish_pass_trace(trace, "no-relevant-events")
             return len(events)
+        t_canary0 = time.monotonic()
         verdict = self._canary_check(model, new_model, touched)
+        if trace is not None:
+            trace.add_span("canary", t_canary0, time.monotonic(),
+                           probes=min(len(touched),
+                                      self.config.canary_probes),
+                           action=(verdict.action if verdict is not None
+                                   else "skipped"))
         if verdict is not None and verdict.action == "rollback":
             # refuse the delta, move on (retrying the same solve
             # yields the same rows), and escalate to the drift lane —
@@ -312,18 +377,29 @@ class StreamTrainer:
                                  verdict.reason)
             self._advance_durable(events)
             self._maybe_retrain()
+            self._finish_pass_trace(trace, "rejected",
+                                    reason=verdict.reason)
             return len(events)
+        t_swap0 = time.monotonic()
         applied = self.server.apply_stream_delta(
             self.config.algo_index, new_model, touched,
             base_instance_id=base_instance,
             rows_updated=report.users_updated,
             rows_inserted=report.users_inserted + report.items_inserted)
+        if trace is not None:
+            trace.add_span("hot_swap", t_swap0, time.monotonic(),
+                           applied=applied,
+                           touchedEntities=len(touched))
         if not applied:
             # the binding moved under us (reload/promote): nothing
             # consumed — the next pass re-folds against the new base
             self._wake.set()
+            self._finish_pass_trace(trace, "rebind-race")
             return 0
+        t_adv0 = time.monotonic()
         self._advance_durable(events)
+        if trace is not None:
+            trace.add_span("advance", t_adv0, time.monotonic())
         dt = time.monotonic() - t0
         now_ms = time.time() * 1000.0
         for e in events:
@@ -351,6 +427,9 @@ class StreamTrainer:
             "residual": report.residual,
             "foldinMs": round(dt * 1000, 3),
         }
+        self._finish_pass_trace(trace, "applied",
+                                foldinMs=round(dt * 1000, 3),
+                                generation=self.applies)
         self._maybe_retrain()
         return len(events)
 

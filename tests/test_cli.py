@@ -454,6 +454,11 @@ def test_deploy_batching_defaults_match_config():
     assert args.assemble_workers == cfg.assemble_workers
     assert args.readback_workers == cfg.readback_workers
     assert args.pipeline_depth == cfg.pipeline_depth
+    # tracing knobs (ISSUE 12) stay in sync the same way
+    assert (not args.no_trace) == cfg.tracing
+    assert args.trace_ring == cfg.trace_ring
+    assert args.trace_slow_ms == cfg.trace_slow_ms
+    assert args.access_log_sample == cfg.access_log_sample
     import inspect
 
     sig = inspect.signature(MicroBatcher.__init__)

@@ -1,0 +1,727 @@
+"""End-to-end request tracing + tail-sampled flight recorder (ISSUE 12).
+
+Bottom-up: the W3C traceparent codec, the retention policy (slow /
+error / deadline / fault kept, fast dropped, ring bounded under 100k
+requests), the Perfetto export shape, OpenMetrics exemplar grammar and
+content negotiation — then live-HTTP coverage: a traced /queries.json
+query retained with its full stage timeline (dispatch/readback
+included), and the headline propagation contract: an event ingested
+with traceparent T is stamped, the streaming fold-in pass ADOPTS T,
+and the hot-swap that made it servable appears under the SAME trace id
+on /trace.json.
+"""
+
+import json
+import logging
+import re
+import threading
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import Context
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.obs import MetricsRegistry, StreamingHistogram
+from predictionio_tpu.obs.trace import (
+    FlightRecorder,
+    Tracer,
+    activate_traces,
+    add_stage_spans,
+    format_traceparent,
+    mark_active_traces,
+    parse_traceparent,
+)
+from predictionio_tpu.server.http import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    json_response,
+    mount_metrics,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+RANK = 8
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+# ---------------------------------------------------------------------------
+# W3C traceparent codec
+# ---------------------------------------------------------------------------
+class TestTraceparent:
+    def test_round_trip(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        parsed = parse_traceparent(format_traceparent(tid, sid))
+        assert parsed == (tid, sid)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage",
+        "00-" + "ab" * 16 + "-" + "cd" * 8,          # missing flags
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden version
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # zero span id
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+    ])
+    def test_invalid_ignored(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_begin_adopts_and_mints(self):
+        tracer = Tracer()
+        t = tracer.begin("q", traceparent=TP)
+        assert t.trace_id == "ab" * 16
+        assert t.parent_span_id == "cd" * 8
+        fresh = tracer.begin("q", traceparent="nonsense")
+        assert re.fullmatch(r"[0-9a-f]{32}", fresh.trace_id)
+        assert fresh.trace_id != t.trace_id
+        assert re.fullmatch(
+            r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", t.traceparent())
+
+
+# ---------------------------------------------------------------------------
+# retention policy
+# ---------------------------------------------------------------------------
+class TestRetention:
+    def test_error_deadline_fault_kept_fast_dropped(self):
+        tracer = Tracer()
+        ok, _ = tracer.finish(tracer.begin("q"), status=200,
+                              duration=0.001)
+        assert not ok  # fast + healthy: dropped
+        ok, reason = tracer.finish(tracer.begin("q"), status=500,
+                                   duration=0.001)
+        assert ok and reason == "error"
+        ok, reason = tracer.finish(tracer.begin("q"), status=503,
+                                   duration=0.001)
+        assert ok and reason == "deadline"
+        faulted = tracer.begin("q")
+        faulted.mark("fault")
+        ok, reason = tracer.finish(faulted, status=200, duration=0.001)
+        assert ok and reason == "fault"
+
+    def test_adaptive_slow_threshold_off_live_p99(self):
+        tracer = Tracer(min_samples=100)
+        assert tracer.slow_threshold() is None  # nothing learned yet
+        for _ in range(200):
+            tracer.finish(tracer.begin("q"), status=200,
+                          duration=0.002)
+        thr = tracer.slow_threshold()
+        assert thr is not None and 0.001 < thr < 0.02
+        ok, reason = tracer.finish(tracer.begin("q"), status=200,
+                                   duration=0.5)
+        assert ok and reason == "slow"
+        # and a typical-latency request still drops
+        ok, _ = tracer.finish(tracer.begin("q"), status=200,
+                              duration=0.002)
+        assert not ok
+
+    def test_fixed_threshold_overrides_adaptive(self):
+        tracer = Tracer(slow_ms=10.0)
+        ok, reason = tracer.finish(tracer.begin("q"), status=200,
+                                   duration=0.05)
+        assert ok and reason == "slow"
+        ok, _ = tracer.finish(tracer.begin("q"), status=200,
+                              duration=0.005)
+        assert not ok
+
+    def test_ring_bounded_under_100k_requests(self):
+        """100k traced requests (1% retained) must leave exactly
+        ``ring`` traces resident — constant memory however long the
+        server lives."""
+        tracer = Tracer(ring=64)
+        for i in range(100_000):
+            status = 500 if i % 100 == 0 else 200
+            tracer.finish(tracer.begin("q"), status=status,
+                          duration=0.001)
+        assert len(tracer.recorder) == 64
+        assert tracer.recorder.dropped == 1000 - 64
+        st = tracer.status()
+        assert st["requests"] == 100_000
+        assert st["retainedByReason"]["error"] == 1000
+
+    def test_recorder_id_lookup_and_slowest(self):
+        rec = FlightRecorder(capacity=8)
+        tracer = Tracer()
+        ids = []
+        for i in range(5):
+            t = tracer.begin(f"q{i}")
+            tracer.finish(t, status=500, duration=0.01 * (i + 1))
+            rec.add(t)
+            ids.append(t.trace_id)
+        assert rec.get(ids[2]).trace_id == ids[2]
+        assert rec.get("f" * 32) is None
+        slowest = rec.slowest(2)
+        assert [t.trace_id for t in slowest] == [ids[4], ids[3]]
+
+    def test_fault_marking_is_thread_local(self):
+        t1, t2 = Tracer().begin("a"), Tracer().begin("b")
+        seen = []
+
+        def other_thread():
+            with activate_traces([t2]):
+                seen.append(True)
+
+        with activate_traces([t1]):
+            th = threading.Thread(target=other_thread)
+            th.start()
+            th.join()
+            mark_active_traces("fault", faultPoint="p")
+        assert "fault" in t1.marks and t1.attrs["faultPoint"] == "p"
+        assert "fault" not in t2.marks  # other thread's batch untouched
+
+
+# ---------------------------------------------------------------------------
+# span recording + Perfetto export
+# ---------------------------------------------------------------------------
+class TestExport:
+    def test_stage_spans_lay_out_sequentially(self):
+        tracer = Tracer()
+        t = tracer.begin("q")
+        phases = {"assemble": 0.001, "supplement": 0.002,
+                  "dispatch": 0.003, "readback": 0.004}
+        add_stage_spans(t, t.t_mono, phases)
+        names = [s.name for s in t.spans]
+        assert names == ["assemble", "supplement", "dispatch",
+                         "readback"]  # canonical order
+        # back-to-back: each span starts where the previous ended
+        for a, b in zip(t.spans, t.spans[1:]):
+            assert b.t_start == pytest.approx(a.t_end)
+
+    def test_perfetto_shape(self):
+        tracer = Tracer()
+        t = tracer.begin("POST /queries.json", traceparent=TP,
+                         request_id="req1")
+        with t.span("dispatch", lane=0):
+            pass
+        tracer.finish(t, status=500, duration=0.25)
+        doc = t.to_trace_events()
+        assert doc["otherData"]["traceId"] == "ab" * 16
+        evs = doc["traceEvents"]
+        assert evs[0]["name"] == "POST /queries.json"
+        assert evs[0]["ph"] == "X"
+        assert evs[0]["dur"] == pytest.approx(250_000, rel=0.01)
+        assert evs[0]["args"]["requestId"] == "req1"
+        child = [e for e in evs if e["name"] == "dispatch"][0]
+        assert child["args"]["parentId"] == t.root_span_id
+        assert child["args"]["lane"] == 0
+        json.dumps(doc)  # fully serializable
+
+    def test_span_ctx_records_errors(self):
+        t = Tracer().begin("q")
+        with pytest.raises(ValueError):
+            with t.span("fold_in"):
+                raise ValueError("boom")
+        assert t.spans[0].attrs["error"] == "boom"
+
+
+# ---------------------------------------------------------------------------
+# exemplars + OpenMetrics grammar
+# ---------------------------------------------------------------------------
+EXEMPLAR_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*_bucket\{[^}]*\} [0-9]+ '
+    r'# \{trace_id="[0-9a-f]{32}"\} [0-9.eE+-]+( [0-9]+(\.[0-9]+)?)?$')
+
+
+class TestExemplars:
+    def _registry_with_exemplar(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram("pio_query_latency_seconds", "q")
+        tracer = Tracer(ring=4)
+        t = tracer.begin("q")
+        child = fam.labels()
+        child.observe(0.05)
+        t.exemplar(child, 0.05)
+        tracer.finish(t, status=500, duration=0.05)
+        return reg, t
+
+    def test_exemplar_grammar(self):
+        reg, t = self._registry_with_exemplar()
+        lines = reg.render(openmetrics=True).splitlines()
+        ex = [ln for ln in lines if "# {" in ln]
+        assert len(ex) == 1
+        assert EXEMPLAR_RE.match(ex[0]), ex[0]
+        assert t.trace_id in ex[0]
+
+    def test_exemplars_absent_from_004_format(self):
+        reg, _ = self._registry_with_exemplar()
+        plain = reg.render()
+        assert "# {" not in plain
+        assert "# EOF" not in plain
+
+    def test_openmetrics_terminator_and_counter_metadata(self):
+        reg, _ = self._registry_with_exemplar()
+        reg.counter("pio_events_ingested_total", "x").inc()
+        om = reg.render(openmetrics=True)
+        assert om.rstrip().endswith("# EOF")
+        # counter family metadata drops _total, samples keep it
+        assert "# TYPE pio_events_ingested counter" in om
+        assert "pio_events_ingested_total 1" in om
+
+    def test_unretained_trace_writes_no_exemplar(self):
+        reg = MetricsRegistry()
+        child = reg.histogram("pio_query_latency_seconds", "q").labels()
+        tracer = Tracer()
+        t = tracer.begin("q")
+        child.observe(0.001)
+        t.exemplar(child, 0.001)
+        tracer.finish(t, status=200, duration=0.001)  # dropped
+        assert "# {" not in reg.render(openmetrics=True)
+
+    def test_exemplar_lands_in_value_bucket(self):
+        h = StreamingHistogram(bounds=[0.1, 1.0, 10.0])
+        h.record_exemplar(0.5, "ab" * 16)
+        assert list(h.exemplars().keys()) == [1]  # 0.1 < 0.5 <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP middleware (toy app)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def toy_server():
+    app = HTTPApp("toy")
+    reg = MetricsRegistry()
+
+    @app.route("GET", "/ok")
+    def ok(req: Request) -> Response:
+        return json_response({"ok": True})
+
+    @app.route("GET", "/boom")
+    def boom(req: Request) -> Response:
+        return json_response({"message": "nope"}, 500)
+
+    mount_metrics(app, reg, server_name="toy")
+    srv = AppServer(app, "127.0.0.1", 0).start_background()
+    yield app, srv, srv.port
+    srv.shutdown()
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    try:
+        resp = urllib.request.urlopen(req, timeout=30)
+        body = resp.read()
+        return resp.status, dict(resp.headers), body
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+class TestMiddleware:
+    def test_traceparent_propagated_and_minted(self, toy_server):
+        app, srv, port = toy_server
+        status, headers, _ = _get(port, "/ok", {"traceparent": TP})
+        assert status == 200
+        echoed = parse_traceparent(headers["traceparent"])
+        assert echoed[0] == "ab" * 16       # same trace id
+        assert echoed[1] != "cd" * 8        # our own span id
+        _, headers2, _ = _get(port, "/ok")
+        assert parse_traceparent(headers2["traceparent"])[0] \
+            != "ab" * 16                    # minted fresh
+
+    def test_error_retained_and_served_from_trace_json(self, toy_server):
+        app, srv, port = toy_server
+        status, headers, _ = _get(port, "/boom", {"traceparent": TP})
+        assert status == 500
+        assert headers.get("X-Trace-Retained") == "error"
+        _, _, body = _get(port, "/trace.json?id=" + "ab" * 16)
+        doc = json.loads(body)
+        assert doc["otherData"]["traceId"] == "ab" * 16
+        assert doc["otherData"]["retainedReason"] == "error"
+        # status + slowest listings work too
+        _, _, body = _get(port, "/trace.json")
+        st = json.loads(body)
+        assert st["retained"] >= 1 and st["requests"] >= 1
+        _, _, body = _get(port, "/trace.json?slowest=5")
+        assert any(t["traceId"] == "ab" * 16
+                   for t in json.loads(body)["traces"])
+
+    def test_unknown_trace_404(self, toy_server):
+        app, srv, port = toy_server
+        status, _, _ = _get(port, "/trace.json?id=" + "f" * 32)
+        assert status == 404
+
+    def test_metrics_content_negotiation(self, toy_server):
+        app, srv, port = toy_server
+        _, headers, body = _get(port, "/metrics")
+        assert headers["Content-Type"].startswith("text/plain")
+        assert b"# EOF" not in body
+        _, headers, body = _get(
+            port, "/metrics",
+            {"Accept": "application/openmetrics-text"})
+        assert headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        assert body.rstrip().endswith(b"# EOF")
+
+    def test_build_info_labels(self, toy_server):
+        app, srv, port = toy_server
+        _, _, body = _get(port, "/metrics")
+        line = [ln for ln in body.decode().splitlines()
+                if ln.startswith("pio_build_info{")][0]
+        for label in ("server=", "version=", "jax=", "backend=",
+                      "process_count=", "devices="):
+            assert label in line, line
+
+    def test_trace_metrics_exported(self, toy_server):
+        app, srv, port = toy_server
+        _get(port, "/boom")
+        _, _, body = _get(port, "/metrics")
+        text = body.decode()
+        assert re.search(r'pio_trace_retained_total\{reason="error"\} '
+                         r'[1-9]', text)
+        assert "pio_trace_requests_total" in text
+        assert "pio_trace_ring_size" in text
+
+    def test_access_log_sampling(self, toy_server, caplog):
+        app, srv, port = toy_server
+        app.access_log_sample = 0.0  # drop ALL successes
+        with caplog.at_level(logging.INFO, "predictionio_tpu.access"):
+            _get(port, "/ok")
+            _get(port, "/boom")
+        lines = [json.loads(r.message) for r in caplog.records
+                 if r.name == "predictionio_tpu.access"]
+        statuses = [ln["status"] for ln in lines]
+        assert 200 not in statuses      # sampled away
+        assert 500 in statuses          # errors ALWAYS log
+        assert all("traceId" in ln for ln in lines)
+        app.access_log_sample = 1.0
+        with caplog.at_level(logging.INFO, "predictionio_tpu.access"):
+            _get(port, "/ok")
+        lines = [json.loads(r.message) for r in caplog.records
+                 if r.name == "predictionio_tpu.access"]
+        assert any(ln["status"] == 200 for ln in lines)
+        # the in-process trace object never leaks into the log line
+        assert all(not k.startswith("_")
+                   for ln in lines for k in ln)
+
+
+# ---------------------------------------------------------------------------
+# engine server end to end (live HTTP)
+# ---------------------------------------------------------------------------
+def _mem_storage(app_name="mlapp"):
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, app_name))
+    storage.events().init(app_id)
+    return storage, app_id
+
+
+def _rate(user, item, rating, t):
+    return Event(event="rate", entity_type="user", entity_id=user,
+                 target_entity_type="item", target_entity_id=item,
+                 properties=DataMap({"rating": float(rating)}),
+                 event_time=t)
+
+
+def _seed(storage, app_id, n_users=20):
+    rng = np.random.default_rng(7)
+    events, t = [], T0
+    for u in range(n_users):
+        for i in rng.choice(20, size=6, replace=False):
+            events.append(_rate(f"u{u}", f"i{i}", 5.0, t))
+            t += timedelta(minutes=1)
+    storage.events().insert_batch(events, app_id)
+    return t
+
+
+def _deploy(storage, **config_kw):
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import (
+        get_latest_completed,
+        load_models_for_deploy,
+        run_train,
+    )
+
+    ctx = Context(app_name="mlapp", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("mlapp", rank=RANK, num_iterations=4,
+                               reg=0.05, seed=3)
+    run_train(ctx, engine, ep, engine_id="reco",
+              engine_factory="templates.recommendation")
+    inst = get_latest_completed(ctx, engine_id="reco")
+    models = load_models_for_deploy(ctx, engine, inst, ep)
+    config_kw.setdefault("warm_start", False)
+    qs = QueryServer(ctx, engine, ep, models, inst,
+                     ServerConfig(**config_kw))
+    return qs
+
+
+@pytest.fixture(scope="module")
+def traced_server():
+    from predictionio_tpu.server.engineserver import (
+        create_engine_server,
+    )
+
+    storage, app_id = _mem_storage()
+    t_end = _seed(storage, app_id)
+    # trace_slow_ms=1: every device query (ms+) is "slow" → retained,
+    # so the stage-timeline assertions don't depend on load
+    qs = _deploy(storage, batching=True, max_batch=8,
+                 trace_slow_ms=1.0)
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    yield storage, app_id, qs, srv, srv.port, t_end
+    srv.shutdown()
+
+
+def _query(port, user, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps({"user": user, "num": 3}).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+class TestEngineServerTracing:
+    def test_slow_query_retained_with_stage_timeline(self, traced_server):
+        """The acceptance path: a retained query's Perfetto export
+        carries the full stage timeline including device dispatch and
+        readback, plus the batch/engine attribution attrs."""
+        storage, app_id, qs, srv, port, _ = traced_server
+        status, headers, _ = _query(port, "u1", {"traceparent": TP})
+        assert status == 200
+        trace_id = parse_traceparent(headers["traceparent"])[0]
+        assert trace_id == "ab" * 16
+        assert headers.get("X-Trace-Retained") == "slow"
+        _, _, body = _get(port, f"/trace.json?id={trace_id}")
+        doc = json.loads(body)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "dispatch" in names and "readback" in names, names
+        assert "batch" in names  # per-batch span rides every query
+        root = doc["traceEvents"][0]
+        assert root["args"]["status"] == 200
+        batch = [e for e in doc["traceEvents"]
+                 if e["name"] == "batch"][0]
+        assert batch["args"]["batchSize"] >= 1
+        # stage children parent onto the batch span
+        dispatch = [e for e in doc["traceEvents"]
+                    if e["name"] == "dispatch"][0]
+        assert dispatch["args"]["parentId"] == batch["args"]["spanId"]
+
+    def test_engine_attrs_and_exemplar(self, traced_server):
+        storage, app_id, qs, srv, port, _ = traced_server
+        _query(port, "u2")
+        _, _, body = _get(port, "/trace.json?slowest=1")
+        top = json.loads(body)["traces"][0]
+        assert top["attrs"]["engineInstanceId"] == qs.instance.id
+        assert top["attrs"]["arm"] == "stable"
+        _, _, body = _get(
+            port, "/metrics",
+            {"Accept": "application/openmetrics-text"})
+        ex = [ln for ln in body.decode().splitlines()
+              if "pio_query_latency_seconds_bucket" in ln
+              and "# {" in ln]
+        assert ex and EXEMPLAR_RE.match(ex[0]), ex[:2]
+
+    def test_fault_injected_query_flagged(self, traced_server):
+        from predictionio_tpu.faults import inject_spec, registry
+
+        storage, app_id, qs, srv, port, _ = traced_server
+        inject_spec("serving.dispatch=latency,delay_ms=5,times=1")
+        try:
+            _query(port, "u3", {"traceparent": format_traceparent(
+                "99" * 16, "11" * 8)})
+        finally:
+            registry().clear("serving.dispatch")
+        trace = qs.tracer.recorder.get("99" * 16)
+        assert trace is not None
+        assert "fault" in trace.marks
+        assert trace.attrs["faultPoint"] == "serving.dispatch"
+
+    def test_status_page_and_status_json_blocks(self, traced_server):
+        storage, app_id, qs, srv, port, _ = traced_server
+        _, _, body = _get(port, "/status.json")
+        st = json.loads(body)
+        assert st["trace"]["ringCapacity"] == 512
+        assert st["trace"]["requests"] >= 1
+        _, _, body = _get(port, "/")
+        assert b"flight recorder" in body
+
+    def test_profile_endpoint(self, traced_server, tmp_path_factory):
+        storage, app_id, qs, srv, port, _ = traced_server
+        qs.profiler.base_dir = str(
+            tmp_path_factory.mktemp("profiles"))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile",
+            data=json.dumps({"durationMs": 50}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 202
+            info = json.loads(resp.read())
+        assert info["durationMs"] == 50
+        # a second capture while one runs is refused
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            second = 200
+        except urllib.error.HTTPError as e:
+            second = e.code
+        assert second == 409
+        deadline = 100
+        import time as _time
+
+        while qs.profiler.active and deadline:
+            _time.sleep(0.05)
+            deadline -= 1
+        assert not qs.profiler.active
+        _, _, body = _get(port, "/profile.json")
+        pj = json.loads(body)
+        assert pj["history"] and pj["history"][0]["done"]
+        assert isinstance(pj["compileTable"], dict)
+
+    def test_profile_bad_window_400(self, traced_server):
+        storage, app_id, qs, srv, port, _ = traced_server
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile",
+            data=json.dumps({"durationMs": 10 ** 9}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+
+    def test_tracing_off_serves_untraced(self):
+        storage, app_id = _mem_storage()
+        _seed(storage, app_id, n_users=6)
+        qs = _deploy(storage, tracing=False)
+        from predictionio_tpu.server.engineserver import (
+            create_engine_server,
+        )
+
+        srv = create_engine_server(qs, host="127.0.0.1", port=0)
+        srv.start_background()
+        try:
+            status, headers, _ = _query(srv.port, "u1")
+            assert status == 200
+            assert "traceparent" not in {k.lower() for k in headers}
+            code, _, _ = _get(srv.port, "/trace.json")
+            assert code == 404  # no tracer, no route
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the headline contract: ingest → fold-in → hot-swap, ONE trace id
+# ---------------------------------------------------------------------------
+class TestEventToServableTrace:
+    def test_trace_id_equal_end_to_end(self, traced_server):
+        from predictionio_tpu.cache.bus import InvalidationBus
+        from predictionio_tpu.data.storage.base import AccessKey
+        from predictionio_tpu.server import eventserver
+        from predictionio_tpu.streaming import (
+            StreamConfig,
+            StreamTrainer,
+        )
+
+        storage, app_id, qs, srv, port, t_end = traced_server
+        storage.access_keys().insert(AccessKey("trace-key", app_id, []))
+        ev_app = eventserver.build_app(storage)
+        ev_srv = AppServer(ev_app, "127.0.0.1", 0).start_background()
+        trainer = StreamTrainer(
+            qs, StreamConfig(app_name="mlapp", consumer="t-trace",
+                             canary_probes=2, interval_ms=50),
+            bus=InvalidationBus())
+        try:
+            trainer.consume_once()  # drain the seed log
+            ingest_tp = format_traceparent("ee" * 16, "22" * 8)
+            body = json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": "u1", "targetEntityType": "item",
+                "targetEntityId": "i9",
+                "properties": {"rating": 5.0},
+                "eventTime": (t_end + timedelta(days=1)).isoformat(),
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ev_srv.port}/events.json"
+                f"?accessKey=trace-key", data=body,
+                headers={"Content-Type": "application/json",
+                         "traceparent": ingest_tp})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 201
+            assert trainer.consume_once() == 1
+            # the fold-in pass ADOPTED the ingest trace id and the
+            # engine server's recorder retained it (reason "stream")
+            trace = qs.tracer.recorder.get("ee" * 16)
+            assert trace is not None, "fold-in trace not retained"
+            assert trace.retained_reason == "stream"
+            assert trace.attrs["outcome"] == "applied"
+            names = [s.name for s in trace.spans]
+            for stage in ("consume", "fold_in", "canary", "hot_swap",
+                          "advance"):
+                assert stage in names, names
+            # and it is retrievable over HTTP from the ENGINE server
+            _, _, body = _get(port, "/trace.json?id=" + "ee" * 16)
+            doc = json.loads(body)
+            assert doc["otherData"]["traceId"] == "ee" * 16
+            assert {"fold_in", "hot_swap"} <= {
+                e["name"] for e in doc["traceEvents"]}
+        finally:
+            trainer.stop(timeout=5)
+            ev_srv.shutdown()
+
+    def test_batch_ingest_stamps_every_event(self, traced_server):
+        from predictionio_tpu.data.storage.base import (
+            AccessKey,
+            EventFilter,
+        )
+        from predictionio_tpu.server import eventserver
+
+        storage, app_id, qs, srv, port, t_end = traced_server
+        storage.access_keys().insert(AccessKey("batch-key", app_id, []))
+        ev_srv = AppServer(eventserver.build_app(storage),
+                           "127.0.0.1", 0).start_background()
+        try:
+            tp = format_traceparent("dd" * 16, "33" * 8)
+            t = t_end + timedelta(days=2)
+            payload = [{
+                "event": "rate", "entityType": "user",
+                "entityId": f"u_b{k}", "targetEntityType": "item",
+                "targetEntityId": "i1",
+                "properties": {"rating": 4.0},
+                "eventTime": (t + timedelta(seconds=k)).isoformat(),
+            } for k in range(3)]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ev_srv.port}/batch/events.json"
+                f"?accessKey=batch-key",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": tp})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                results = json.loads(resp.read())
+            assert all(r["status"] == 201 for r in results)
+            stamped = [
+                e for e in storage.events().find(
+                    app_id, None, EventFilter(limit=-1))
+                if str(e.properties.get("pio_traceparent",
+                                        default="")).startswith(
+                    "00-" + "dd" * 16)]
+            assert len(stamped) == 3
+        finally:
+            ev_srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# compile-time table
+# ---------------------------------------------------------------------------
+class TestCompileTable:
+    def test_listener_builds_bounded_table(self):
+        from predictionio_tpu.server.stats import RecompileSentinel
+
+        before = RecompileSentinel.total_compiles()
+        RecompileSentinel._listener(
+            "/jax/core/compile/backend_compile_duration", 1.25)
+        RecompileSentinel._listener(
+            "/jax/core/compile/backend_compile_duration", 0.25)
+        assert RecompileSentinel.total_compiles() == before + 2
+        table = RecompileSentinel.compile_table()
+        row = table["/jax/core/compile/backend_compile_duration"]
+        assert row["count"] >= 2
+        assert row["maxSec"] >= 1.25
+        assert row["lastSec"] == 0.25
