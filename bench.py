@@ -541,6 +541,22 @@ def main():
             # with serving_bench.main
             serving = sb.standard_battery(n_cat, 64, n_req, 8,
                                           hi_threads)
+            # quantized-lane side-by-side (ISSUE 13): the same device
+            # per-query + micro-batch workload with serving_quant on,
+            # against the battery's f32 rows — the `serving_quant`
+            # summary row lands in the BENCH line
+            q_dtype = os.environ.get("BENCH_SERVING_QUANT", "int8")
+            if q_dtype in ("bf16", "int8"):
+                try:
+                    qrows = sb.quant_battery(
+                        n_cat, 64, n_req, 8, hi_threads, q_dtype,
+                        f32_per_query=serving.get("per_query"),
+                        f32_micro=serving.get("microbatch"))
+                    serving["serving_quant"] = qrows[-1]
+                    serving["quant_rows"] = qrows[:-1]
+                except Exception as e:  # noqa: BLE001 — report
+                    serving["serving_quant"] = {
+                        "error": _clean_err(e, 300)}
         except Exception as e:  # noqa: BLE001 — report, don't die
             serving = {"error": _clean_err(e, 300)}
 
@@ -659,6 +675,24 @@ def main():
                 roofline["fused"] = probe("fused", "1", 360)
         except Exception as e:  # noqa: BLE001 — report, don't die
             roofline["fused"] = {"error": _clean_err(e, 200)}
+        # serving-side roofline (ISSUE 13): the batched top-k dispatch
+        # over f32 vs row-quantized tables — bytes-accessed ratio and
+        # whether the serving bound moved off the HBM roof
+        try:
+            proc = subprocess.run(
+                [sys.executable, probe_path],
+                env=dict(os.environ, PROBE_SERVE="1"),
+                capture_output=True, text=True, timeout=600)
+            line = next((ln for ln in
+                         reversed(proc.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            if proc.returncode != 0 or line is None:
+                tail = (proc.stderr or proc.stdout or "").strip()
+                raise RuntimeError(
+                    f"probe rc={proc.returncode}: {tail[-200:]}")
+            roofline["serving"] = json.loads(line)
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            roofline["serving"] = {"error": _clean_err(e, 200)}
 
     # telemetry tails (ISSUE 2): surface the serving battery's scraped
     # server-side signals as top-level keys so the perf trajectory
@@ -700,6 +734,10 @@ def main():
         # flight-recorder overhead (ISSUE 12 acceptance ≤5%): host
         # fast-path p50 with tracing on vs off, same load
         "trace_overhead_pct": (serving or {}).get("trace_overhead_pct"),
+        # quantized serving lane vs the f32 einsum lane at the same
+        # offered load (ISSUE 13): per-query p50 pair + micro-batch
+        # qps/p99 ratios
+        "serving_quant": (serving or {}).get("serving_quant"),
         # event→servable freshness through the streaming trainer
         # (ISSUE 10): ingest to correct serve, real HTTP loop
         "event_to_servable_ms": (streaming or {}).get(

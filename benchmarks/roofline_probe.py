@@ -24,10 +24,20 @@ The output places the iteration on the DUAL roofline (ISSUE 7):
 auto), so the bench can emit one block per mode and the fused kernel's
 bytes-accessed drop is visible next to the einsum baseline.
 
+With ``PROBE_SERVE=1`` the probe runs the SERVING roofline instead
+(ISSUE 13): it lowers the batched top-k dispatch (`_serve_topk`) over
+an f32 model and over the row-quantized (``PROBE_QUANT``, default
+int8) tables, compares XLA's post-fusion bytes-accessed / arithmetic
+intensity / bound for the two programs, and times both dispatches —
+the block that proves where the serving bound moved when the wire
+went int8 (the fused kernel's VMEM streaming is not visible to XLA's
+cost model; its effect shows up in serving_bench's measured lane).
+
 Usage: python benchmarks/roofline_probe.py   (from the repo root)
 Env:   BENCH_SCALE, BENCH_RANK as for bench.py; PROBE_ITERS (default 1);
        PROBE_GRAM (default auto); PROBE_GATHER (float32|bfloat16);
-       PROBE_REPEATS (default 3)
+       PROBE_REPEATS (default 3); PROBE_SERVE=1 (+ PROBE_QUANT,
+       PROBE_SERVE_ITEMS, PROBE_SERVE_BATCH) for the serving block
 """
 
 from __future__ import annotations
@@ -48,7 +58,116 @@ PEAK_BW = {"TPU v5 lite": 819, "TPU v5e": 819, "TPU v4": 1228,
            "TPU v6 lite": 1640}
 
 
+def _dual_roofline(flops: float, byts: float, bw, peak_fl,
+                   wall_s: float | None) -> dict:
+    """Shared dual-roofline block: where a program SITS (intensity)
+    and which roof is over it, plus achieved bandwidth when timed."""
+    out: dict = {"xla_flops": flops, "xla_bytes_accessed": byts}
+    if byts and flops:
+        ai = flops / byts
+        out["arithmetic_intensity"] = round(ai, 3)
+        if bw and peak_fl:
+            attainable = min(peak_fl, ai * bw * 1e9)
+            out["attainable_tflops"] = round(attainable / 1e12, 2)
+            out["bound"] = "hbm" if ai * bw * 1e9 < peak_fl else "mxu"
+    if wall_s and byts:
+        gbps = byts / wall_s / 1e9
+        out["hbm_gbps"] = round(gbps, 1)
+        if bw:
+            out["hbm_utilization"] = round(gbps / bw, 3)
+    if wall_s is not None:
+        out["wall_s_per_dispatch"] = round(wall_s, 6)
+    return out
+
+
+def serving_roofline() -> dict:
+    """The serving-side roofline block (ISSUE 13): the batched top-k
+    dispatch over f32 vs row-quantized tables. XLA's bytes-accessed
+    for the einsum realization shows the table-read + score-matrix
+    traffic the quantized wire shrinks — the `bound` field says
+    whether the dispatch is still pinned to the HBM roof after the
+    move."""
+    import jax
+
+    import predictionio_tpu.models.als as als
+
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    rank = int(os.environ.get("BENCH_RANK", "64"))
+    quant = os.environ.get("PROBE_QUANT", "int8")
+    n_items = int(os.environ.get("PROBE_SERVE_ITEMS",
+                                 str(int(1_200_000 * scale))))
+    B = int(os.environ.get("PROBE_SERVE_BATCH", "2048"))
+    n_users = max(int(138_000 * scale), B)
+    k = 16
+    rng = np.random.default_rng(0)
+    U = rng.standard_normal((n_users, rank)).astype(np.float32)
+    V = rng.standard_normal((n_items, rank)).astype(np.float32)
+    idx = rng.integers(0, n_users, B)
+
+    device = jax.devices()[0].device_kind
+    bw = next((v for kk, v in PEAK_BW.items() if device.startswith(kk)),
+              None)
+    try:
+        from bench import device_peak_flops
+
+        peak_fl = device_peak_flops()
+    except Exception:  # noqa: BLE001 — probe must not die on a moved
+        peak_fl = None  # bench.py symbol
+
+    def probe_tables(uf, itf):
+        lowered = als._serve_topk.lower(uf, itf, idx, k=k,
+                                        n_items=n_items)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        # measured dispatch: warm once, then best-of-3
+        als._serve_topk(uf, itf, idx, k=k, n_items=n_items
+                        )[0].block_until_ready()
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.monotonic()
+            als._serve_topk(uf, itf, idx, k=k, n_items=n_items
+                            )[0].block_until_ready()
+            best = min(best, time.monotonic() - t0)
+        return _dual_roofline(float(ca.get("flops", 0.0)),
+                              float(ca.get("bytes accessed", 0.0)),
+                              bw, peak_fl, best)
+
+    Ud, Vd = jax.device_put(U), jax.device_put(V)
+    f32_block = probe_tables(Ud, Vd)
+    qU = als.QuantizedFactors(*als._quantize_rows(U, quant),
+                              quant=quant)
+    qV = als.QuantizedFactors(*als._quantize_rows(V, quant),
+                              quant=quant)
+    qU, qV = jax.device_put(qU), jax.device_put(qV)
+    q_block = probe_tables(qU, qV)
+    out = {
+        "metric": "serving_topk_roofline",
+        "device": device,
+        "rank": rank, "n_items": n_items, "batch": B, "k": k,
+        "quant": quant,
+        "f32": f32_block,
+        quant: q_block,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+    }
+    if f32_block.get("xla_bytes_accessed") \
+            and q_block.get("xla_bytes_accessed"):
+        out["bytes_x"] = round(
+            f32_block["xla_bytes_accessed"]
+            / q_block["xla_bytes_accessed"], 2)
+    if f32_block.get("wall_s_per_dispatch") \
+            and q_block.get("wall_s_per_dispatch"):
+        out["dispatch_x"] = round(
+            f32_block["wall_s_per_dispatch"]
+            / q_block["wall_s_per_dispatch"], 2)
+    return out
+
+
 def main() -> None:
+    if os.environ.get("PROBE_SERVE") == "1":
+        print(json.dumps(serving_roofline()))
+        return
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     rank = int(os.environ.get("BENCH_RANK", "64"))
     iters = int(os.environ.get("PROBE_ITERS", "1"))

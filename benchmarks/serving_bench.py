@@ -48,10 +48,17 @@ latency instead of silently slowing the offered load). Sweep the rate
 to trace the qps-vs-p99 knee — the first slice of ROADMAP's
 load-harness item.
 
+With ``--quant DTYPE`` (ISSUE 13), the device per-query and
+micro-batch configs run again with row-quantized serving tables
+(``serving_quant=DTYPE`` + the autotuned fused top-k kernel) and a
+``serving_quant`` summary row reports quantized-vs-f32 per-query p50
+and micro-batch qps/p99 ratios side by side — the row ``bench.py``
+embeds in the BENCH line.
+
 Usage: python benchmarks/serving_bench.py [n_items_device] [rank]
                                           [--canary FRACTION]
                                           [--zipf ALPHA] [--cache]
-                                          [--mesh]
+                                          [--mesh] [--quant DTYPE]
                                           [--arrival-rate QPS]
 Env:   SERVE_THREADS (8), SERVE_REQUESTS (400 per config)
 """
@@ -645,6 +652,60 @@ def bench_canary(model: ALSModel, candidate: ALSModel, fraction: float,
     }
 
 
+def quant_battery(n_items_dev: int, rank: int, n_req: int,
+                  n_threads: int, hi_threads: int, quant: str,
+                  f32_per_query: dict | None = None,
+                  f32_micro: dict | None = None) -> list:
+    """The --quant view (ISSUE 13): the SAME workload against the
+    device per-query path and the micro-batched lane with
+    ``serving_quant=DTYPE`` (+ the autotuned top-k kernel), side by
+    side with the f32 einsum lane — reusing the standard battery's f32
+    rows when the caller already measured them. Emits a
+    ``serving_quant`` summary row (embedded in the BENCH line): the
+    acceptance view is the quant/fused lane beating the f32 einsum
+    lane on the benched path at equal p99."""
+    dev_model = synth_model(50_000, n_items_dev, rank, device=True)
+    hi_req = max(n_req, 8 * hi_threads)
+    rows = []
+    if f32_per_query is None:
+        f32_per_query = bench_config(
+            dev_model, ServerConfig(), n_req, n_threads,
+            "device_per_query")
+        rows.append(f32_per_query)
+    if f32_micro is None:
+        f32_micro = bench_config(
+            dev_model, ServerConfig(batching=True, max_batch=128,
+                                    batch_window_ms=2.0),
+            hi_req, hi_threads, "device_microbatch_staged")
+        rows.append(f32_micro)
+    q_per_query = bench_config(
+        dev_model, ServerConfig(serving_quant=quant), n_req,
+        n_threads, f"device_per_query_{quant}")
+    q_micro = bench_config(
+        dev_model, ServerConfig(batching=True, max_batch=128,
+                                batch_window_ms=2.0,
+                                serving_quant=quant),
+        hi_req, hi_threads, f"device_microbatch_{quant}")
+    rows += [q_per_query, q_micro]
+    summary = {
+        "config": "serving_quant",
+        "quant": quant,
+        "per_query_f32_p50_ms": f32_per_query.get("p50_ms"),
+        "per_query_quant_p50_ms": q_per_query.get("p50_ms"),
+        "micro_f32_qps": f32_micro.get("qps"),
+        "micro_quant_qps": q_micro.get("qps"),
+        "micro_f32_p99_ms": f32_micro.get("p99_ms"),
+        "micro_quant_p99_ms": q_micro.get("p99_ms"),
+    }
+    if f32_micro.get("qps") and q_micro.get("qps"):
+        summary["qps_x"] = round(q_micro["qps"] / f32_micro["qps"], 2)
+    if f32_micro.get("p99_ms") and q_micro.get("p99_ms"):
+        summary["p99_x"] = round(
+            f32_micro["p99_ms"] / q_micro["p99_ms"], 2)
+    rows.append(summary)
+    return rows
+
+
 def bench_cached_pair(n_items_dev: int, rank: int, n_req: int,
                       n_threads: int, zipf) -> list:
     """The --cache view: the SAME Zipf-skewed workload against the
@@ -695,6 +756,14 @@ def main() -> None:
         i = argv.index("--arrival-rate")
         arrival_rate = float(argv[i + 1])
         del argv[i:i + 2]
+    quant = None
+    if "--quant" in argv:
+        i = argv.index("--quant")
+        quant = argv[i + 1]
+        del argv[i:i + 2]
+        if quant not in ("bf16", "int8"):
+            raise SystemExit(f"--quant must be bf16 or int8, "
+                             f"got {quant!r}")
     sys.argv[1:] = argv
     n_items_dev = int(sys.argv[1]) if len(sys.argv) > 1 else 1_200_000
     rank = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -739,8 +808,14 @@ def main() -> None:
             "results": results,
         }))
         return
-    results = list(standard_battery(n_items_dev, rank, n_requests,
-                                    n_threads, hi).values())
+    battery = standard_battery(n_items_dev, rank, n_requests,
+                               n_threads, hi)
+    results = list(battery.values())
+    if quant is not None:
+        results.extend(quant_battery(
+            n_items_dev, rank, n_requests, n_threads, hi, quant,
+            f32_per_query=battery.get("per_query"),
+            f32_micro=battery.get("microbatch")))
     if with_mesh:
         scaling = mesh_scaling_battery(n_items_dev, rank, n_requests, hi)
         results.append({"config": "mesh_scaling", **scaling})

@@ -324,6 +324,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         hot_entities=args.hot_entities,
         debug_locks=args.debug_locks,
         serving_mode=args.serving_mode,
+        serving_quant=args.serving_quant,
+        serving_topk=args.serving_topk,
         streaming=args.stream,
         stream_app_name=args.stream_app or None,
         stream_interval_ms=args.stream_interval_ms,
@@ -1448,6 +1450,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(batch, model) mesh (models > one HBM); "
                         "auto = sharded when the model exceeds the "
                         "per-device HBM headroom, else replicated")
+    s.add_argument("--serving-quant", default="off",
+                   choices=["off", "bf16", "int8"],
+                   help="row-quantized serving factor tables "
+                        "(docs/kernels.md): int8 = per-row-scaled "
+                        "int8 storage (~4x users per HBM, ~4x less "
+                        "bandwidth per scored batch) with f32 "
+                        "accumulation; bf16 halves both; guarded by "
+                        "a deploy-time NDCG@10 parity probe that "
+                        "auto-falls-back to f32")
+    s.add_argument("--serving-topk", default="auto",
+                   choices=["auto", "einsum", "fused"],
+                   help="batched-lane top-k realization: fused = the "
+                        "Pallas gather->score->top-k kernel (the "
+                        "[B, I] score matrix never lands in HBM), "
+                        "einsum = the XLA baseline, auto = the "
+                        "support-gated autotune table")
     s.add_argument("--stream", action="store_true",
                    help="streaming incremental training "
                         "(docs/streaming.md): a trainer daemon tails "

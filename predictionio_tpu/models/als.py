@@ -1433,7 +1433,237 @@ def als_flops_per_iter(user_h, item_h, params: ALSParams) -> int:
     return side(user_h, rows_of(item_h)) + side(item_h, rows_of(user_h))
 
 
+# -- row-quantized serving factor tables (ISSUE 13) --------------------------
+#
+# Tensor-Casting-style precision co-design (arXiv 2010.13100):
+# recommendation factors tolerate low-precision STORAGE as long as the
+# accumulation stays f32. Serving-side tables are therefore stored
+# int8 (per-row absmax scales) or bf16 and dequantized on the fly —
+# 4x (int8) / 2x (bf16) more users per HBM and the same factor less
+# bandwidth per scored batch, with every dot product still
+# accumulating in f32. Deploy-time only, like the mesh: a quantized
+# table never enters the blob store.
+
+#: the ServerConfig.serving_quant vocabulary
+SERVING_QUANT_MODES = ("off", "bf16", "int8")
+
+#: NDCG@10-vs-f32 floor the deploy-time parity probe enforces before a
+#: quantized table may serve (:func:`quantize_serving_model` auto-off:
+#: a model trained at a rank/scale where per-row int8 loses the
+#: ranking falls back to f32 instead of silently degrading quality)
+SERVING_QUANT_NDCG_FLOOR = 0.97
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantizedFactors:
+    """A row-quantized serving factor table: ``data`` [n, r] int8 with
+    per-row f32 absmax ``scale`` [n, 1], or bf16 with no scale. A
+    pytree (so device placement, sharding and ``nbytes`` accounting
+    reach the leaves); ``quant`` is static metadata. Serving paths
+    dequantize after the wire — upcast + scale inside the compiled
+    program (or the fused kernel's VMEM), never as a materialized f32
+    copy of the table."""
+
+    data: jax.Array = field(metadata=dict(static=False))
+    scale: Optional[jax.Array] = field(default=None,
+                                       metadata=dict(static=False))
+    quant: str = field(default="int8", metadata=dict(static=True))
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def nbytes(self) -> int:
+        nb = int(self.data.nbytes)
+        if self.scale is not None:
+            nb += int(self.scale.nbytes)
+        return nb
+
+
+def _table_leaves(t) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """(data, scale-or-None) of a factor table, quantized or plain."""
+    if isinstance(t, QuantizedFactors):
+        return t.data, t.scale
+    return t, None
+
+
+def table_quant(t) -> str:
+    """The quant dtype of a factor table ("off" for plain f32)."""
+    return t.quant if isinstance(t, QuantizedFactors) else "off"
+
+
+def serving_quant_of(model) -> str:
+    """The serving-quant realization of a bound model — the ``quant``
+    label of the ``pio_serving_kernel`` info gauge."""
+    return table_quant(getattr(model, "item_factors", model))
+
+
+def _quantize_rows(rows: np.ndarray, quant: str
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Host-side row quantization: per-row absmax scale → int8 in
+    [-127, 127] (symmetric, so dequant is one multiply), or a bf16
+    cast. Shared by :func:`quantize_serving_model` and the streaming
+    hot-swap's re-quantization (:func:`apply_row_updates`)."""
+    rows = np.asarray(rows, dtype=np.float32)
+    if quant == "bf16":
+        import ml_dtypes
+
+        return rows.astype(ml_dtypes.bfloat16), None
+    if quant != "int8":
+        raise ValueError(f"quant must be 'bf16' or 'int8', got {quant!r}")
+    amax = np.max(np.abs(rows), axis=-1, keepdims=True) \
+        if rows.size else np.zeros((rows.shape[0], 1), np.float32)
+    scale = np.maximum(amax, 1e-12).astype(np.float32) / 127.0
+    data = np.clip(np.rint(rows / scale), -127, 127).astype(np.int8)
+    return data, scale
+
+
+_dequant_scaled = jax.jit(lambda d, s: d.astype(jnp.float32) * s)
+_dequant_plain = jax.jit(lambda d: d.astype(jnp.float32))
+
+
+def dequantize_table(t):
+    """An f32 view of a factor table (identity for plain tables).
+    Elementwise, so a row-sharded quantized table dequantizes into the
+    same sharding. Used by the training-side consumers of a serving
+    table (streaming fold-in solves) — the serving paths themselves
+    dequantize inside their compiled programs instead."""
+    if not isinstance(t, QuantizedFactors):
+        return t
+    if t.scale is None:
+        return _dequant_plain(t.data)
+    return _dequant_scaled(t.data, t.scale)
+
+
+def table_host_f32(t) -> np.ndarray:
+    """Host f32 copy of a factor table (plain or quantized, device or
+    host resident) — the fold-in residual / parity-probe view."""
+    if isinstance(t, QuantizedFactors):
+        data = np.asarray(jax.device_get(t.data)).astype(np.float32)
+        if t.scale is not None:
+            data = data * np.asarray(jax.device_get(t.scale))
+        return data
+    if isinstance(t, np.ndarray):
+        return np.asarray(t, dtype=np.float32)
+    return np.asarray(jax.device_get(t)).astype(np.float32)
+
+
+def _binary_ndcg(ranked, relevant, k: int) -> float:
+    """Binary NDCG@k of one ranked id list against a relevant-id set
+    (inlined rather than imported from controller.metric: models must
+    not depend on the controller layer)."""
+    dcg = sum(1.0 / np.log2(i + 2.0)
+              for i, x in enumerate(ranked[:k]) if x in relevant)
+    ideal = sum(1.0 / np.log2(i + 2.0)
+                for i in range(min(k, len(relevant))))
+    return float(dcg / ideal) if ideal else 0.0
+
+
+def serving_quant_ndcg(U: np.ndarray, V: np.ndarray, qU, qV,
+                       n_items: int, k: int = 10, sample: int = 32,
+                       seed: int = 0) -> float:
+    """Mean NDCG@k of the QUANTIZED ranking against the f32 ranking's
+    top-k (f32 as ground truth) over a user sample — the deploy-time
+    parity probe behind the auto-off fallback, and the same statistic
+    the CI quality gate asserts on a fixture model."""
+    n = min(sample, U.shape[0])
+    if n == 0 or n_items == 0:
+        return 1.0
+    users = np.random.default_rng(seed).choice(U.shape[0], size=n,
+                                               replace=False)
+    kk = min(k, n_items)
+    ids_f, _ = _host_topk(U[users], V, kk, n_items)
+    ids_q, _ = _host_topk(table_host_f32(qU)[users],
+                          table_host_f32(qV), kk, n_items)
+    return float(np.mean([
+        _binary_ndcg(list(a), set(b.tolist()), kk)
+        for a, b in zip(ids_q, ids_f)]))
+
+
+def quantize_serving_model(model: "ALSModel", quant: str, *,
+                           parity_floor: float = SERVING_QUANT_NDCG_FLOOR,
+                           parity_sample: int = 32, parity_k: int = 10,
+                           seed: int = 0) -> "ALSModel":
+    """A model whose serving factor tables are row-quantized to
+    ``quant`` ("int8" | "bf16"; "off" returns the input) — the
+    ``ServerConfig.serving_quant`` realization, applied at bind time
+    BEFORE device placement so the host→HBM transfer already moves the
+    small tables.
+
+    Auto-off: before committing, a parity probe ranks ``parity_sample``
+    users through both tables and requires NDCG@``parity_k`` ≥
+    ``parity_floor`` against the f32 ranking; a model whose rank/scale
+    cannot take the quantization keeps its f32 tables (logged), so
+    ``--serving-quant`` can never silently degrade ranking. The CI
+    quality gate (tests/test_serving_quant.py) asserts the same
+    statistic on a fixture model."""
+    import dataclasses
+
+    if quant in (None, "", "off"):
+        return model
+    if quant not in ("bf16", "int8"):
+        raise ValueError(
+            f"serving quant must be one of {SERVING_QUANT_MODES}, "
+            f"got {quant!r}")
+    if isinstance(model.user_factors, QuantizedFactors):
+        return model
+    U = table_host_f32(model.user_factors)
+    V = table_host_f32(model.item_factors)
+    qU = QuantizedFactors(*_quantize_rows(U, quant), quant=quant)
+    qV = QuantizedFactors(*_quantize_rows(V, quant), quant=quant)
+    if parity_floor and parity_sample > 0:
+        ndcg = serving_quant_ndcg(U, V, qU, qV, model.n_items,
+                                  k=parity_k, sample=parity_sample,
+                                  seed=seed)
+        if ndcg < parity_floor:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "serving_quant=%s parity probe failed (NDCG@%d %.4f "
+                "< %.2f vs f32); keeping full-precision serving "
+                "tables (auto-off)", quant, parity_k, ndcg,
+                parity_floor)
+            return model
+    return dataclasses.replace(model, user_factors=qU, item_factors=qV)
+
+
 # -- serving ----------------------------------------------------------------
+
+#: process-wide serving top-k override (None = the autotune table);
+#: set per deploy from ``ServerConfig.serving_topk`` — an explicit
+#: "fused" on a CPU host is a debugging/test run and exercises the
+#: interpret-mode kernel, mirroring ``gram_mode="fused"``
+_serving_topk_override: Optional[str] = None
+
+
+def set_serving_topk_mode(mode: Optional[str]) -> None:
+    """Pin the batched-lane top-k realization ("einsum" | "fused");
+    None/"auto" returns control to the support-gated autotune table
+    (``ops/gram_autotune.best_topk_mode``)."""
+    global _serving_topk_override
+    if mode in (None, "", "auto"):
+        _serving_topk_override = None
+        return
+    if mode not in ("einsum", "fused"):
+        raise ValueError(
+            f"serving topk mode must be 'auto', 'einsum' or 'fused', "
+            f"got {mode!r}")
+    _serving_topk_override = mode
+
+
+def resolved_topk_mode(rank: int, quant: str = "off") -> str:
+    """The concrete serving top-k realization ("einsum" | "fused") for
+    the attached backend — the ``mode`` label of the
+    ``pio_serving_kernel`` info gauge (docs/observability.md)."""
+    if _serving_topk_override is not None:
+        return _serving_topk_override
+    from ..ops.gram_autotune import best_topk_mode
+
+    return best_topk_mode(rank, "f32" if quant in (None, "off")
+                          else quant)
+
 
 @functools.partial(jax.jit, static_argnames=("k", "n_items"))
 def _topk_scores(user_vecs: jax.Array, item_factors: jax.Array,
@@ -1448,18 +1678,66 @@ def _topk_scores(user_vecs: jax.Array, item_factors: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_items"))
-def _serve_topk(user_factors: jax.Array, item_factors: jax.Array,
-                idx: jax.Array, *, k: int, n_items: int
-                ) -> Tuple[jax.Array, jax.Array]:
+def _serve_topk(user_factors, item_factors, idx: jax.Array, *, k: int,
+                n_items: int) -> Tuple[jax.Array, jax.Array]:
     """The WHOLE serving dispatch as one compiled program: user-row
     gather + [B, r]×[n_pad, r]ᵀ matmul + pad mask + top_k. Eagerly these
     were 4-5 separate dispatches, each a round trip through the device
     tunnel — fused, a query pays one dispatch and one fetch (measured:
-    the per-query device path's p50 dropped ~4x)."""
+    the per-query device path's p50 dropped ~4x).
+
+    Tables may be :class:`QuantizedFactors`: rows upcast to f32 (and
+    per-row scales apply) INSIDE the program, so the dot accumulates
+    f32 while HBM holds int8/bf16 — the einsum realization of the
+    serving-quant co-design. This is also the XLA reference the fused
+    kernel (``ops/fused_topk.py``) is held exact against."""
+    ud, us = _table_leaves(user_factors)
+    vd, vs = _table_leaves(item_factors)
     # ptpu: allow[materialized-gather] — a [B, r] serving row fetch
     # (no history axis): bounded by the micro-batcher's pow2 batch cap
-    vecs = user_factors[idx]
-    return _topk_scores(vecs, item_factors, k=k, n_items=n_items)
+    vecs = ud[idx]
+    if vecs.dtype != jnp.float32:
+        vecs = vecs.astype(jnp.float32)
+    if us is not None:
+        # ptpu: allow[materialized-gather] — [B]-bounded scale fetch
+        vecs = vecs * us.reshape(-1)[idx][:, None]
+    if vd.dtype != jnp.float32:
+        vd = vd.astype(jnp.float32)
+    scores = vecs @ vd.T
+    if vs is not None:
+        # per-row item scales factor out of the dot: score[b,i] =
+        # (vec·q_i)·s_i — applied to the [B, n_pad] product, never as
+        # a dequantized f32 copy of the table
+        scores = scores * vs.reshape(1, -1)
+    n_pad = vd.shape[0]
+    mask = jnp.arange(n_pad) < n_items
+    scores = jnp.where(mask[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def _device_topk(user_table, item_table, idx: np.ndarray, k_dev: int,
+                 n_items: int) -> Tuple[jax.Array, jax.Array]:
+    """The single-device batched top-k dispatch switch (ISSUE 13):
+    routes to the fused gather→score→top-k Pallas kernel
+    (``ops/fused_topk.py`` — the [B, I] score matrix never lands in
+    HBM) when the autotune table resolves "fused" and the compiled k
+    fits the on-chip merge, else the :func:`_serve_topk` einsum
+    program. Both realizations share tie semantics (descending score,
+    lowest id first), so the switch is invisible to callers."""
+    from ..ops.fused_topk import TOPK_MAX_K, fused_topk_dispatch
+
+    vd, vs = _table_leaves(item_table)
+    mode = resolved_topk_mode(int(vd.shape[-1]), table_quant(item_table))
+    if mode == "fused" and 1 <= k_dev <= TOPK_MAX_K:
+        ud, us = _table_leaves(user_table)
+        # the index stays uncommitted numpy (int32 — the kernel's SMEM
+        # staging dtype): the jitted kernel places it, no eager
+        # host→device hop for the transfer guard to flag
+        return fused_topk_dispatch(ud, np.asarray(idx, dtype=np.int32),
+                                   vd, us, vs, k=k_dev,
+                                   n_items=n_items)
+    return _serve_topk(user_table, item_table, idx, k=k_dev,
+                       n_items=n_items)
 
 
 #: serializes SHARDED serving dispatches process-wide. The mesh program
@@ -1477,6 +1755,8 @@ def _is_row_sharded(arr) -> bool:
     """True when ``arr`` is a jax array whose rows are spread across
     more than one device (a :func:`shard_model` table) — its gathers
     must be GSPMD-resolved, never a host ``np.asarray``."""
+    if isinstance(arr, QuantizedFactors):
+        arr = arr.data
     sharding = getattr(arr, "sharding", None)
     if sharding is None:
         return False
@@ -1499,18 +1779,41 @@ def _gather_rows_fn(mesh: Mesh):
                    out_shardings=NamedSharding(mesh, P()))
 
 
+@functools.lru_cache(maxsize=16)
+def _gather_vecs_fn(mesh: Mesh, has_scale: bool):
+    """Quantized twin of :func:`_gather_rows_fn`: cross-shard row
+    gather PLUS on-the-fly dequantization (upcast + per-row scale),
+    output replicated — the int8/bf16 rows are what cross the ICI."""
+    if has_scale:
+        # ptpu: allow[materialized-gather] — [B, r] cross-shard row
+        # fetch bounded by the serving batch (dequantized in-program)
+        fn = (lambda table, scale, idx:
+              table[idx].astype(jnp.float32) * scale[idx].reshape(-1, 1))
+    else:
+        # ptpu: allow[materialized-gather] — same [B, r] row fetch
+        fn = lambda table, scale, idx: table[idx].astype(jnp.float32)
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
+
+
 def _user_vecs(user_factors, user_indices: np.ndarray, mesh: Mesh):
-    """[B, r] query vectors for the sharded ranker, replicated over the
-    mesh. Row-sharded tables gather via GSPMD collectives (the table
-    never exists on one device); host/np tables gather locally. Host
+    """[B, r] f32 query vectors for the sharded ranker, replicated over
+    the mesh. Row-sharded tables gather via GSPMD collectives (the
+    table never exists on one device) — quantized tables dequantize
+    inside the same program; host/np tables gather locally. Host
     inputs stay UNCOMMITTED numpy so the mesh program places them
     itself — a ``jnp.asarray`` here would commit to device 0 and every
     dispatch would pay (and the transfer guard would flag) a
     device-to-device hop."""
     idx = np.asarray(user_indices, dtype=np.int64)
-    if _is_row_sharded(user_factors):
-        return _gather_rows_fn(mesh)(user_factors, idx)
-    return np.asarray(user_factors)[idx]
+    ud, us = _table_leaves(user_factors)
+    if _is_row_sharded(ud):
+        if not isinstance(user_factors, QuantizedFactors):
+            return _gather_rows_fn(mesh)(ud, idx)
+        return _gather_vecs_fn(mesh, us is not None)(ud, us, idx)
+    host = np.asarray(ud)[idx].astype(np.float32)
+    if us is not None:
+        host = host * np.asarray(us).reshape(-1)[idx][:, None]
+    return host
 
 
 def recommend_batch_sharded(user_factors, item_factors,
@@ -1533,49 +1836,100 @@ def recommend_batch_sharded(user_factors, item_factors,
     ties measure-zero). Returns host (ids, scores) of shape [B, k].
     """
     n_dev = mesh.devices.size
-    n_pad = item_factors.shape[0]
+    vd, _ = _table_leaves(item_factors)
+    n_pad = vd.shape[0]
     if n_pad % n_dev:
         raise ValueError(f"item rows {n_pad} not divisible by mesh size "
                          f"{n_dev}; pad factors to a device multiple "
                          f"(shard_model does)")
-    k_local = min(k, n_pad // n_dev)
-    ranked = _sharded_rank_fn(mesh, k, k_local, n_items)
     with _mesh_dispatch_lock:
         vecs = _user_vecs(user_factors, user_indices, mesh)
         # item_factors passes through UNPLACED when it is host data:
         # the mesh program shards it per in_specs; an eager jnp.asarray
         # would commit the whole table to device 0 first.
-        # ptpu: allow[callback-under-lock] — `ranked` is a compiled XLA
-        # executable (jit of shard_map), not user code: it cannot
-        # re-enter this lock, and serializing the launch is the lock's
-        # entire purpose (concurrent mesh-collective launches deadlock)
-        ids, scores = ranked(vecs, item_factors)
+        ids, scores = _rank_sharded(mesh, vecs, item_factors, k,
+                                    n_items)
         kk = min(k, n_items)
         ids, scores = jax.device_get((ids, scores))
     return ids[:, :kk], scores[:, :kk]
 
 
+def _rank_sharded(mesh: Mesh, vecs, item_factors, k_dev: int,
+                  n_items: int):
+    """Launch the sharded ranking program for replicated [B, r] query
+    vectors against a (possibly quantized) row-sharded item table —
+    the shared entry of :func:`recommend_batch_sharded`,
+    :func:`_dispatch_topk_chunk` and :func:`recommend_pinned`.
+    Resolves the per-shard top-k realization (einsum vs the fused
+    kernel) ONCE per (mesh, shape) via the compile-once cache.
+    Callers hold ``_mesh_dispatch_lock``."""
+    from ..ops.fused_topk import TOPK_MAX_K
+
+    vd, vs = _table_leaves(item_factors)
+    n_pad = vd.shape[0]
+    k_local = min(k_dev, n_pad // mesh.devices.size)
+    quant = table_quant(item_factors)
+    mode = resolved_topk_mode(int(vd.shape[-1]), quant)
+    if not (1 <= k_local <= TOPK_MAX_K):
+        mode = "einsum"  # the on-chip merge carries k ≤ TOPK_MAX_K
+    ranked = _sharded_rank_fn(mesh, k_dev, k_local, n_items, quant,
+                              mode)
+    # ptpu: allow[callback-under-lock] — `ranked` is a compiled XLA
+    # executable (jit of shard_map), not user code: it cannot re-enter
+    # the dispatch lock, and serializing the launch is the lock's
+    # entire purpose (concurrent mesh-collective launches deadlock)
+    if vs is None:
+        return ranked(vecs, vd)
+    return ranked(vecs, vd, vs)
+
+
 @functools.lru_cache(maxsize=64)
-def _sharded_rank_fn(mesh: Mesh, k: int, k_local: int, n_items: int):
+def _sharded_rank_fn(mesh: Mesh, k: int, k_local: int, n_items: int,
+                     quant: str = "off", topk_mode: str = "einsum"):
     """Compile-once cache for the sharded serving program (a fresh
     closure per call would defeat the jit cache and recompile the mesh
     program on every serving batch). Keyed on (mesh, k, k_local,
-    n_items); shapes key the inner jit cache as usual. Axis names come
-    from the mesh, so the same program serves a ``(data, model)``
-    training mesh and the ``(batch, model)`` serving mesh."""
+    n_items, quant, topk_mode); shapes key the inner jit cache as
+    usual. Axis names come from the mesh, so the same program serves a
+    ``(data, model)`` training mesh and the ``(batch, model)`` serving
+    mesh.
+
+    Each shard ranks its LOCAL item rows — through the fused
+    gather→score→top-k kernel when ``topk_mode="fused"`` (the shard's
+    [B, n_local] score block never lands in HBM; the shard's global id
+    origin rides in as the kernel's ``base``), else the einsum + local
+    top_k baseline with int8/bf16 rows dequantized in-program — then
+    the per-shard candidates all-gather and reduce to the global
+    top-k, exactly as before."""
     from ..parallel.collectives import shard_map_compat
 
     axes = tuple(mesh.axis_names)
+    has_scale = quant == "int8"
 
-    def local_rank(vecs, itf_local):
-        scores = vecs @ itf_local.T          # [B, n_local]
+    def local_rank(vecs, itf_local, isc_local=None):
+        n_local = itf_local.shape[0]
         shard = jax.lax.axis_index(axes)
-        base = shard * itf_local.shape[0]
-        local_ids = base + jnp.arange(itf_local.shape[0])
-        scores = jnp.where((local_ids < n_items)[None, :], scores,
-                           -jnp.inf)
-        s, i = jax.lax.top_k(scores, k_local)
-        gid = jnp.take(local_ids, i)
+        base = shard * n_local
+        if topk_mode == "fused":
+            from ..ops.fused_topk import fused_topk_dispatch
+
+            uscale = jnp.ones((vecs.shape[0], 1), jnp.float32) \
+                if has_scale else None  # vecs arrive dequantized
+            s, gid = fused_topk_dispatch(
+                vecs, jnp.arange(vecs.shape[0], dtype=jnp.int32),
+                itf_local, uscale, isc_local, base, k=k_local,
+                n_items=n_items)
+        else:
+            itf = itf_local.astype(jnp.float32) \
+                if itf_local.dtype != jnp.float32 else itf_local
+            scores = vecs @ itf.T            # [B, n_local]
+            if isc_local is not None:
+                scores = scores * isc_local.reshape(1, -1)
+            local_ids = base + jnp.arange(n_local)
+            scores = jnp.where((local_ids < n_items)[None, :], scores,
+                               -jnp.inf)
+            s, i = jax.lax.top_k(scores, k_local)
+            gid = jnp.take(local_ids, i)
         # gather the candidate sets along the candidate axis
         s_all = jax.lax.all_gather(s, axes, axis=1,
                                    tiled=True)  # [B, k_local*n_dev]
@@ -1584,9 +1938,13 @@ def _sharded_rank_fn(mesh: Mesh, k: int, k_local: int, n_items: int):
         return jnp.take_along_axis(g_all, pos, axis=1)[:, :k], \
             s2[:, :k]
 
+    spec = rows_spec(mesh)
+    if has_scale:
+        return jax.jit(shard_map_compat(
+            local_rank, mesh, in_specs=(P(), spec, spec),
+            out_specs=(P(), P()), check=False))
     return jax.jit(shard_map_compat(
-        local_rank, mesh,
-        in_specs=(P(), rows_spec(mesh)),
+        local_rank, mesh, in_specs=(P(), spec),
         out_specs=(P(), P()), check=False))
 
 
@@ -1650,8 +2008,15 @@ def ensure_device_resident(model: ALSModel,
 
     if _serve_on_host(model, batch=max(max_batch, 1)):
         return model
-    if isinstance(model.user_factors, np.ndarray) \
-            or isinstance(model.item_factors, np.ndarray):
+
+    def _has_host_leaf(t) -> bool:
+        return any(isinstance(leaf, np.ndarray)
+                   for leaf in jax.tree_util.tree_leaves(t))
+
+    if _has_host_leaf(model.user_factors) \
+            or _has_host_leaf(model.item_factors):
+        # device_put maps over pytrees, so quantized tables move their
+        # int8/bf16 data + f32 scale leaves in one shot
         return dataclasses.replace(
             model,
             user_factors=jax.device_put(model.user_factors),
@@ -1683,16 +2048,28 @@ def shard_model(model: ALSModel, mesh: Mesh) -> ALSModel:
 
     n_dev = mesh.devices.size
     spec = NamedSharding(mesh, rows_spec(mesh))
-    U = np.asarray(model.user_factors) \
-        if isinstance(model.user_factors, np.ndarray) \
-        else jax.device_get(model.user_factors)
-    V = np.asarray(model.item_factors) \
-        if isinstance(model.item_factors, np.ndarray) \
-        else jax.device_get(model.item_factors)
+
+    def _place(t):
+        if isinstance(t, QuantizedFactors):
+            # quantized tables shard leaf-wise: int8/bf16 data and the
+            # [n, 1] f32 scales land row-sharded together, so a shard
+            # can dequantize its rows with no cross-device fetch
+            data = np.asarray(jax.device_get(t.data))
+            sc = None if t.scale is None \
+                else np.asarray(jax.device_get(t.scale))
+            return QuantizedFactors(
+                jax.device_put(_pad_rows(data, n_dev), spec),
+                None if sc is None
+                else jax.device_put(_pad_rows(sc, n_dev), spec),
+                t.quant)
+        arr = np.asarray(t) if isinstance(t, np.ndarray) \
+            else jax.device_get(t)
+        return jax.device_put(_pad_rows(np.asarray(arr), n_dev), spec)
+
     return dataclasses.replace(
         model,
-        user_factors=jax.device_put(_pad_rows(np.asarray(U), n_dev), spec),
-        item_factors=jax.device_put(_pad_rows(np.asarray(V), n_dev), spec),
+        user_factors=_place(model.user_factors),
+        item_factors=_place(model.item_factors),
         mesh=mesh)
 
 
@@ -1733,11 +2110,32 @@ def pin_user_rows(model: ALSModel, user_indices: Sequence[int],
     n = min(len(user_indices), cap)
     idx[:n] = np.asarray(list(user_indices)[:n], dtype=np.int64)
     mesh = getattr(model, "mesh", None)
+    quant = isinstance(model.user_factors, QuantizedFactors)
+    ud, us = _table_leaves(model.user_factors)
     if mesh is not None:
         with _mesh_dispatch_lock:
-            rows_dev = _gather_rows_fn(mesh)(model.user_factors, idx)
+            # quantized models pin a QUANTIZED table (the hot tier
+            # inherits the 4x capacity win); the collective gather
+            # moves int8/bf16 rows + f32 scales, never a dequant copy
+            rows_dev = _gather_rows_fn(mesh)(ud, idx)
+            sc_dev = _gather_rows_fn(mesh)(us, idx) \
+                if us is not None else None
             rows_dev.block_until_ready()
+        if quant:
+            pinned = QuantizedFactors(rows_dev, sc_dev,
+                                      model.user_factors.quant)
+            return pinned, pinned.nbytes
         return rows_dev, int(rows_dev.nbytes)
+    if quant:
+        data = np.asarray(jax.device_get(ud))[idx]
+        sc = np.asarray(jax.device_get(us))[idx] \
+            if us is not None else None
+        pinned = QuantizedFactors(
+            jax.device_put(data),
+            None if sc is None else jax.device_put(sc),
+            model.user_factors.quant)
+        pinned.data.block_until_ready()
+        return pinned, pinned.nbytes
     rows = np.asarray(model.user_factors)[idx]  # one host gather per
     pinned = jax.device_put(rows)               # refresh, not per query
     pinned.block_until_ready()
@@ -1759,6 +2157,20 @@ def pin_user_rows_lanes(model: ALSModel, user_indices: Sequence[int],
     idx = np.zeros(cap, dtype=np.int64)
     n = min(len(user_indices), cap)
     idx[:n] = np.asarray(list(user_indices)[:n], dtype=np.int64)
+    if isinstance(model.user_factors, QuantizedFactors):
+        ud, us = _table_leaves(model.user_factors)
+        data = np.asarray(jax.device_get(ud))[idx]
+        sc = np.asarray(jax.device_get(us))[idx] \
+            if us is not None else None
+        tables = tuple(
+            QuantizedFactors(
+                jax.device_put(data, d),
+                None if sc is None else jax.device_put(sc, d),
+                model.user_factors.quant)
+            for d in devices)
+        for t in tables:
+            t.data.block_until_ready()
+        return tables, tables[0].nbytes * len(tables)
     rows = np.asarray(model.user_factors)[idx]
     tables = tuple(jax.device_put(rows, d) for d in devices)
     for t in tables:
@@ -1782,9 +2194,9 @@ def recommend_pinned(model: ALSModel, pinned, slot: int,
     if isinstance(pinned, tuple):
         chosen = pinned[0]
         try:
-            devs = model.item_factors.devices()
+            devs = _table_leaves(model.item_factors)[0].devices()
             for t in pinned:
-                if t.devices() == devs:
+                if _table_leaves(t)[0].devices() == devs:
                     chosen = t
                     break
         except Exception:  # noqa: BLE001 — host-resident factors place
@@ -1793,25 +2205,26 @@ def recommend_pinned(model: ALSModel, pinned, slot: int,
     mesh = getattr(model, "mesh", None)
     if mesh is not None:
         k_dev = _compiled_k(k, model.n_items)
-        n_pad = model.item_factors.shape[0]
-        k_local = min(k_dev, n_pad // mesh.devices.size)
-        ranked = _sharded_rank_fn(mesh, k_dev, k_local, model.n_items)
         with _mesh_dispatch_lock:
             # ptpu: allow[callback-under-lock] — compiled XLA
             # executables (jitted gather + mesh ranker); they cannot
             # re-enter, and the lock exists to serialize their launch
-            vec = _gather_rows_fn(mesh)(
-                pinned, np.asarray([slot], dtype=np.int64))  # [1, r]
-            # ptpu: allow[callback-under-lock] — same compiled ranker
-            ids, scores = ranked(vec, model.item_factors)
+            pd, ps = _table_leaves(pinned)
+            sidx = np.asarray([slot], dtype=np.int64)
+            if isinstance(pinned, QuantizedFactors):
+                vec = _gather_vecs_fn(mesh, ps is not None)(pd, ps,
+                                                            sidx)
+            else:
+                vec = _gather_rows_fn(mesh)(pd, sidx)  # [1, r]
+            ids, scores = _rank_sharded(mesh, vec, model.item_factors,
+                                        k_dev, model.n_items)
             k = min(k, model.n_items)
             ids, scores = jax.device_get((ids, scores))
         return ids[0][:k], scores[0][:k]
     k_dev = _compiled_k(k, model.n_items)
-    scores, ids = _serve_topk(
-        pinned, jnp.asarray(model.item_factors),
-        np.asarray([slot], dtype=np.int64),
-        k=k_dev, n_items=model.n_items)
+    scores, ids = _device_topk(
+        pinned, model.item_factors,
+        np.asarray([slot], dtype=np.int64), k_dev, model.n_items)
     k = min(k, model.n_items)
     ids, scores = jax.device_get((ids, scores))
     return ids[0][:k], scores[0][:k]
@@ -1835,10 +2248,10 @@ def recommend_products(model: ALSModel, user_index: int, k: int
     k_dev = _compiled_k(k, model.n_items)
     # the index stays uncommitted numpy: jit places it beside the
     # (possibly lane-committed) factors with no device-to-device hop
-    scores, ids = _serve_topk(
-        jnp.asarray(model.user_factors), jnp.asarray(model.item_factors),
-        np.asarray([user_index], dtype=np.int64),
-        k=k_dev, n_items=model.n_items)
+    scores, ids = _device_topk(
+        model.user_factors, model.item_factors,
+        np.asarray([user_index], dtype=np.int64), k_dev,
+        model.n_items)
     k = min(k, model.n_items)
     ids, scores = jax.device_get((ids, scores))
     return ids[0][:k], scores[0][:k]
@@ -1879,27 +2292,20 @@ def _dispatch_topk_chunk(model: ALSModel, user_indices: np.ndarray,
     mesh = getattr(model, "mesh", None)
     if mesh is not None:
         n_dev = mesh.devices.size
-        n_pad = model.item_factors.shape[0]
+        n_pad = _table_leaves(model.item_factors)[0].shape[0]
         if n_pad % n_dev:
             raise ValueError(
                 f"item rows {n_pad} not divisible by mesh size "
                 f"{n_dev}; pad factors to a device multiple "
                 f"(shard_model does)")
-        k_local = min(k_dev, n_pad // n_dev)
-        ranked = _sharded_rank_fn(mesh, k_dev, k_local, model.n_items)
         with _mesh_dispatch_lock:
             vecs = _user_vecs(model.user_factors, idx_dev, mesh)
-            # ptpu: allow[callback-under-lock] — `ranked` is a compiled
-            # XLA executable (jit of shard_map), not user code: it
-            # cannot re-enter this lock, and serializing the launch is
-            # the lock's entire purpose (concurrent mesh-collective
-            # launches deadlock)
-            ids, scores = ranked(vecs, model.item_factors)
+            ids, scores = _rank_sharded(mesh, vecs, model.item_factors,
+                                        k_dev, model.n_items)
     else:
-        scores, ids = _serve_topk(
-            jnp.asarray(model.user_factors),
-            jnp.asarray(model.item_factors),
-            idx_dev, k=k_dev, n_items=model.n_items)
+        scores, ids = _device_topk(
+            model.user_factors, model.item_factors, idx_dev, k_dev,
+            model.n_items)
 
     def resolve() -> Tuple[np.ndarray, np.ndarray]:
         i, s = jax.device_get((ids, scores))
@@ -1962,9 +2368,18 @@ def recommend_batch(model: ALSModel, user_indices: np.ndarray, k: int
     return recommend_batch_async(model, user_indices, k)()
 
 
+def _host_row_f32(t, i: int) -> np.ndarray:
+    """One factor row as host f32, dequantizing if needed."""
+    data, scale = _table_leaves(t)
+    row = np.asarray(jax.device_get(data[i])).astype(np.float32)
+    if scale is not None:
+        row = row * float(np.asarray(jax.device_get(scale[i]))[0])
+    return row
+
+
 def predict_rating(model: ALSModel, user_index: int, item_index: int) -> float:
-    u = np.asarray(model.user_factors[user_index])
-    v = np.asarray(model.item_factors[item_index])
+    u = _host_row_f32(model.user_factors, user_index)
+    v = _host_row_f32(model.item_factors, item_index)
     return float(u @ v)
 
 
@@ -2009,7 +2424,10 @@ def fixed_gramian(fixed, params: "ALSParams"):
     Explicit models need none — returns None."""
     if not params.implicit_prefs:
         return None
-    arr = jnp.asarray(fixed)
+    # a quantized serving table dequantizes once here (elementwise —
+    # sharding preserved): fold-in math stays f32 against the same
+    # values serving scores with
+    arr = jnp.asarray(dequantize_table(fixed))
     bf16 = params.matmul_dtype == "bfloat16"
     if _is_row_sharded(arr):
         with _mesh_dispatch_lock:  # the reduction launches collectives
@@ -2060,7 +2478,10 @@ def fold_in_rows(fixed, indices: np.ndarray, values: np.ndarray,
     cnt[0, :B] = counts
     implicit = params.implicit_prefs
     bf16 = params.matmul_dtype == "bfloat16"
-    table = jnp.asarray(fixed)
+    # quantized serving tables (ISSUE 13) dequantize for the solve —
+    # the fold-in's normal equations stay f32 against the values the
+    # table actually serves
+    table = jnp.asarray(dequantize_table(fixed))
 
     def _solve():
         nonlocal G
@@ -2097,9 +2518,11 @@ def _scatter_rows(table: jax.Array, row_idx: np.ndarray,
     idx = np.empty(Bp, dtype=np.int64)
     idx[:B] = row_idx
     idx[B:] = row_idx[0] if B else 0
-    vals = np.empty((Bp, rows.shape[-1]), dtype=np.float32)
+    # rows keep their own dtype (int8/bf16 for re-quantized hot-swap
+    # rows; f32 otherwise) — the jitted set casts to the table's
+    vals = np.empty((Bp, rows.shape[-1]), dtype=rows.dtype)
     vals[:B] = rows
-    vals[B:] = rows[0] if B else 0.0
+    vals[B:] = rows[0] if B else 0
     return _scatter_rows_fn(jnp.asarray(table), idx, vals)
 
 
@@ -2132,6 +2555,38 @@ def apply_row_updates(model: ALSModel, side: str, row_idx: np.ndarray,
     rows = np.asarray(rows, dtype=np.float32)
     if len(row_idx) == 0:
         return model
+    if isinstance(table, QuantizedFactors):
+        # streaming hot-swap into a quantized serving table (ISSUE 13):
+        # the freshly solved f32 rows RE-QUANTIZE on the way in — data
+        # and per-row scales swap together, so a swapped row serves
+        # with its own scale, never a stale one
+        qd, qs = _quantize_rows(rows, table.quant)
+
+        def _swap_leaves(data_new, scale_new):
+            return dataclasses.replace(model, **{name: QuantizedFactors(
+                data_new, scale_new, table.quant)})
+
+        if isinstance(table.data, np.ndarray):
+            data = table.data.copy()
+            data[row_idx] = qd
+            scale = None
+            if table.scale is not None:
+                scale = table.scale.copy()
+                scale[row_idx] = qs
+            return _swap_leaves(data, scale)
+        if _is_row_sharded(table.data):
+            with _mesh_dispatch_lock:
+                data = _scatter_rows(table.data, row_idx, qd)
+                data.block_until_ready()
+                scale = None
+                if table.scale is not None:
+                    scale = _scatter_rows(table.scale, row_idx, qs)
+                    scale.block_until_ready()
+            return _swap_leaves(data, scale)
+        data = _scatter_rows(table.data, row_idx, qd)
+        scale = _scatter_rows(table.scale, row_idx, qs) \
+            if table.scale is not None else None
+        return _swap_leaves(data, scale)
     if isinstance(table, np.ndarray):
         new = table.copy()
         new[row_idx] = rows
@@ -2189,23 +2644,36 @@ def extend_factor_rows(model: ALSModel, side: str, new_keys: Sequence[str],
     if n_after > capacity:
         grow = _pow2_ceil(max(n_after - capacity, COLD_START_GROW_MIN))
         mesh = getattr(model, "mesh", None)
-        if isinstance(table, np.ndarray):
-            table = np.vstack([table, np.zeros((grow, table.shape[-1]),
-                                               table.dtype)])
-        elif mesh is not None and _is_row_sharded(table):
-            # sharded growth: pull the shards together once, extend to
-            # a device multiple, re-place row-sharded (the same
-            # placement shard_model derives)
-            host = jax.device_get(table)
-            n_dev = mesh.devices.size
-            host = np.vstack([host, np.zeros((grow, host.shape[-1]),
-                                             host.dtype)])
-            host = _pad_rows(host, n_dev)
-            table = jax.device_put(
-                host, NamedSharding(mesh, rows_spec(mesh)))
+
+        def _grow_arr(arr, grow_n, fill):
+            if isinstance(arr, np.ndarray):
+                extra = np.full((grow_n,) + arr.shape[1:], fill,
+                                arr.dtype)
+                return np.concatenate([arr, extra], axis=0)
+            if mesh is not None and _is_row_sharded(arr):
+                # sharded growth: pull the shards together once,
+                # extend to a device multiple, re-place row-sharded
+                # (the same placement shard_model derives)
+                host = jax.device_get(arr)
+                host = np.concatenate(
+                    [host, np.full((grow_n,) + host.shape[1:], fill,
+                                   host.dtype)], axis=0)
+                host = _pad_rows(host, mesh.devices.size)
+                return jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+            pad = jnp.full((grow_n,) + arr.shape[1:], fill, arr.dtype)
+            return jnp.concatenate([jnp.asarray(arr), pad], axis=0)
+
+        if isinstance(table, QuantizedFactors):
+            # claimed rows are re-quantized by the apply below; the
+            # fresh capacity carries zero rows with scale 1 (inert)
+            table = QuantizedFactors(
+                _grow_arr(table.data, grow, 0),
+                None if table.scale is None
+                else _grow_arr(table.scale, grow, 1.0),
+                table.quant)
         else:
-            pad = jnp.zeros((grow, table.shape[-1]), table.dtype)
-            table = jnp.concatenate([jnp.asarray(table), pad], axis=0)
+            table = _grow_arr(table, grow, 0)
     fwd = dict(ids.items()) if ids is not None else {}
     for i, k in enumerate(new_keys):
         fwd[k] = n_real + i

@@ -216,6 +216,24 @@ class ServerConfig:
     #: PTPU_DEBUG_LOCKS=1 env var enables it without a config change
     #: (the staging runbook path, docs/operations.md).
     debug_locks: bool = False
+    #: Row-quantized serving factor tables (ISSUE 13,
+    #: docs/kernels.md): "int8" stores per-row-scaled int8 factors
+    #: (~4x more users per HBM, ~4x less bandwidth per scored batch),
+    #: "bf16" halves both — dequantized on the fly with f32
+    #: accumulation (Tensor-Casting precision co-design). Guarded by a
+    #: deploy-time NDCG@10 parity probe that auto-falls-back to f32
+    #: when the model's rank/scale cannot take the quantization, so
+    #: the knob can never silently degrade ranking. "off" serves f32.
+    serving_quant: str = "off"
+    #: Batched-lane top-k realization: "fused" = the Pallas
+    #: gather→score→top-k kernel (ops/fused_topk.py — the [B, I]
+    #: score matrix never lands in HBM), "einsum" = the XLA matmul +
+    #: top_k baseline, "auto" = the persistent autotune table
+    #: (gram_autotune.best_topk_mode), support-gated so "fused" never
+    #: resolves where the kernel cannot lower. An explicit "fused" on
+    #: a CPU host runs the interpret-mode kernel (a debugging/A-B
+    #: configuration, mirroring gram_mode="fused").
+    serving_topk: str = "auto"
     #: Mesh-wide serving (ISSUE 6, docs/sharded-serving.md):
     #: "single" — today's one-device path; "replicated" — a full model
     #: copy per device, the micro-batcher fans micro-batches out
@@ -523,8 +541,10 @@ class QueryServer:
             "1 once the serving shapes are pre-compiled",
             fn=lambda: 1.0 if self.warm_done.is_set() else 0.0)
         # the initial _bind ran before this registry existed; record
-        # the resolved gram mode now (rebinds re-record inside _bind)
+        # the resolved gram + serving-kernel modes now (rebinds
+        # re-record inside _bind)
         self._record_gram_mode()
+        self._record_serving_kernel()
         if self.cache is not None:
             self.cache.register_metrics(self.metrics)
         if locks_instrumented():
@@ -632,6 +652,36 @@ class QueryServer:
             for algo in self.algorithms:
                 algo.bind_serving(self.ctx)
                 self._bind_feature_cache(algo)
+            # serving fast path knobs (ISSUE 13): pin the batched-lane
+            # top-k realization for this deploy (validates the value —
+            # a bad config fails the deploy, not the first query) and
+            # row-quantize the serving tables BEFORE device placement,
+            # so the host→HBM transfer already moves the small tables.
+            # The quantize hook runs its NDCG parity probe and returns
+            # the f32 model unchanged where quantization loses ranking
+            # (auto-off).
+            from ..models.als import set_serving_topk_mode
+
+            if self.config.serving_quant not in ("off", "bf16", "int8"):
+                raise ValueError(
+                    f"serving_quant must be 'off', 'bf16' or 'int8', "
+                    f"got {self.config.serving_quant!r}")
+            set_serving_topk_mode(self.config.serving_topk)
+            if self.config.serving_quant != "off":
+                quantized = []
+                for a, m in zip(self.algorithms, models):
+                    q = getattr(a, "quantize_serving_model", None)
+                    if q is None:
+                        quantized.append(m)
+                        continue
+                    # bind-time only (deploy/reload/promote, never a
+                    # query): the quantize hook is a pure table
+                    # rewrite with the same atomic-swap contract as
+                    # the prepare_serving_model calls below; it
+                    # cannot re-enter the binding lock.
+                    # ptpu: allow[callback-under-lock]
+                    quantized.append(q(m, self.config.serving_quant))
+                models = quantized
             # fix device placement ONCE at bind (deploy/reload), not
             # per query — a re-materialized model holds numpy factors
             bind_batch = self.config.max_batch if self.config.batching \
@@ -645,6 +695,9 @@ class QueryServer:
             # lowering, and the result must be recorded inside the
             # same swap that installs the binding it describes
             self._record_gram_mode()
+            # ptpu: allow[blocking-under-lock] — same bind-time-only
+            # contract for the serving-kernel resolution probe
+            self._record_serving_kernel()
             # mesh-wide placement (ISSUE 6): resolve the serving mode
             # against the live devices and the model's resident bytes,
             # then either fan the binding out as per-device lane copies
@@ -692,6 +745,54 @@ class QueryServer:
             fam.labels(mode=mode).set(1.0)
         except Exception:  # noqa: BLE001 — telemetry must not block a
             pass           # deploy/reload/promote
+
+    # ptpu: guarded-by[_lock] — only ever called from _bind under the
+    # binding lock (the gauge family itself is thread-safe)
+    def _record_serving_kernel(self) -> None:
+        """Refresh the ``pio_serving_kernel`` info gauge (ISSUE 13):
+        the batched-lane top-k realization × serving-quant dtype the
+        bound models resolve to on THIS backend (autotune table +
+        Pallas lowering support, ``models/als.resolved_topk_mode``)
+        reads 1; stale labels from a prior bind drop to 0 — a deploy
+        that quietly fell off the fused kernel or auto-disabled
+        quantization is visible on /metrics, not just in bench
+        lines. Sits next to ``pio_gram_mode``."""
+        if getattr(self, "metrics", None) is None:
+            return  # constructor's initial _bind; __init__ re-records
+        try:
+            from ..models.als import resolved_topk_mode, serving_quant_of
+
+            mode = quant = None
+            for algo, model in zip(self.algorithms, self.models):
+                p = getattr(algo, "params", None)
+                if p is not None and hasattr(p, "rank"):
+                    quant = serving_quant_of(model)
+                    mode = resolved_topk_mode(int(p.rank), quant)
+                    break
+            if mode is None:
+                return
+            fam = self.metrics.gauge(
+                "pio_serving_kernel",
+                "Resolved serving top-k realization x quant dtype of "
+                "the bound engine (info gauge: 1 at the active "
+                "labels)")
+            self._serving_kernel_gauge = fam
+            for _, child in fam.children():
+                child.set(0.0)
+            fam.labels(mode=mode, quant=quant).set(1.0)
+            self._serving_kernel = {"mode": mode, "quant": quant}
+        except Exception:  # noqa: BLE001 — telemetry must not block a
+            pass           # deploy/reload/promote
+
+    def serving_kernel_status(self) -> dict:
+        """The resolved serving-kernel block for /status.json: top-k
+        realization, quant dtype, and the configured knobs (resolved
+        may differ — auto-off parity fallback, unsupported kernel)."""
+        out = {"configuredQuant": self.config.serving_quant,
+               "configuredTopk": self.config.serving_topk}
+        out.update(getattr(self, "_serving_kernel", None)
+                   or {"mode": None, "quant": None})
+        return out
 
     @staticmethod
     def _models_nbytes(models: List[Any]) -> Optional[int]:
@@ -1647,8 +1748,20 @@ class QueryServer:
         for algo in algorithms:
             algo.bind_serving(self.ctx)
             self._bind_feature_cache(algo)
+        # the candidate serves under the same quant policy as stable
+        # (an A/B across precision is a config change, not a canary);
+        # raw_models stay unquantized so promote re-derives through
+        # the normal _bind
+        to_prepare = models
+        if self.config.serving_quant != "off":
+            to_prepare = []
+            for a, m in zip(algorithms, models):
+                q = getattr(a, "quantize_serving_model", None)
+                to_prepare.append(
+                    q(m, self.config.serving_quant)
+                    if q is not None else m)
         prepared = [a.prepare_serving_model(m, 1)
-                    for a, m in zip(algorithms, models)]
+                    for a, m in zip(algorithms, to_prepare)]
         with self._lock:
             mode, mesh = self.serving_mode_resolved, self.serving_mesh
         if mode == "sharded" and mesh is not None:
@@ -1895,8 +2008,14 @@ class QueryServer:
             # point of folding rows instead of rebinding
             cache.invalidate_entities("user", touched_entities)
             if cache.hot is not None:
-                cache.hot.invalidate(touched_entities)
-                cache.hot.refresh(wait=False)  # re-pin from new rows
+                # refresh ONLY when the swap actually dropped a pinned
+                # entry: an unconditional refresh re-gathered the full
+                # pinned table and re-warmed its k-ladder on every
+                # fold-in even when no pinned entity was touched
+                # (ISSUE 13 satellite) — pure wasted device work at
+                # streaming cadence
+                if cache.hot.invalidate(touched_entities):
+                    cache.hot.refresh(wait=False)  # re-pin new rows
         return True
 
     def start_stream(self, config=None):
@@ -2316,6 +2435,10 @@ def build_app(server: QueryServer) -> HTTPApp:
                        else {"running": False}),
             "mesh": server.mesh_status(),
             "degraded": server.degraded_status(),
+            # the serving-quant sizing claim is read off these two
+            # blocks together: servingKernel says the wire dtype, hbm
+            # says the resident bytes it produced (docs/kernels.md)
+            "servingKernel": server.serving_kernel_status(),
             "hbm": hbm_stats(),
             "cache": (server.cache.stats() if server.cache is not None
                       else {"enabled": False}),

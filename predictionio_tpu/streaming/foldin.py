@@ -42,6 +42,7 @@ from ..models.als import (
     extend_factor_rows,
     fixed_gramian,
     fold_in_rows,
+    table_host_f32,
 )
 
 log = logging.getLogger(__name__)
@@ -248,8 +249,10 @@ def _batch_residual(model: ALSModel, triples) -> Optional[float]:
     """Mean |u·v − r| over the batch, normalized by max(1, |r|) scale —
     how well the folded rows explain the very events they folded. For
     implicit models the target is preference 1 on observed entries."""
-    U = np.asarray(model.user_factors)
-    V = np.asarray(model.item_factors)
+    # table_host_f32 dequantizes row-quantized serving tables
+    # (ISSUE 13): the residual measures what the table actually serves
+    U = table_host_f32(model.user_factors)
+    V = table_host_f32(model.item_factors)
     errs = []
     for ukey, ikey, r in triples:
         ui = model.user_ids.get(ukey) if model.user_ids else None

@@ -320,6 +320,17 @@ class ALSAlgorithm(Algorithm):
 
         return ensure_device_resident(model, max_batch)
 
+    def quantize_serving_model(self, model: ALSModel,
+                               quant: str) -> ALSModel:
+        """Row-quantize the serving factor tables (ISSUE 13,
+        ``ServerConfig.serving_quant``): int8/bf16 storage with
+        per-row scales and f32 accumulation, behind the deploy-time
+        NDCG@10 parity probe — a model whose rank/scale cannot take
+        the quantization keeps its f32 tables (auto-off)."""
+        from ..models.als import quantize_serving_model
+
+        return quantize_serving_model(model, quant)
+
     # -- mesh-wide serving placement hooks (ISSUE 6) ------------------------
     def replicate_serving_model(self, model: ALSModel,
                                 device) -> ALSModel:

@@ -454,6 +454,9 @@ def test_deploy_batching_defaults_match_config():
     assert args.assemble_workers == cfg.assemble_workers
     assert args.readback_workers == cfg.readback_workers
     assert args.pipeline_depth == cfg.pipeline_depth
+    # serving fast-path knobs (ISSUE 13) stay in sync the same way
+    assert args.serving_quant == cfg.serving_quant
+    assert args.serving_topk == cfg.serving_topk
     # tracing knobs (ISSUE 12) stay in sync the same way
     assert (not args.no_trace) == cfg.tracing
     assert args.trace_ring == cfg.trace_ring
