@@ -45,8 +45,11 @@ from .core import (
 )
 from .report import findings_to_json, findings_to_sarif
 from .rules import RULES, Rule
+from .sharding import SHARDING_RULES, count_sharding_pragmas
 
 __all__ = [
+    "SHARDING_RULES",
+    "count_sharding_pragmas",
     "CheckContext",
     "Finding",
     "ProjectIndex",

@@ -83,11 +83,14 @@ class Finding:
 @dataclass
 class CheckContext:
     """Cross-file facts rules need: the mesh axis names declared by
-    ``parallel/mesh.py`` (for sharding-mismatch) and the
+    ``parallel/mesh.py`` (for sharding-mismatch), the declared axis
+    GROUPS — which axes coexist on one mesh, e.g. ``(data, model)``
+    and ``(batch, model)`` — for the sharding-flow rules, and the
     interprocedural :class:`ProjectIndex` over the scanned module set
     (built once per run by the orchestrator)."""
 
     declared_axes: Set[str] = field(default_factory=set)
+    declared_groups: Set[Tuple[str, ...]] = field(default_factory=set)
     project: Optional["ProjectIndex"] = None
 
 
@@ -271,6 +274,43 @@ def extract_mesh_axes(source: str) -> Set[str]:
     return axes
 
 
+def extract_mesh_groups(source: str) -> Set[Tuple[str, ...]]:
+    """Axis GROUPS a ``parallel/mesh.py`` declares: every tuple/list
+    literal whose elements are all ``*_AXIS`` constant names —
+    ``(DATA_AXIS, MODEL_AXIS)`` declares that ``data`` and ``model``
+    coexist on one mesh. The sharding-flow rules use this to catch
+    boundaries mixing axes of *different* meshes (``data`` with
+    ``batch``), which no single mesh this framework builds can
+    carry."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return set()
+    consts: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.endswith("_AXIS") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    groups: Set[Tuple[str, ...]] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Tuple, ast.List)) \
+                or len(node.elts) < 2:
+            continue
+        names: List[str] = []
+        for e in node.elts:
+            if isinstance(e, ast.Name) and e.id in consts:
+                names.append(consts[e.id])
+            else:
+                names = []
+                break
+        if names:
+            groups.add(tuple(names))
+    return groups
+
+
 def _find_mesh_source(files: Sequence[str]) -> Optional[str]:
     """The scanned tree's ``parallel/mesh.py`` if present, else this
     package's own (so ``ptpu check some/engine/dir`` still validates
@@ -296,8 +336,10 @@ def default_context() -> CheckContext:
     """Context anchored to this package's own mesh declarations (used
     when checking loose files/snippets with no mesh.py in scope)."""
     mesh_src = _find_mesh_source([])
-    return CheckContext(declared_axes=extract_mesh_axes(mesh_src)
-                        if mesh_src else set())
+    if not mesh_src:
+        return CheckContext()
+    return CheckContext(declared_axes=extract_mesh_axes(mesh_src),
+                        declared_groups=extract_mesh_groups(mesh_src))
 
 
 # ---------------------------------------------------------------------------
@@ -432,6 +474,11 @@ class FunctionInfo:
         #: gather), or invoked as a callable (callback-under-lock)
         self.index_sinks: Dict[int, Witness] = {}
         self.call_sinks: Dict[int, Witness] = {}
+        #: param position → Witness / canonical PartitionSpec string:
+        #: the param flows into a shard_map boundary that pins that
+        #: spec (implicit-reshard; collected by analysis/sharding.py)
+        self.spec_sinks: Dict[int, Witness] = {}
+        self.spec_constraints: Dict[int, str] = {}
 
     def hot(self, dir_parts: Set[str]) -> bool:
         return bool(set(self.mod.path.split("/")[:-1]) & dir_parts)
@@ -654,6 +701,12 @@ class ProjectIndex:
             fn.calls.append(CallSite(node.lineno, node.col_offset,
                                      callee, bound, arg_names,
                                      kwarg_names, lambda_args))
+        # sharding-flow direct sites: params this function feeds into
+        # a shard_map boundary with a pinned in_spec (implicit-reshard)
+        from .sharding import collect_spec_sinks
+        for pos, (spec, w) in collect_spec_sinks(fn).items():
+            fn.spec_sinks[pos] = w
+            fn.spec_constraints[pos] = spec
 
     # -- propagation --------------------------------------------------
 
@@ -735,6 +788,14 @@ class ProjectIndex:
                         "callback-under-lock", fn.mod.path, call.line,
                         call.col, "", via=f"{callee.qname}#{pos}")
                     changed = True
+                if pos in callee.spec_constraints \
+                        and my_pos not in fn.spec_constraints:
+                    fn.spec_sinks[my_pos] = Witness(
+                        "implicit-reshard", fn.mod.path, call.line,
+                        call.col, "", via=f"{callee.qname}#{pos}")
+                    fn.spec_constraints[my_pos] = \
+                        callee.spec_constraints[pos]
+                    changed = True
         return changed
 
     # -- chain reconstruction ----------------------------------------
@@ -759,14 +820,14 @@ class ProjectIndex:
     def sink_chain(self, start: FunctionInfo, kind: str, pos: int
                    ) -> List[Tuple[str, Witness]]:
         """Like :meth:`chain` for a param-position sink (``kind`` is
-        ``index`` or ``call``)."""
+        ``index``, ``call``, or ``spec``)."""
         hops: List[Tuple[str, Witness]] = []
         fn: Optional[FunctionInfo] = start
         seen: Set[Tuple[str, int]] = set()
         while fn is not None and (fn.qname, pos) not in seen:
             seen.add((fn.qname, pos))
-            sinks = fn.index_sinks if kind == "index" \
-                else fn.call_sinks
+            sinks = {"index": fn.index_sinks, "call": fn.call_sinks,
+                     "spec": fn.spec_sinks}[kind]
             w = sinks.get(pos)
             if w is None:
                 break
@@ -925,8 +986,10 @@ def run_check(paths: Sequence[str],
                          f"(have: {sorted(RULES)})")
     files = iter_py_files(paths)
     mesh_src = _find_mesh_source(files)
-    ctx = CheckContext(declared_axes=extract_mesh_axes(mesh_src)
-                       if mesh_src else set())
+    ctx = CheckContext(
+        declared_axes=extract_mesh_axes(mesh_src) if mesh_src else set(),
+        declared_groups=extract_mesh_groups(mesh_src)
+        if mesh_src else set())
     findings: List[Finding] = []
     mods: List[ModuleInfo] = []
     for f in files:

@@ -563,14 +563,18 @@ _COLLECTIVE_AXIS_ARG = {
 
 def rule_sharding_mismatch(mod: ModuleInfo,
                            ctx: CheckContext) -> List[Finding]:
+    from .sharding import _is_pspec_call, _is_shard_map_call
+
     axes = ctx.declared_axes
     if not axes:
         return []
     findings: List[Finding] = []
+    flagged: Set[Tuple[int, str]] = set()
 
-    def check(node: ast.Call, arg: ast.AST, what: str) -> None:
+    def check(node: ast.AST, arg: ast.AST, what: str) -> None:
         for name in _axis_literals(arg):
-            if name not in axes:
+            if name not in axes and (id(node), name) not in flagged:
+                flagged.add((id(node), name))
                 findings.append(Finding(
                     "sharding-mismatch", mod.path, node.lineno,
                     node.col_offset,
@@ -583,14 +587,35 @@ def rule_sharding_mismatch(mod: ModuleInfo,
         if not isinstance(node, ast.Call):
             continue
         resolved = mod.resolve(node.func)
-        if resolved == "jax.sharding.PartitionSpec":
+        if _is_pspec_call(mod, node):
             # covers every NamedSharding-annotated entry point too:
             # NamedSharding(mesh, P(...)), shard_map in/out specs, jit
             # out_shardings — the axis names always ride a
-            # PartitionSpec call
+            # PartitionSpec call, however P was imported (the alias
+            # table, OR a bare `P`/`PartitionSpec` name the aliases
+            # cannot resolve: star imports, `jax.P`)
             for arg in list(node.args) + [kw.value
                                           for kw in node.keywords]:
                 check(node, arg, "PartitionSpec")
+            continue
+        if _is_shard_map_call(mod, node):
+            # keyword-form in_specs=/out_specs= of a shard_map
+            # boundary: axis literals OUTSIDE a P(...) call (those are
+            # caught above) — bare tuple/string forms a compat wrapper
+            # might accept
+            for kw in node.keywords:
+                if kw.arg not in ("in_specs", "out_specs"):
+                    continue
+                covered: Set[int] = set()
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Call) \
+                            and _is_pspec_call(mod, sub):
+                        covered |= {id(d) for d in ast.walk(sub)}
+                for sub in ast.walk(kw.value):
+                    if id(sub) not in covered \
+                            and isinstance(sub, (ast.Tuple, ast.List,
+                                                 ast.Constant)):
+                        check(node, sub, f"shard_map {kw.arg}")
             continue
         pos = _COLLECTIVE_AXIS_ARG.get(resolved or "")
         if pos is None:
@@ -915,6 +940,12 @@ from .kernels import (  # noqa: E402 — registry assembly
     rule_missing_interpret_fallback,
     rule_vmem_overbudget,
 )
+from .sharding import (  # noqa: E402 — registry assembly
+    rule_implicit_reshard,
+    rule_missing_donation_sharded,
+    rule_shard_map_spec_mismatch,
+    rule_unsharded_capture,
+)
 
 RULES: Dict[str, Rule] = {r.name: r for r in (
     Rule("host-sync-in-hot-path",
@@ -931,9 +962,30 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
          "re-bound buffer",
          rule_missing_donation),
     Rule("sharding-mismatch",
-         "PartitionSpec / NamedSharding / lax-collective axis names "
-         "not declared by parallel/mesh.py",
+         "PartitionSpec / NamedSharding / lax-collective / shard_map "
+         "spec axis names (bare P() literals included) not declared "
+         "by parallel/mesh.py",
          rule_sharding_mismatch),
+    Rule("implicit-reshard",
+         "a value with a known sharding passed — directly or through "
+         "any helper chain — where a shard_map boundary pins a "
+         "different spec: a silent all-gather/all-to-all per dispatch",
+         rule_implicit_reshard, project=True),
+    Rule("shard-map-spec-mismatch",
+         "shard_map in_specs/out_specs arity disagreeing with the "
+         "wrapped function, or axis names mixing different declared "
+         "meshes (parallel/mesh.py groups)",
+         rule_shard_map_spec_mismatch),
+    Rule("unsharded-capture",
+         "a shard_map'd/jitted closure capturing an array the "
+         "enclosing scope shards — the capture enters replicated "
+         "(implicit all-gather of the whole table)",
+         rule_unsharded_capture),
+    Rule("missing-donation-sharded",
+         "x = step(x, …) re-binding a SHARDED buffer through a "
+         "cross-module jitted step that does not donate the slot "
+         "(2x peak HBM at exactly the scale that forced sharding)",
+         rule_missing_donation_sharded, project=True),
     Rule("materialized-gather",
          "table[indices] / jnp.take gathers by traced params in "
          "models/, ops/, or server/ functions — directly or through "

@@ -1279,6 +1279,96 @@ def cmd_check(args) -> int:
     return 0
 
 
+def cmd_audit_hlo(args) -> int:
+    """``ptpu audit-hlo`` — compile the registered SPMD entry points
+    on a forced 8-device CPU mesh, parse the optimized HLO for
+    collective ops + temp allocations, and gate against the committed
+    golden manifest (``analysis/hlo_baseline.json``) with the same
+    ratchet semantics as ``ptpu check --baseline``. The static
+    sharding rules catch spec disagreements the AST can see; this
+    catches the collectives only XLA sees. Non-zero exit on new
+    collectives / grown temps (see --baseline-grow);
+    docs/parallelism.md has the diff-reading runbook."""
+    from ..analysis import hlo_audit as ha
+
+    if args.list_entries:
+        for name, (_b, desc) in ha.ENTRY_POINTS.items():
+            _out(f"{name}: {desc}")
+        return 0
+    try:
+        manifest = ha.run_audit(args.entry or None)
+    except ha.AuditError as e:
+        _err(f"ptpu audit-hlo: {e}")
+        return 2
+    baseline_path = args.baseline or ha.DEFAULT_BASELINE
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    if args.write_baseline:
+        cap = None
+        if not args.baseline_grow and os.path.exists(baseline_path):
+            try:
+                cap = ha.load_manifest(baseline_path)
+            except (OSError, ValueError) as e:
+                _err(f"ptpu audit-hlo: cannot read baseline: {e}")
+                return 2
+        ha.write_manifest(baseline_path, manifest, cap=cap)
+        _err(f"ptpu audit-hlo: wrote "
+             f"{len(manifest['entries'])} entry point(s) to "
+             f"{baseline_path}"
+             f"{' (ratchet: shrink-only)' if cap is not None else ''}.")
+        if cap is not None:
+            violations, _ = ha.diff_manifests(manifest, cap)
+            if violations:
+                _err(f"ptpu audit-hlo: {len(violations)} regression(s) "
+                     f"were NOT absorbed (the baseline only ratchets "
+                     f"down; fix them or re-record deliberately with "
+                     f"--baseline-grow):")
+                for v in violations:
+                    _err(f"  {v}")
+                return 1
+        return 0
+    if args.format == "json":
+        _out(json.dumps(manifest, indent=2, sort_keys=True))
+    else:
+        _out(ha.format_text(manifest))
+    if not os.path.exists(baseline_path):
+        _err(f"ptpu audit-hlo: no baseline at {baseline_path} — "
+             f"record one with --write-baseline (gate skipped).")
+        return 0
+    try:
+        baseline = ha.load_manifest(baseline_path)
+    except (OSError, ValueError) as e:
+        _err(f"ptpu audit-hlo: cannot read baseline: {e}")
+        return 2
+    if args.entry:
+        # a subset run gates only the audited entries — the others are
+        # not "no longer reproduced", they were not compiled
+        keep = set(args.entry)
+        baseline = {**baseline,
+                    "entries": {k: v
+                                for k, v in baseline["entries"].items()
+                                if k in keep}}
+    violations, shrinkable = ha.diff_manifests(manifest, baseline)
+    if shrinkable:
+        _err(f"ptpu audit-hlo: {len(shrinkable)} baseline entr"
+             f"{'y is' if len(shrinkable) == 1 else 'ies are'} no "
+             f"longer fully reproduced — ratchet down with "
+             f"--write-baseline:")
+        for s in shrinkable:
+            _err(f"  {s}")
+    if violations:
+        _err(f"ptpu audit-hlo: {len(violations)} collective/temp "
+             f"regression(s) vs {baseline_path}:")
+        for v in violations:
+            _err(f"  {v}")
+        return 1
+    _err("ptpu audit-hlo: compiled collectives match the golden "
+         "manifest.")
+    return 0
+
+
 def cmd_template(args, storage: Storage) -> int:
     _out("Bundled engine templates (predictionio_tpu.templates):")
     _out("  recommendation  — ALS top-N (module: "
@@ -1741,6 +1831,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "debt (e.g. when enabling a rule) instead of "
                         "the default shrink-only ratchet")
 
+    s = sub.add_parser("audit-hlo", help="compile the SPMD entry "
+                       "points on a forced 8-device CPU mesh and diff "
+                       "the HLO collectives against the committed "
+                       "golden manifest (the runtime complement of "
+                       "the ptpu check sharding rules)")
+    s.add_argument("--entry", action="append", default=[],
+                   help="audit only the named entry point (repeatable)")
+    s.add_argument("--list-entries", action="store_true",
+                   help="print the entry-point catalogue and exit")
+    s.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format for the fresh manifest")
+    s.add_argument("--out", default="",
+                   help="also write the fresh manifest JSON to FILE "
+                        "(the CI artifact)")
+    s.add_argument("--baseline", default="",
+                   help="golden manifest to gate against (default: "
+                        "the committed analysis/hlo_baseline.json)")
+    s.add_argument("--write-baseline", action="store_true",
+                   help="record the fresh manifest as the baseline; "
+                        "against an existing one this only RATCHETS "
+                        "(shrinks counts/temps) and fails on growth")
+    s.add_argument("--baseline-grow", action="store_true",
+                   help="with --write-baseline: allow recording new "
+                        "collectives/entries (deliberate schedule "
+                        "changes) instead of the shrink-only ratchet")
+
     sub.add_parser("template", help="list bundled engine templates")
     sub.add_parser("shell", help="interactive shell with storage preloaded")
     s = sub.add_parser("run", help="run module.path:callable with storage "
@@ -1788,6 +1904,13 @@ def main(argv: Optional[List[str]] = None,
     if args.command == "check":
         # pure-AST lint: needs neither storage nor jax
         return cmd_check(args)
+    if args.command == "audit-hlo":
+        # needs jax on a forced virtual mesh, but no storage; the
+        # device topology MUST be pinned before the first jax import
+        from ..analysis.hlo_audit import ensure_cpu_devices
+
+        ensure_cpu_devices()
+        return cmd_audit_hlo(args)
     if args.command in ("train", "eval", "deploy", "batchpredict",
                         "run", "shell", "status"):
         # device-using commands share one persistent XLA program cache

@@ -41,13 +41,6 @@ from ..ops.solve import gramian, solve_spd_batch
 from ..parallel.mesh import rows_spec
 from ..utils.platform import enable_compilation_cache
 
-#: PartitionSpec sharding rows over every axis of the DEFAULT
-#: ``(data, model)`` training mesh. Mesh-parameterized code paths use
-#: :func:`~predictionio_tpu.parallel.mesh.rows_spec` instead, so the
-#: same layout lands on a ``(batch, model)`` serving mesh unchanged.
-ROWS = P(("data", "model"))
-
-
 @dataclass(frozen=True)
 class ALSParams:
     """Hyperparameters, name-compatible with the reference template's

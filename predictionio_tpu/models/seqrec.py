@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ring_attention import ring_attention
+from ..parallel.mesh import rows_spec
 
 
 @dataclass(frozen=True)
@@ -259,8 +260,12 @@ def train_seqrec(sequences: np.ndarray, n_items: int,
     B = max(B // n_dev, 1) * n_dev  # divisible batches for the mesh
     rng = np.random.default_rng(params.seed)
     losses: List[float] = []
+    # rows_spec, NOT a hard-coded P(("data","model")): the batch axis
+    # shards over whichever mesh is handed in — a (batch, model)
+    # serving mesh would KeyError on the literal axis names (caught by
+    # the ptpu check sharding rules / audit-hlo, ISSUE 14)
     batch_sharding = None if mesh is None \
-        else NamedSharding(mesh, P(("data", "model")))
+        else NamedSharding(mesh, rows_spec(mesh))
     for epoch in range(params.num_epochs):
         order = rng.permutation(len(seqs))
         epoch_losses: list = []

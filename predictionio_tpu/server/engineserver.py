@@ -545,6 +545,7 @@ class QueryServer:
         # re-record inside _bind)
         self._record_gram_mode()
         self._record_serving_kernel()
+        self._record_sharding_findings()
         if self.cache is not None:
             self.cache.register_metrics(self.metrics)
         if locks_instrumented():
@@ -783,6 +784,39 @@ class QueryServer:
             self._serving_kernel = {"mode": mode, "quant": quant}
         except Exception:  # noqa: BLE001 — telemetry must not block a
             pass           # deploy/reload/promote
+
+    def _record_sharding_findings(self) -> None:
+        """Record the ``pio_sharding_findings`` info gauge (ISSUE 14):
+        per-rule count of ``# ptpu: allow[...]`` pragmas naming a
+        sharding-family rule baked into THIS deployed build — the
+        accepted-and-justified sharding debt the static pass would
+        otherwise flag. A deploy that ships new suppressed sharding
+        findings moves this gauge, so the debt is visible on /metrics
+        next to ``pio_gram_mode``/``pio_serving_kernel``, not only in
+        code review. Source-text census (no jax, no AST), run once at
+        server construction — the installed sources don't change under
+        a live process."""
+        if getattr(self, "metrics", None) is None:
+            return
+        try:
+            from ..analysis.sharding import count_sharding_pragmas
+
+            counts = count_sharding_pragmas()
+            fam = self.metrics.gauge(
+                "pio_sharding_findings",
+                "Pragma-suppressed sharding findings baked into the "
+                "deployed build (info gauge: count per rule)")
+            for rule, n in sorted(counts.items()):
+                fam.labels(rule=rule).set(float(n))
+            self._sharding_findings = dict(counts)
+        except Exception:  # noqa: BLE001 — telemetry must not block
+            pass           # server construction
+
+    def sharding_findings_status(self) -> dict:
+        """The suppressed-sharding-debt block for /status.json."""
+        counts = getattr(self, "_sharding_findings", None) or {}
+        return {"suppressed": sum(counts.values()),
+                "byRule": dict(sorted(counts.items()))}
 
     def serving_kernel_status(self) -> dict:
         """The resolved serving-kernel block for /status.json: top-k
@@ -2306,6 +2340,19 @@ def build_app(server: QueryServer) -> HTTPApp:
         return ("<li>cache hit ratio: " + html.escape(", ".join(parts))
                 + " (<a href='/cache.json'>cache.json</a>)</li>")
 
+    def _sharding_line() -> str:
+        """Suppressed sharding-debt census (ISSUE 14): how many
+        pragma-justified sharding findings this build carries, per
+        rule — the static pass's audit trail surfaced where an
+        operator looks first."""
+        sf = server.sharding_findings_status()
+        if not sf["suppressed"]:
+            return ""
+        parts = ", ".join(f"{rule} {n}"
+                          for rule, n in sf["byRule"].items())
+        return (f"<li>sharding findings suppressed: "
+                f"{sf['suppressed']} ({html.escape(parts)})</li>")
+
     def _mesh_panel() -> str:
         """Per-device lane/HBM occupancy while a mesh is active
         (ISSUE 6); empty in single mode — the page stays what it was."""
@@ -2402,7 +2449,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
-{_pipeline_line()}{_stream_line()}{_cache_line()}{_trace_line()}
+{_sharding_line()}{_pipeline_line()}{_stream_line()}{_cache_line()}{_trace_line()}
 </ul>{_mesh_panel()}{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
@@ -2439,6 +2486,7 @@ def build_app(server: QueryServer) -> HTTPApp:
             # blocks together: servingKernel says the wire dtype, hbm
             # says the resident bytes it produced (docs/kernels.md)
             "servingKernel": server.serving_kernel_status(),
+            "shardingFindings": server.sharding_findings_status(),
             "hbm": hbm_stats(),
             "cache": (server.cache.stats() if server.cache is not None
                       else {"enabled": False}),

@@ -9,6 +9,14 @@ Query: ``{"user": "u1", "num": 10}`` (recent history read from the
 event store at serving time — the e-commerce template's realtime-lookup
 pattern) or ``{"items": ["i3", "i9"], "num": 10}`` for an explicit
 session history. Known items in the history are excluded from results.
+
+Sharding baseline (ISSUE 14): this template holds NO PartitionSpecs of
+its own — it hands ``ctx.mesh`` to ``models/seqrec.py``, whose batch
+sharding derives from the mesh via ``rows_spec`` (the hard-coded
+``P(("data","model"))`` it used to carry broke on any other mesh).
+The compiled collective structure of the training step is pinned by
+the ``seqrec_train_step`` entry of ``ptpu audit-hlo``; the sequential
+mesh/fused-kernel ROADMAP work starts from that clean slate.
 """
 
 from __future__ import annotations

@@ -608,7 +608,9 @@ class TestRepoWide:
             "unguarded-shared-state", "lock-order-inversion",
             "blocking-under-lock", "callback-under-lock",
             "vmem-overbudget", "dma-unwaited",
-            "low-precision-accumulator", "missing-interpret-fallback"}
+            "low-precision-accumulator", "missing-interpret-fallback",
+            "implicit-reshard", "shard-map-spec-mismatch",
+            "unsharded-capture", "missing-donation-sharded"}
 
     def test_kernel_files_clean_under_kernel_rules(self):
         # the acceptance bar: the real Pallas kernels pass the rules
@@ -2331,3 +2333,556 @@ class TestServingRuntimeWiring:
         with pytest.raises(Exception):
             with server._transfer_guard():
                 np.asarray(jnp.ones(13) + 1)  # implicit D2H
+
+
+# ---------------------------------------------------------------------------
+# SPMD sharding-flow rule family (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+class TestShardingMismatchGeneralized:
+    """ISSUE 14 satellite: bare P() literals the alias table cannot
+    resolve, and shard_map in_specs=/out_specs= keyword forms."""
+
+    def test_positive_bare_jax_p(self):
+        code = src("""
+            import jax
+
+            SPEC = jax.P("bogus")
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+        assert "bogus" in findings[0].message
+
+    def test_positive_star_import_p(self):
+        code = src("""
+            from jax.sharding import *
+
+            SPEC = P("nope")
+        """)
+        findings = check_source(code, path=COLD)
+        assert "sharding-mismatch" in rules_of(findings)
+
+    def test_positive_shard_map_kwarg_specs(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, body):
+                return shard_map_compat(body, mesh,
+                                        in_specs=(P("typo_axis"),),
+                                        out_specs=P())
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+        assert "typo_axis" in findings[0].message
+
+    def test_positive_shard_map_bare_string_specs(self):
+        # a compat wrapper accepting bare axis strings in the spec
+        # kwarg — no P() call anywhere, still checked
+        code = src("""
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, body):
+                return shard_map_compat(body, mesh,
+                                        in_specs=("wrong",),
+                                        out_specs=("model",))
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+        assert "wrong" in findings[0].message
+
+    def test_negative_declared_axes_every_form(self):
+        code = src("""
+            import jax
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            A = jax.P("batch")
+
+            def build(mesh, body):
+                return shard_map_compat(body, mesh,
+                                        in_specs=(jax.P("model"),),
+                                        out_specs=jax.P())
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_no_double_report_p_inside_shard_map_kwarg(self):
+        # one bad axis inside a resolvable P inside in_specs= must
+        # yield exactly ONE finding, not one per covering branch
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, body):
+                return shard_map_compat(body, mesh,
+                                        in_specs=(P("oops"),),
+                                        out_specs=(P(),))
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["sharding-mismatch"]
+
+
+class TestShardMapSpecMismatch:
+    def test_positive_in_specs_arity(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, x):
+                def body(a, b):
+                    return a + b
+                fn = shard_map_compat(body, mesh,
+                                      in_specs=(P("model"),),
+                                      out_specs=P())
+                return fn(x)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["shard-map-spec-mismatch"]
+        assert "in_specs carries 1" in findings[0].message
+
+    def test_positive_out_specs_arity(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, x):
+                def body(a):
+                    return a, a
+                return shard_map_compat(body, mesh,
+                                        in_specs=(P("model"),),
+                                        out_specs=P())(x)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["shard-map-spec-mismatch"]
+        assert "2-tuple" in findings[0].message
+
+    def test_positive_axis_group_mixing(self):
+        # "data" (training mesh) with "batch" (serving mesh): both
+        # declared, but no single mesh carries both
+        code = src("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, x):
+                def body(a):
+                    return jax.lax.psum(a, "data")
+                return shard_map_compat(body, mesh,
+                                        in_specs=(P("batch"),),
+                                        out_specs=(P("batch"),))(x)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["shard-map-spec-mismatch"]
+        assert "different declared meshes" in findings[0].message
+
+    def test_negative_coherent_site(self):
+        code = src("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, x, y):
+                def body(a, b):
+                    return jax.lax.psum(a + b, "model"), a
+                return shard_map_compat(body, mesh,
+                                        in_specs=(P("model"), P()),
+                                        out_specs=(P(), P("model")))(x, y)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_rows_spec_symbolic(self):
+        # rows_spec(mesh) is mesh-agnostic — no static arity/axis claim
+        # beyond the spec count itself
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def build(mesh, x, y):
+                spec = rows_spec(mesh)
+                def body(a, b):
+                    return a + b
+                return shard_map_compat(body, mesh,
+                                        in_specs=(P(), spec),
+                                        out_specs=spec)(x, y)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def build(mesh, x):
+                def body(a, b):
+                    return a + b
+                # ptpu: allow[shard-map-spec-mismatch] — b is bound by
+                # functools.partial upstream of this wrapper
+                fn = shard_map_compat(body, mesh,
+                                      in_specs=(P("model"),),
+                                      out_specs=P())
+                return fn(x)
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+class TestImplicitReshard:
+    def test_positive_direct(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def run(mesh, host):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                def body(t):
+                    return t.sum()
+                fn = shard_map_compat(body, mesh, in_specs=(P(),),
+                                      out_specs=P())
+                return fn(table)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["implicit-reshard"]
+        assert "rows(*)" in findings[0].message
+        assert "P()" in findings[0].message
+
+    def test_positive_interprocedural_with_chain(self):
+        files = {
+            "predictionio_tpu/models/helper.py": src("""
+                from jax.sharding import PartitionSpec as P
+                from predictionio_tpu.parallel.collectives import \\
+                    shard_map_compat
+
+                def consume(table, mesh):
+                    def body(t):
+                        return t.sum()
+                    fn = shard_map_compat(body, mesh, in_specs=(P(),),
+                                          out_specs=P())
+                    return fn(table)
+            """),
+            "predictionio_tpu/models/train.py": src("""
+                import jax
+                from jax.sharding import NamedSharding
+                from predictionio_tpu.parallel.mesh import rows_spec
+                from predictionio_tpu.models.helper import consume
+
+                def step(mesh, host):
+                    U = jax.device_put(
+                        host, NamedSharding(mesh, rows_spec(mesh)))
+                    return consume(U, mesh)
+            """),
+        }
+        findings = check_project(files)
+        assert rules_of(findings) == ["implicit-reshard"]
+        f = findings[0]
+        assert f.path == "predictionio_tpu/models/train.py"
+        assert "consume" in f.message and "rows(*)" in f.message
+        # the chain walks down to the shard_map boundary
+        assert f.related and \
+            f.related[-1][0] == "predictionio_tpu/models/helper.py"
+
+    def test_negative_matching_specs(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def run(mesh, host):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                spec = rows_spec(mesh)
+                def body(t):
+                    return t.sum()
+                fn = shard_map_compat(body, mesh, in_specs=(spec,),
+                                      out_specs=P())
+                return fn(table)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_full_group_literal_equals_rows(self):
+        # P(("data","model")) IS rows_spec on the training mesh — the
+        # two spellings must not count as a reshard
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def run(mesh, host):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                def body(t):
+                    return t.sum()
+                fn = shard_map_compat(
+                    body, mesh, in_specs=(P(("data", "model")),),
+                    out_specs=P())
+                return fn(table)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_at_boundary_blesses_callers(self):
+        files = {
+            "predictionio_tpu/models/helper.py": src("""
+                from jax.sharding import PartitionSpec as P
+                from predictionio_tpu.parallel.collectives import \\
+                    shard_map_compat
+
+                def consume(table, mesh):
+                    def body(t):
+                        return t.sum()
+                    fn = shard_map_compat(body, mesh, in_specs=(P(),),
+                                          out_specs=P())
+                    # ptpu: allow[implicit-reshard] — the table enters
+                    # replicated by design (same all-gather the GSPMD
+                    # gather pays); documented boundary
+                    return fn(table)
+            """),
+            "predictionio_tpu/models/train.py": src("""
+                import jax
+                from jax.sharding import NamedSharding
+                from predictionio_tpu.parallel.mesh import rows_spec
+                from predictionio_tpu.models.helper import consume
+
+                def step(mesh, host):
+                    U = jax.device_put(
+                        host, NamedSharding(mesh, rows_spec(mesh)))
+                    return consume(U, mesh)
+            """),
+        }
+        assert check_project(files) == []
+
+
+class TestUnshardedCapture:
+    def test_positive_shard_map_closure(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def run(mesh, host, idx):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                def body(i):
+                    return table[i]
+                fn = shard_map_compat(body, mesh,
+                                      in_specs=(P("model"),),
+                                      out_specs=P("model"))
+                return fn(idx)
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["unsharded-capture"]
+        assert "table" in findings[0].message
+
+    def test_positive_jit_closure(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def build(mesh, host):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                @jax.jit
+                def score(v):
+                    return v @ table.T
+                return score
+        """)
+        findings = check_source(code, path=COLD)
+        assert "unsharded-capture" in rules_of(findings)
+
+    def test_negative_passed_as_argument(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def run(mesh, host, idx):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                spec = rows_spec(mesh)
+                def body(t, i):
+                    return t[i]
+                fn = shard_map_compat(body, mesh,
+                                      in_specs=(spec, P("model")),
+                                      out_specs=P("model"))
+                return fn(table, idx)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_negative_replicated_capture_fine(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+
+            def run(mesh, host, idx):
+                g = jax.device_put(host, NamedSharding(mesh, P()))
+                def body(i):
+                    return g[i]
+                fn = shard_map_compat(body, mesh,
+                                      in_specs=(P("model"),),
+                                      out_specs=P("model"))
+                return fn(idx)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from predictionio_tpu.parallel.collectives import \\
+                shard_map_compat
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            def run(mesh, host, idx):
+                table = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                def body(i):
+                    return table[i]
+                # ptpu: allow[unsharded-capture] — [k, r] pinned tile,
+                # deliberately replicated per device
+                fn = shard_map_compat(body, mesh,
+                                      in_specs=(P("model"),),
+                                      out_specs=P("model"))
+                return fn(idx)
+        """)
+        assert check_source(code, path=COLD) == []
+
+
+class TestMissingDonationSharded:
+    FILES = {
+        "predictionio_tpu/models/stepmod.py": src("""
+            import jax
+
+            @jax.jit
+            def half_step(U, hist):
+                return U * 2
+        """),
+        "predictionio_tpu/models/train2.py": src("""
+            import jax
+            from jax.sharding import NamedSharding
+            from predictionio_tpu.parallel.mesh import rows_spec
+            from predictionio_tpu.models.stepmod import half_step
+
+            def train(mesh, host, hist):
+                U = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                for _ in range(4):
+                    U = half_step(U, hist)
+                return U
+        """),
+    }
+
+    def test_positive_cross_module_rebind(self):
+        findings = check_project(self.FILES)
+        assert rules_of(findings) == ["missing-donation-sharded"]
+        f = findings[0]
+        assert f.path == "predictionio_tpu/models/train2.py"
+        assert "half_step" in f.message and "rows(*)" in f.message
+        # related points at the jit site missing the donation
+        assert f.related and \
+            f.related[0][0] == "predictionio_tpu/models/stepmod.py"
+
+    def test_negative_donated(self):
+        files = dict(self.FILES)
+        files["predictionio_tpu/models/stepmod.py"] = src("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def half_step(U, hist):
+                return U * 2
+        """)
+        assert check_project(files) == []
+
+    def test_negative_same_module_is_plain_rules_job(self):
+        # same-module rebinds are missing-donation's (which fires);
+        # the sharded rule must not double-report
+        code = src("""
+            import jax
+            from jax.sharding import NamedSharding
+            from predictionio_tpu.parallel.mesh import rows_spec
+
+            @jax.jit
+            def half_step(U, hist):
+                return U * 2
+
+            def train(mesh, host, hist):
+                U = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                U = half_step(U, hist)
+                return U
+        """)
+        findings = check_source(code, path=COLD)
+        assert rules_of(findings) == ["missing-donation"]
+
+    def test_pragma_suppresses(self):
+        files = dict(self.FILES)
+        files["predictionio_tpu/models/train2.py"] = src("""
+            import jax
+            from jax.sharding import NamedSharding
+            from predictionio_tpu.parallel.mesh import rows_spec
+            from predictionio_tpu.models.stepmod import half_step
+
+            def train(mesh, host, hist):
+                U = jax.device_put(
+                    host, NamedSharding(mesh, rows_spec(mesh)))
+                for _ in range(4):
+                    # ptpu: allow[missing-donation-sharded] — U is
+                    # checkpoint-retained across steps by design
+                    U = half_step(U, hist)
+                return U
+        """)
+        assert check_project(files) == []
+
+
+class TestShardingPragmaCensus:
+    def test_counts_per_rule(self, tmp_path):
+        from predictionio_tpu.analysis import count_sharding_pragmas
+
+        (tmp_path / "a.py").write_text(src("""
+            # ptpu: allow[implicit-reshard] — documented boundary
+            x = 1
+            # ptpu: allow[unsharded-capture,sharding-mismatch] — tile
+            y = 2
+            # ptpu: allow[host-sync-in-hot-path] — not sharding
+            z = 3
+        """))
+        counts = count_sharding_pragmas(str(tmp_path))
+        assert counts == {"implicit-reshard": 1,
+                          "unsharded-capture": 1,
+                          "sharding-mismatch": 1}
+
+    def test_repo_census_matches_gauge_source(self):
+        # whatever the tree carries, the census is non-negative ints
+        # keyed by family rules only
+        from predictionio_tpu.analysis import (
+            SHARDING_RULES,
+            count_sharding_pragmas,
+        )
+
+        counts = count_sharding_pragmas()
+        assert all(rule in SHARDING_RULES for rule in counts)
+        assert all(isinstance(n, int) and n > 0
+                   for n in counts.values())

@@ -227,6 +227,41 @@ class TestMicroBatching:
             srv.shutdown()
 
 
+class TestShardingFindingsGauge:
+    def test_census_recorded_and_on_status(self, trained_ctx):
+        """The pio_sharding_findings info gauge (ISSUE 14): the server
+        records the per-rule count of pragma-suppressed sharding
+        findings baked into the deployed build, and /status.json
+        carries the same census as `shardingFindings`."""
+        from predictionio_tpu.analysis import count_sharding_pragmas
+        from predictionio_tpu.server.engineserver import (
+            QueryServer,
+            build_app,
+        )
+        from predictionio_tpu.workflow import (
+            get_latest_completed,
+            load_models_for_deploy,
+        )
+
+        ctx, engine, ep = trained_ctx
+        inst = get_latest_completed(ctx, engine_id="srv")
+        models = load_models_for_deploy(ctx, engine, inst, ep)
+        server = QueryServer(ctx, engine, ep, models, inst)
+        expect = count_sharding_pragmas()
+        sf = server.sharding_findings_status()
+        assert sf["byRule"] == dict(sorted(expect.items()))
+        assert sf["suppressed"] == sum(expect.values())
+        rendered = server.metrics.render()
+        for rule, n in expect.items():
+            assert (f'pio_sharding_findings{{rule="{rule}"}} {n}'
+                    in rendered)
+        app = build_app(server)
+        route = next(h for m, _, _, h in app._routes
+                     if getattr(h, "__name__", "") == "status")
+        doc = route(None).body
+        assert doc["shardingFindings"] == sf
+
+
 class TestGramModeGauge:
     def test_bind_records_resolved_gram_mode(self, trained_ctx):
         """The pio_gram_mode info gauge (ISSUE 7): binding an ALS

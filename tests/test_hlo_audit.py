@@ -1,0 +1,220 @@
+"""``ptpu audit-hlo`` tests (ISSUE 14): HLO collective parsing, the
+golden collective-count regressions for the sharded entry points
+(compiled live on the forced 8-device CPU mesh the whole suite runs
+under), the ratchet diff/write semantics, the deliberately mis-specced
+fixture that must fail with the inserted collective NAMED, and the CLI
+contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.analysis import hlo_audit as ha
+from predictionio_tpu.cli import main
+
+jax = pytest.importorskip("jax")
+
+
+def _mesh():
+    from predictionio_tpu.parallel.mesh import make_serving_mesh
+
+    return make_serving_mesh()
+
+
+def _rows_sharded(mesh, arr):
+    from jax.sharding import NamedSharding
+
+    from predictionio_tpu.parallel.mesh import rows_spec
+
+    return jax.device_put(arr, NamedSharding(mesh, rows_spec(mesh)))
+
+
+class TestParseCollectives:
+    def test_counts_and_shapes(self):
+        hlo = """
+  %x = f32[4,64]{1,0} all-gather(f32[4,8]{1,0} %a), dimensions={1}
+  %y = f32[16,16]{1,0} all-reduce(f32[16,16]{1,0} %b), to_apply=%add
+  %z = f32[4,64]{1,0} all-gather(f32[4,8]{1,0} %c), dimensions={1}
+"""
+        counts, shapes = ha.parse_collectives(hlo)
+        assert counts == {"all-gather": 2, "all-reduce": 1}
+        assert shapes["all-reduce"] == ["f32[16,16]{1,0}"]
+
+    def test_start_counts_done_does_not(self):
+        hlo = """
+  %s = f32[8]{0} all-reduce-start(f32[8]{0} %a), to_apply=%add
+  %d = f32[8]{0} all-reduce-done(f32[8]{0} %s)
+  %p = (f32[2]{0}, f32[2]{0}) collective-permute(f32[2]{0} %b)
+"""
+        counts, _ = ha.parse_collectives(hlo)
+        assert counts == {"all-reduce": 1, "collective-permute": 1}
+
+
+class TestGoldenCollectiveCounts:
+    """The satellite regression tests: EXACTLY the expected collective
+    set for the two flagship sharded programs on the 8-device mesh —
+    a new collective fails here before it ships to TPU."""
+
+    def test_gramian_allreduce_is_one_psum(self):
+        from predictionio_tpu.parallel.collectives import (
+            gramian_allreduce,
+        )
+
+        mesh = _mesh()
+        x = _rows_sharded(
+            mesh, np.ones((8 * mesh.devices.size, 16), np.float32))
+        compiled = jax.jit(
+            lambda t: gramian_allreduce(t, mesh)).lower(x).compile()
+        counts, _ = ha.parse_collectives(compiled.as_text())
+        assert counts == {"all-reduce": 1}, counts
+
+    def test_sharded_rank_is_two_allgathers(self):
+        # per-shard local top-k, then ONE candidate all-gather for the
+        # scores and ONE for the global ids — O(k·n_dev) on the wire,
+        # nothing else
+        from predictionio_tpu.models.als import _sharded_rank_fn
+
+        mesh = _mesh()
+        n = 8 * mesh.devices.size
+        table = _rows_sharded(mesh, np.ones((n, 16), np.float32))
+        vecs = np.ones((4, 16), np.float32)
+        compiled = _sharded_rank_fn(mesh, 8, 8, n).lower(
+            vecs, table).compile()
+        counts, _ = ha.parse_collectives(compiled.as_text())
+        assert counts == {"all-gather": 2}, counts
+
+
+class TestRunAuditAndDiff:
+    @pytest.fixture(scope="class")
+    def manifest(self):
+        return ha.run_audit(["gramian_allreduce", "gather_rows"])
+
+    def test_manifest_shape(self, manifest):
+        assert manifest["version"] == ha.MANIFEST_VERSION
+        assert manifest["devices"] == ha.AUDIT_DEVICE_COUNT
+        assert set(manifest["entries"]) == {"gramian_allreduce",
+                                            "gather_rows"}
+        rec = manifest["entries"]["gramian_allreduce"]
+        assert rec["collectives"] == {"all-reduce": 1}
+        assert rec["temp_bytes"] >= 0
+
+    def test_identical_manifests_pass(self, manifest):
+        violations, shrinkable = ha.diff_manifests(manifest, manifest)
+        assert violations == [] and shrinkable == []
+
+    def test_new_collective_fails_with_op_named(self, manifest):
+        baseline = json.loads(json.dumps(manifest))
+        del baseline["entries"]["gramian_allreduce"][
+            "collectives"]["all-reduce"]
+        violations, _ = ha.diff_manifests(manifest, baseline)
+        assert len(violations) == 1
+        assert "gramian_allreduce" in violations[0]
+        assert "all-reduce" in violations[0]
+
+    def test_grown_temp_fails(self, manifest):
+        current = json.loads(json.dumps(manifest))
+        rec = current["entries"]["gather_rows"]
+        rec["temp_bytes"] = int(
+            manifest["entries"]["gather_rows"]["temp_bytes"]
+            * ha.TEMP_GROWTH_RATIO + ha.TEMP_SLACK_BYTES + 4096)
+        violations, _ = ha.diff_manifests(current, manifest)
+        assert len(violations) == 1
+        assert "temp allocation" in violations[0]
+
+    def test_unknown_entry_point_fails(self, manifest):
+        current = json.loads(json.dumps(manifest))
+        current["entries"]["rogue"] = {"collectives": {},
+                                       "temp_bytes": 0}
+        violations, _ = ha.diff_manifests(current, manifest)
+        assert any("rogue" in v and "baseline" in v
+                   for v in violations)
+
+    def test_shrink_reported_not_failed(self, manifest):
+        current = json.loads(json.dumps(manifest))
+        del current["entries"]["gramian_allreduce"][
+            "collectives"]["all-reduce"]
+        violations, shrinkable = ha.diff_manifests(current, manifest)
+        assert violations == []
+        assert any("all-reduce" in s for s in shrinkable)
+
+    def test_write_ratchets_never_absorbs(self, manifest, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        ha.write_manifest(path, manifest)
+        grown = json.loads(json.dumps(manifest))
+        grown["entries"]["gramian_allreduce"]["collectives"][
+            "all-to-all"] = 3
+        ha.write_manifest(path, grown, cap=ha.load_manifest(path))
+        rewritten = ha.load_manifest(path)
+        assert "all-to-all" not in rewritten["entries"][
+            "gramian_allreduce"]["collectives"]
+
+    def test_committed_baseline_matches_live_compile(self, manifest):
+        """The committed golden manifest reproduces on this machine
+        for the audited subset — the CI gate's premise."""
+        baseline = ha.load_manifest(ha.DEFAULT_BASELINE)
+        for name in manifest["entries"]:
+            assert manifest["entries"][name]["collectives"] == \
+                baseline["entries"][name]["collectives"], name
+
+
+class TestMisSpeccedFixtureFailsCI:
+    def test_replicating_a_sharded_table_names_the_collective(self):
+        """The acceptance fixture: force the exact bug the audit
+        exists for — a row-sharded table consumed through a
+        replicated out_sharding — and assert the gate fails with the
+        inserted collective named."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh()
+        table = _rows_sharded(
+            mesh, np.ones((8 * mesh.devices.size, 16), np.float32))
+        # the mis-spec: out_shardings=P() forces XLA to materialize
+        # the full table on every device — the silent reshard
+        bad = jax.jit(lambda t: t * 2.0,
+                      out_shardings=NamedSharding(mesh, P()))
+        record = ha.audit_compiled(bad.lower(table).compile())
+        assert record["collectives"], \
+            "mis-spec produced no collective — fixture broken"
+        current = {"version": ha.MANIFEST_VERSION,
+                   "devices": ha.AUDIT_DEVICE_COUNT,
+                   "entries": {"serve_topk": record}}
+        golden = {"version": ha.MANIFEST_VERSION,
+                  "devices": ha.AUDIT_DEVICE_COUNT,
+                  "entries": {"serve_topk": {"collectives": {},
+                                             "temp_bytes":
+                                                 record["temp_bytes"]}}}
+        violations, _ = ha.diff_manifests(current, golden)
+        assert violations, "the inserted collective must fail the gate"
+        op = next(iter(record["collectives"]))
+        assert any(op in v and "serve_topk" in v for v in violations)
+
+
+class TestAuditCLI:
+    def test_list_entries(self, capsys):
+        assert main(["audit-hlo", "--list-entries"]) == 0
+        out = capsys.readouterr().out
+        assert "gramian_allreduce" in out and "sharded_rank" in out
+
+    def test_unknown_entry_exits_2(self):
+        assert main(["audit-hlo", "--entry", "nope"]) == 2
+
+    def test_subset_against_committed_baseline(self, capsys,
+                                               tmp_path):
+        artifact = str(tmp_path / "audit.json")
+        rc = main(["audit-hlo", "--entry", "gramian_allreduce",
+                   "--format", "json", "--out", artifact])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["entries"]["gramian_allreduce"]["collectives"] == \
+            {"all-reduce": 1}
+        assert os.path.exists(artifact)
+
+    def test_write_and_gate_roundtrip(self, tmp_path, capsys):
+        path = str(tmp_path / "b.json")
+        assert main(["audit-hlo", "--entry", "gather_rows",
+                     "--baseline", path, "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert main(["audit-hlo", "--entry", "gather_rows",
+                     "--baseline", path]) == 0
