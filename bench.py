@@ -605,6 +605,32 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, don't die
             streaming = {"error": _clean_err(e, 300)}
 
+    # capacity model (ISSUE 15): the mixed-traffic load harness —
+    # Zipf queries + event ingest + streaming fold-ins + a held canary
+    # concurrently, offered rate swept to the knee per serving config,
+    # freshness re-measured at 80% of the knee WHILE queries fly (the
+    # number beside the idle event_to_servable_ms)
+    capacity = None
+    if os.environ.get("BENCH_CAPACITY", "1") == "1":
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks"))
+            import load_harness
+
+            capacity = load_harness.measure(
+                configs=os.environ.get("BENCH_CAPACITY_CONFIGS",
+                                       "host,staged,cached"),
+                rate_min=float(os.environ.get(
+                    "BENCH_CAPACITY_RATE_MIN", "8")),
+                rate_max=float(os.environ.get(
+                    "BENCH_CAPACITY_RATE_MAX", "128")),
+                step_sec=float(os.environ.get(
+                    "BENCH_CAPACITY_STEP_SEC", "4")),
+                freshness_trials=3)
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            capacity = {"error": _clean_err(e, 300)}
+
     # elastic reliability (ISSUE 11): the serving lane-kill drill —
     # inject a dead replicated lane under real HTTP load, require zero
     # failed in-deadline queries, and measure the recovery-time-
@@ -742,7 +768,18 @@ def main():
         # (ISSUE 10): ingest to correct serve, real HTTP loop
         "event_to_servable_ms": (streaming or {}).get(
             "event_to_servable_p50_ms"),
+        # the same freshness number measured at 80% of the staged
+        # config's knee qps WITH queries in flight (ISSUE 15): the
+        # idle number above says what the trainer can do, this one
+        # says what it does while the server earns its keep
+        "event_to_servable_under_load_ms": (
+            ((capacity or {}).get("configs") or {})
+            .get("staged", {}).get("freshness_under_load_ms")),
         "streaming": streaming,
+        # the capacity model (ISSUE 15): knee qps + p99 at 80% of knee
+        # per serving config under MIXED traffic — what `ptpu slo
+        # check` gates against the committed slo/specs/ci.json
+        "capacity": capacity,
         # lane-kill recovery-time-objective (ISSUE 11): degraded-mode
         # entry→exit with zero failed in-deadline queries required
         "rto_ms": (reliability or {}).get("rto_ms"),

@@ -1,0 +1,236 @@
+"""Shared load-generation core for the HTTP benchmarks (ISSUE 15).
+
+``serving_bench``, ``http_ingest_bench`` and the mixed-traffic
+``load_harness`` all drive a real server from a worker-thread pool;
+before this module each kept its own near-copy of the pool, the index
+hand-off, the latency accounting and the keep-alive connection
+handling. One definition now, with both loop disciplines:
+
+- **closed loop** (``rate_qps=None``): workers fire as fast as the
+  server answers — latency is measured from each send. Good for
+  "how fast can it go" burst batteries; it systematically under-states
+  latency under overload (a stalling server slows the offered load).
+- **open loop** (``rate_qps`` set): request *k*'s intended start is
+  ``t0 + k/rate`` regardless of how the server is doing, and latency
+  is measured **from that schedule** — the coordinated-omission-safe
+  discipline (MLPerf-style): a stalling server accrues queueing delay
+  on every scheduled arrival instead of silently thinning the load.
+  Sweeping the rate and watching p99 is how the qps-vs-p99 knee is
+  found.
+
+Senders own their connections and heal them: a sender must raise on
+failure and may keep per-thread state (one keep-alive HTTP/1.1
+connection per worker — on a shared host, per-request TCP
+setup/teardown dominates before the server does).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Callable, Iterable, Optional, Tuple
+
+import numpy as np
+
+#: sender verdicts: anything else must be raised as an exception
+OK = "ok"
+SHED = "shed"
+
+
+class LoadStats:
+    """Thread-safe accumulator: latencies (seconds) by verdict plus
+    error strings."""
+
+    def __init__(self) -> None:
+        self.lat: list = []
+        self.shed: list = []
+        self.errors: list = []
+        self._lock = threading.Lock()
+
+    def ok(self, dt: float) -> None:
+        with self._lock:
+            self.lat.append(dt)
+
+    def shed_one(self, dt: float) -> None:
+        with self._lock:
+            self.shed.append(dt)
+
+    def error(self, msg: str) -> None:
+        with self._lock:
+            self.errors.append(msg)
+
+    def percentiles(self) -> dict:
+        """``{p50_ms, p90_ms, p99_ms}`` over the OK latencies (empty
+        dict when none landed)."""
+        if not self.lat:
+            return {}
+        arr = np.sort(np.asarray(self.lat)) * 1e3
+        return {
+            "p50_ms": round(float(np.percentile(arr, 50)), 2),
+            "p90_ms": round(float(np.percentile(arr, 90)), 2),
+            "p99_ms": round(float(np.percentile(arr, 99)), 2),
+        }
+
+    def summary(self, wall: float) -> dict:
+        """The standard result row every consumer emits."""
+        out = {
+            "n": len(self.lat),
+            "shed": len(self.shed),
+            "errors": len(self.errors),
+            "qps": (round(len(self.lat) / wall, 1) if wall > 0
+                    else None),
+            **self.percentiles(),
+        }
+        return out
+
+
+def run_load(worker_factory: Callable[[], Callable[[int], str]],
+             n_requests: int, n_threads: int,
+             rate_qps: Optional[float] = None,
+             start_delay: float = 0.05,
+             stop: Optional[threading.Event] = None
+             ) -> Tuple[LoadStats, float]:
+    """Drive ``n_requests`` through ``n_threads`` workers.
+
+    ``worker_factory()`` runs once per thread and returns
+    ``send(k) -> "ok" | "shed"``; the sender raises on failure and owns
+    (and heals) its own connection. Closed loop measures from each
+    send; open loop (``rate_qps``) measures from request *k*'s
+    scheduled arrival ``t0 + k/rate`` — see the module docstring for
+    why that distinction is the whole point. ``stop`` (optional) ends
+    the run early — used by background traffic lanes whose duration is
+    decided by a foreground measurement.
+
+    Returns ``(stats, wall_seconds)`` where wall spans first scheduled
+    arrival (open) or first send (closed) to last completion.
+    """
+    stats = LoadStats()
+    it = iter(range(int(n_requests)))
+    it_lock = threading.Lock()
+    t0 = time.monotonic() + start_delay if rate_qps else None
+
+    def loop() -> None:
+        send = worker_factory()
+        try:
+            while not (stop is not None and stop.is_set()):
+                with it_lock:
+                    k = next(it, None)
+                if k is None:
+                    return
+                if rate_qps:
+                    t_ref = t0 + k / rate_qps
+                    delay = t_ref - time.monotonic()
+                    if delay > 0:
+                        if stop is None:
+                            time.sleep(delay)
+                        elif stop.wait(delay):
+                            return
+                else:
+                    t_ref = time.monotonic()
+                try:
+                    verdict = send(k)
+                except Exception as e:  # noqa: BLE001 — surface, not die
+                    stats.error(str(e))
+                    continue
+                # latency from the SCHEDULED start under open loop:
+                # waiting for a worker/connection counts against the
+                # server, never against the workload
+                dt = time.monotonic() - t_ref
+                if verdict == SHED:
+                    stats.shed_one(dt)
+                else:
+                    stats.ok(dt)
+        finally:
+            closer = getattr(send, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001 — teardown only
+                    pass
+
+    threads = [threading.Thread(target=loop, daemon=True)
+               for _ in range(int(n_threads))]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - (max(t_start, t0) if t0 is not None
+                               else t_start)
+    return stats, wall
+
+
+def json_post_sender(port: int, path, body_fn: Callable[[int], bytes],
+                     check: Optional[Callable[[int, bytes],
+                                              Optional[str]]] = None,
+                     shed_status: Iterable[int] = (503,),
+                     host: str = "127.0.0.1",
+                     timeout: float = 120.0
+                     ) -> Callable[[], Callable[[int], str]]:
+    """A ``worker_factory`` POSTing JSON over one keep-alive
+    connection per worker. ``path`` is a string or ``path(k)``;
+    ``check(status, payload)`` returns an error string for a bad
+    response (None = OK; default accepts exactly 200). A transport
+    error closes the connection — ``http.client`` reconnects lazily on
+    the next request."""
+    shed = set(shed_status)
+
+    def factory() -> Callable[[int], str]:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+
+        def send(k: int) -> str:
+            body = body_fn(k)
+            try:
+                conn.request(
+                    "POST", path(k) if callable(path) else path,
+                    body=body,
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+            except Exception:
+                conn.close()  # reconnect lazily on the next request
+                raise
+            if resp.status in shed:
+                return SHED
+            if check is not None:
+                err = check(resp.status, payload)
+                if err:
+                    raise RuntimeError(err)
+            elif resp.status != 200:
+                raise RuntimeError(f"status {resp.status}")
+            return OK
+
+        send.close = conn.close  # type: ignore[attr-defined]
+        return send
+
+    return factory
+
+
+def expect_json_field(field: str) -> Callable[[int, bytes],
+                                              Optional[str]]:
+    """A ``check`` asserting status 200 and a non-null ``field`` in
+    the JSON body (the ``itemScores`` contract of /queries.json)."""
+
+    def check(status: int, payload: bytes) -> Optional[str]:
+        if status != 200:
+            return f"status {status}"
+        try:
+            if json.loads(payload).get(field) is None:
+                return f"bad response: missing {field!r}"
+        except (ValueError, UnicodeDecodeError) as e:
+            return f"unparseable response: {e}"
+        return None
+
+    return check
+
+
+def sample_entities(rng, n_entities: int, size: int,
+                    zipf: Optional[float] = None) -> np.ndarray:
+    """Uniform entity draw, or Zipf(α)-skewed when ``zipf`` is set
+    (rank 1 = the hottest entity, wrapped into the id space) — the
+    hot-entity skew production recommendation traffic actually has."""
+    if zipf is None:
+        return rng.integers(0, n_entities, size)
+    return (rng.zipf(float(zipf), size=size) - 1) % n_entities

@@ -2,7 +2,10 @@
 
 Drives the REAL event server over HTTP (not the storage layer): N client
 threads posting single events and ≤50-event batches
-(the reference's cap, ``EventServer.scala:66,349``), SQLite backend.
+(the reference's cap, ``EventServer.scala:66,349``), SQLite backend —
+through the shared ``_loadgen`` worker pool (keep-alive connections,
+one definition of the pool/accounting across the serving, ingest, and
+mixed-traffic benchmarks).
 
 Usage: python benchmarks/http_ingest_bench.py [n_events] [n_threads]
 Prints one JSON line.
@@ -11,19 +14,41 @@ Prints one JSON line.
 import json
 import os
 import sys
-import threading
-import time
-import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-def post(url: str, payload) -> dict:
-    req = urllib.request.Request(
-        url, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
-    with urllib.request.urlopen(req, timeout=30) as r:
-        return json.loads(r.read())
+from _loadgen import json_post_sender, run_load  # noqa: E402
+
+
+def event_body(entity: str, item: int) -> dict:
+    return {"event": "rate", "entityType": "user", "entityId": entity,
+            "targetEntityType": "item", "targetEntityId": f"i{item}",
+            "properties": {"rating": float(item % 5 + 1)},
+            "eventTime": "2026-01-01T00:00:00.000Z"}
+
+
+def _check_single(status: int, payload: bytes):
+    if status != 201:
+        return f"status {status}"
+    if b"eventId" not in payload:
+        return f"no eventId in {payload[:120]!r}"
+    return None
+
+
+def _check_batch(status: int, payload: bytes):
+    if status != 200:
+        return f"status {status}"
+    try:
+        rows = json.loads(payload)
+    except ValueError as e:
+        return f"unparseable batch response: {e}"
+    if not all(r.get("status") == 201 for r in rows):
+        return f"batch rejects: {rows[:2]}"
+    return None
 
 
 def main() -> None:
@@ -50,53 +75,33 @@ def main() -> None:
 
     server = create_event_server(storage, host="127.0.0.1", port=0)
     server.start_background()
-    base = f"http://127.0.0.1:{server.port}"
+    port = server.port
 
-    def run_phase(batch_size: int, total: int) -> float:
-        per_thread = total // n_threads
-        errs = []
+    # phase 1: single-event POSTs
+    n_single = max(n_events // 4, n_threads)
+    single_sender = json_post_sender(
+        port, "/events.json?accessKey=bkey",
+        body_fn=lambda k: json.dumps(
+            event_body(f"u{k}", k % 97)).encode(),
+        check=_check_single, shed_status=())
+    stats, wall = run_load(single_sender, n_single, n_threads)
+    if stats.errors:
+        raise RuntimeError(stats.errors[:3])
+    single_rps = len(stats.lat) / wall
 
-        def worker(tid: int):
-            try:
-                if batch_size == 1:
-                    for i in range(per_thread):
-                        out = post(f"{base}/events.json?accessKey=bkey", {
-                            "event": "rate", "entityType": "user",
-                            "entityId": f"u{tid}-{i}",
-                            "targetEntityType": "item",
-                            "targetEntityId": f"i{i % 97}",
-                            "properties": {"rating": float(i % 5 + 1)},
-                            "eventTime": "2026-01-01T00:00:00.000Z"})
-                        assert "eventId" in out, out
-                else:
-                    for s in range(0, per_thread, batch_size):
-                        m = min(batch_size, per_thread - s)
-                        out = post(
-                            f"{base}/batch/events.json?accessKey=bkey",
-                            [{"event": "rate", "entityType": "user",
-                              "entityId": f"u{tid}-{s + i}",
-                              "targetEntityType": "item",
-                              "targetEntityId": f"i{i % 97}",
-                              "eventTime": "2026-01-01T00:00:00.000Z"}
-                             for i in range(m)])
-                        assert all(r["status"] == 201 for r in out), out[:2]
-            except Exception as e:  # noqa: BLE001
-                errs.append(repr(e))
-
-        threads = [threading.Thread(target=worker, args=(t,))
-                   for t in range(n_threads)]
-        t0 = time.monotonic()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        dt = time.monotonic() - t0
-        if errs:
-            raise RuntimeError(errs[:3])
-        return (per_thread * n_threads) / dt
-
-    single_rps = run_phase(1, max(n_events // 4, n_threads))
-    batch_rps = run_phase(50, n_events)
+    # phase 2: 50-event batches (the reference's cap)
+    batch = 50
+    n_batches = max(n_events // batch, 1)
+    batch_sender = json_post_sender(
+        port, "/batch/events.json?accessKey=bkey",
+        body_fn=lambda k: json.dumps(
+            [event_body(f"b{k}-{i}", i % 97)
+             for i in range(batch)]).encode(),
+        check=_check_batch, shed_status=())
+    stats, wall = run_load(batch_sender, n_batches, n_threads)
+    if stats.errors:
+        raise RuntimeError(stats.errors[:3])
+    batch_rps = (len(stats.lat) * batch) / wall
     server.shutdown()
 
     print(json.dumps({

@@ -68,7 +68,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import threading
 import time
 import urllib.request
 from datetime import datetime, timezone
@@ -77,7 +76,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from _loadgen import (  # noqa: E402
+    expect_json_field,
+    json_post_sender,
+    run_load,
+    sample_entities,
+)
 from predictionio_tpu.controller import Context  # noqa: E402
 from predictionio_tpu.data.bimap import BiMap  # noqa: E402
 from predictionio_tpu.data.storage import App, Storage  # noqa: E402
@@ -118,12 +124,20 @@ def synth_model(n_users: int, n_items: int, rank: int,
         params=ALSParams(rank=rank))
 
 
-def _sample_users(rng, n_users: int, n: int, zipf=None) -> np.ndarray:
-    """Uniform user draw, or Zipf(α)-skewed when ``zipf`` is set (rank
-    1 = the hottest user; wrapped into the id space)."""
-    if zipf is None:
-        return rng.integers(0, n_users, n)
-    return (rng.zipf(float(zipf), size=n) - 1) % n_users
+#: Zipf-or-uniform user draw — shared with the load harness
+_sample_users = sample_entities
+
+
+def _query_sender(port: int, users: np.ndarray, shed=()):
+    """One keep-alive worker posting ``/queries.json`` for user k.
+    ``shed`` lists statuses counted as load-shedding instead of
+    errors (the open-loop knee sweep passes ``(503,)``; the
+    closed-loop battery treats every non-200 as a failure)."""
+    return json_post_sender(
+        port, "/queries.json",
+        body_fn=lambda k: json.dumps({"user": f"u{users[k]}",
+                                      "num": 10}).encode(),
+        check=expect_json_field("itemScores"), shed_status=shed)
 
 
 def _boot_server(model: ALSModel, cfg: ServerConfig):
@@ -175,54 +189,11 @@ def bench_config(model: ALSModel, cfg: ServerConfig, n_requests: int,
             headers={"Content-Type": "application/json"}), timeout=120
         ).read()
 
-    lat: list = []
-    errors: list = []
-    lat_lock = threading.Lock()
-    idx = iter(range(n_requests))
-    idx_lock = threading.Lock()
-
-    def worker():
-        # one persistent HTTP/1.1 connection per worker: on a shared
-        # 1-core host, per-request TCP setup/teardown dominates before
-        # the device does — keep-alive measures the serving stack, not
-        # the client's socket churn
-        import http.client
-
-        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
-        try:
-            while True:
-                with idx_lock:
-                    k = next(idx, None)
-                if k is None:
-                    return
-                body = json.dumps({"user": f"u{users[k]}",
-                                   "num": 10}).encode()
-                t0 = time.monotonic()
-                try:
-                    conn.request("POST", "/queries.json", body=body,
-                                 headers={"Content-Type":
-                                          "application/json"})
-                    out = json.loads(conn.getresponse().read())
-                    if out.get("itemScores") is None:
-                        raise RuntimeError(f"bad response: {out}")
-                except Exception as e:  # noqa: BLE001 — surface, not die
-                    with lat_lock:
-                        errors.append(str(e))
-                    conn.close()  # reconnect lazily on next request
-                    continue
-                dt = time.monotonic() - t0
-                with lat_lock:
-                    lat.append(dt)
-        finally:
-            conn.close()
-
-    t_start = time.monotonic()
-    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.monotonic() - t_start
+    # closed-loop burst through the shared generator (_loadgen): one
+    # keep-alive connection per worker, latency from each send
+    stats, wall = run_load(_query_sender(port, users), n_requests,
+                           n_threads)
+    lat, errors = stats.lat, stats.errors
     # hot-query probe (ISSUE 4): with the serving cache on, repeat ONE
     # hot user's query sequentially — after the first fill these are
     # pure cache hits, measuring the parse→cache→respond floor the
@@ -417,65 +388,13 @@ def bench_open_loop(model: ALSModel, cfg: ServerConfig, rate_qps: float,
                 headers={"Content-Type": "application/json"}),
                 timeout=120).read()
 
-        lat: list = []
-        shed: list = []
-        errors: list = []
-        lock = threading.Lock()
-        idx = iter(range(n_requests))
-        t0 = time.monotonic() + 0.05
-
-        def worker():
-            import http.client
-
-            conn = http.client.HTTPConnection("127.0.0.1", port,
-                                              timeout=120)
-            try:
-                while True:
-                    with lock:
-                        k = next(idx, None)
-                    if k is None:
-                        return
-                    t_sched = t0 + k / rate_qps
-                    delay = t_sched - time.monotonic()
-                    if delay > 0:
-                        time.sleep(delay)
-                    body = json.dumps({"user": f"u{users[k]}",
-                                       "num": 10}).encode()
-                    try:
-                        conn.request("POST", "/queries.json", body=body,
-                                     headers={"Content-Type":
-                                              "application/json"})
-                        resp = conn.getresponse()
-                        payload = resp.read()
-                        # latency from the SCHEDULED start: waiting for
-                        # a free connection/worker counts against the
-                        # server, not against the workload
-                        dt = time.monotonic() - t_sched
-                        if resp.status == 503:
-                            with lock:
-                                shed.append(dt)
-                        elif resp.status != 200 or not json.loads(
-                                payload).get("itemScores"):
-                            raise RuntimeError(
-                                f"status {resp.status}")
-                        else:
-                            with lock:
-                                lat.append(dt)
-                    except Exception as e:  # noqa: BLE001 — surface
-                        with lock:
-                            errors.append(str(e))
-                        conn.close()
-            finally:
-                conn.close()
-
-        threads = [threading.Thread(target=worker)
-                   for _ in range(n_threads)]
-        t_start = time.monotonic()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.monotonic() - max(t_start, t0)
+        # the open-loop discipline lives in _loadgen.run_load now:
+        # request k's intended start is t0 + k/rate and latency is
+        # measured from that schedule (coordinated-omission-safe)
+        stats, wall = run_load(
+            _query_sender(port, users, shed=(503,)), n_requests,
+            n_threads, rate_qps=rate_qps)
+        lat, shed, errors = stats.lat, stats.shed, stats.errors
         pipe = None
         try:
             with urllib.request.urlopen(
@@ -587,44 +506,9 @@ def bench_canary(model: ALSModel, candidate: ALSModel, fraction: float,
 
         rng = np.random.default_rng(2)
         users = rng.integers(0, model.n_users, n_requests)
-        errors: list = []
-        lock = threading.Lock()
-        idx = iter(range(n_requests))
-
-        def worker():
-            import http.client
-
-            conn = http.client.HTTPConnection("127.0.0.1", port,
-                                              timeout=120)
-            try:
-                while True:
-                    with lock:
-                        k = next(idx, None)
-                    if k is None:
-                        return
-                    body = json.dumps({"user": f"u{users[k]}",
-                                       "num": 10}).encode()
-                    try:
-                        conn.request(
-                            "POST", "/queries.json", body=body,
-                            headers={"Content-Type":
-                                     "application/json"})
-                        out = json.loads(conn.getresponse().read())
-                        if out.get("itemScores") is None:
-                            raise RuntimeError(f"bad response: {out}")
-                    except Exception as e:  # noqa: BLE001 — surface
-                        with lock:
-                            errors.append(str(e))
-                        conn.close()
-            finally:
-                conn.close()
-
-        threads = [threading.Thread(target=worker)
-                   for _ in range(n_threads)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        stats, _wall = run_load(_query_sender(port, users),
+                                n_requests, n_threads)
+        errors = stats.errors
         arms = qs.release_arms()
     finally:
         srv.shutdown()

@@ -1,0 +1,187 @@
+"""SLO-engine smoke (ISSUE 15) — the CI gate for burn-rate detection.
+
+End-to-end over REAL HTTP on whatever device is available (CI: CPU),
+against the committed ``slo/specs/ci.json`` (short smoke windows):
+
+1. deploy a micro-batched engine server with the SLO engine evaluating
+   the committed specs every 200 ms and the flight recorder on;
+2. **baseline**: open-loop queries past the slow window — every spec
+   must settle ``ok`` with zero violations (the committed baseline
+   passes);
+3. **seeded regression**: arm a latency fault at the PR 11
+   ``serving.dispatch`` injection point (every batched dispatch sleeps
+   past the latency spec's threshold) and keep the load coming — the
+   fast AND slow windows must rise past their burn thresholds, the
+   breach must be counted in ``pio_slo_violations_total``, and the
+   flight recorder must hold a trace carrying the fault attribution
+   (``faultPoint=serving.dispatch``) — every violation arrives with
+   exemplar evidence;
+4. **recovery**: clear the fault — the spec must leave ``breach``
+   within the fast window's horizon (violations stay counted).
+
+Prints one JSON line; exits non-zero on any violation of the above —
+this is the demonstration that a real SLO regression FAILS CI while
+the healthy baseline passes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _loadgen import (  # noqa: E402
+    expect_json_field,
+    json_post_sender,
+    run_load,
+)
+
+SPEC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "slo", "specs", "ci.json")
+
+#: the latency spec the injected fault must breach (slo/specs/ci.json)
+LATENCY_SPEC = "queries-p99-latency"
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _spec_state(port: int, name: str) -> dict:
+    for sp in (_get(port, "/slo.json").get("specs") or []):
+        if sp["name"] == name:
+            return sp
+    raise RuntimeError(f"spec {name!r} not evaluated by the server")
+
+
+def _drive(port: int, seconds: float, rate: float = 25.0) -> None:
+    rng = np.random.default_rng(5)
+    n = int(rate * seconds)
+    users = rng.integers(0, 200, n)
+    sender = json_post_sender(
+        port, "/queries.json",
+        body_fn=lambda k: json.dumps({"user": f"u{users[k]}",
+                                      "num": 5}).encode(),
+        check=expect_json_field("itemScores"))
+    stats, _wall = run_load(sender, n, 8, rate_qps=rate)
+    if stats.errors:
+        raise RuntimeError(
+            f"{len(stats.errors)} failed queries under smoke load "
+            f"(first: {stats.errors[0]})")
+
+
+def _await_state(port: int, name: str, want, timeout_s: float) -> dict:
+    deadline = time.monotonic() + timeout_s
+    sp = _spec_state(port, name)
+    while time.monotonic() < deadline:
+        sp = _spec_state(port, name)
+        if sp["state"] in want:
+            return sp
+        time.sleep(0.25)
+    return sp
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    from predictionio_tpu import faults
+    from predictionio_tpu.server.engineserver import ServerConfig
+    from serving_bench import _boot_server, _wait_warm, synth_model
+
+    model = synth_model(200, 200, 8, device=False)
+    qs, srv = _boot_server(model, ServerConfig(
+        batching=True, max_batch=16, batch_window_ms=2.0,
+        slo_specs=SPEC_PATH, slo_interval_ms=200.0,
+        queue_deadline_ms=10_000.0))
+    port = srv.port
+    checks: dict = {}
+    out: dict = {"bench": "slo_smoke", "specs": SPEC_PATH}
+    try:
+        _wait_warm(port, "slo_smoke")
+
+        # phase 1 — committed baseline: drive past the slow window,
+        # every spec settles ok with zero violations
+        _drive(port, seconds=10.0)
+        baseline = _await_state(port, LATENCY_SPEC, ("ok",), 5.0)
+        states = {sp["name"]: sp["state"]
+                  for sp in _get(port, "/slo.json")["specs"]}
+        out["baseline"] = {"states": states,
+                           "violations": baseline["violations"]}
+        checks["baseline_ok"] = (
+            baseline["state"] == "ok"
+            and baseline["violations"] == 0
+            and all(s in ("ok", "idle", "insufficient_data")
+                    for s in states.values()))
+
+        # phase 2 — seeded regression: every batched dispatch now
+        # sleeps well past the latency spec's threshold
+        faults.inject("serving.dispatch", "latency", delay_ms=400.0)
+        t_inject = time.monotonic()
+        _drive(port, seconds=12.0, rate=20.0)
+        breached = _await_state(port, LATENCY_SPEC, ("breach",), 10.0)
+        out["breach"] = {k: breached[k] for k in
+                         ("state", "burnFast", "burnSlow",
+                          "violations", "budgetRemaining")}
+        out["detect_sec"] = round(time.monotonic() - t_inject, 1)
+        checks["breach_detected"] = breached["state"] == "breach"
+        checks["violation_counted"] = breached["violations"] >= 1
+        metrics_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+        checks["violations_series_exported"] = any(
+            ln.startswith("pio_slo_violations_total")
+            and f'slo="{LATENCY_SPEC}"' in ln
+            and not ln.rstrip().endswith(" 0")
+            for ln in metrics_text.splitlines())
+        checks["burn_series_exported"] = \
+            "pio_slo_burn_rate" in metrics_text
+
+        # the evidence contract: a retained trace carries the fault
+        # attribution from the injected dispatch
+        slowest = _get(port, "/trace.json?slowest=20").get("traces") or []
+        fault_traces = [t for t in slowest
+                        if (t.get("attrs") or {}).get("faultPoint")
+                        == "serving.dispatch"]
+        out["retained_traces"] = len(slowest)
+        out["fault_attributed_traces"] = len(fault_traces)
+        checks["trace_retained_with_fault_attr"] = bool(fault_traces)
+        # while the breach burned, the tracer was in force-retention
+        trace_status = _get(port, "/trace.json")
+        retained_by = trace_status.get("retainedByReason") or {}
+        out["retained_by_reason"] = retained_by
+        checks["burn_force_retention"] = (
+            trace_status.get("forcedReason") == "slo"
+            or retained_by.get("slo", 0) > 0)
+
+        # phase 3 — recovery: clear the fault, keep serving; the spec
+        # leaves breach within the fast window's horizon
+        faults.clear("serving.dispatch")
+        _drive(port, seconds=8.0)
+        recovered = _await_state(port, LATENCY_SPEC,
+                                 ("ok", "idle"), 15.0)
+        out["recovery"] = {"state": recovered["state"],
+                           "violations": recovered["violations"]}
+        checks["recovered"] = recovered["state"] in ("ok", "idle")
+        checks["violations_persist"] = recovered["violations"] >= 1
+    finally:
+        faults.clear()
+        srv.shutdown()
+    ok = all(checks.values())
+    print(json.dumps({"ok": ok, **out, **checks}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

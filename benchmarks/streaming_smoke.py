@@ -17,6 +17,13 @@ End-to-end over REAL HTTP on whatever device is available (CI: CPU):
    EXACTLY the relevant events ingested (none lost, none twice), with
    cursor lag 0 and the ``pio_stream_*`` series exported on /metrics.
 
+With ``--with-load QPS`` (ISSUE 15), the SAME probe runs while an
+open-loop query generator drives the engine server at the given rate —
+the freshness number under concurrent serving load, not on an idle
+box. ``measure(load_qps=...)`` is the importable form; the harness and
+bench.py embed ``event_to_servable_under_load_ms`` beside the idle
+number through it.
+
 Prints one JSON line; exits non-zero on any violation. ``measure()``
 is importable — bench.py embeds ``event_to_servable_ms`` in the BENCH
 line through it.
@@ -27,6 +34,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 import urllib.request
 from datetime import datetime, timedelta, timezone
@@ -35,6 +43,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from predictionio_tpu.controller import Context  # noqa: E402
 from predictionio_tpu.data import DataMap, Event  # noqa: E402
@@ -77,10 +86,13 @@ def _seed(storage, app_id, n_users=30):
 
 
 def measure(trials: int = 8, ratings_per_trial: int = 3,
-            interval_ms: float = 100.0, timeout_s: float = 30.0) -> dict:
+            interval_ms: float = 100.0, timeout_s: float = 30.0,
+            load_qps: float = 0.0, load_threads: int = 4) -> dict:
     """The ingest→fold-in→serve loop over real HTTP; returns the
     freshness samples + consistency checks (no printing, no exit —
-    bench.py embeds this)."""
+    bench.py embeds this). ``load_qps > 0`` runs a concurrent
+    open-loop query generator against the engine server for the whole
+    trial loop — the freshness-under-load measurement (ISSUE 15)."""
     from predictionio_tpu.server.engineserver import (
         QueryServer,
         ServerConfig,
@@ -121,6 +133,33 @@ def measure(trials: int = 8, ratings_per_trial: int = 3,
     out: dict = {"trials": trials}
     samples_ms = []
     ingested_relevant = 0
+    load_stop = load_thread = load_box = None
+    if load_qps > 0:
+        # concurrent query load (ISSUE 15): an open-loop generator at
+        # ``load_qps`` against the SAME serving binding the fold-ins
+        # hot-swap into — freshness measured while the device/model is
+        # actually contended, not idle
+        from _loadgen import (
+            expect_json_field,
+            json_post_sender,
+            run_load,
+        )
+
+        rng = np.random.default_rng(13)
+        load_users = rng.integers(0, 30, 100_000)
+        sender = json_post_sender(
+            en_srv.port, "/queries.json",
+            body_fn=lambda k: json.dumps(
+                {"user": f"u{load_users[k]}", "num": 5}).encode(),
+            check=expect_json_field("itemScores"))
+        load_stop = threading.Event()
+        load_box: list = []
+        load_thread = threading.Thread(
+            target=lambda: load_box.append(run_load(
+                sender, len(load_users), load_threads,
+                rate_qps=load_qps, stop=load_stop)),
+            daemon=True, name="freshness-load")
+        load_thread.start()
     try:
         for k in range(trials):
             user = f"smoke_user_{k}"
@@ -185,6 +224,13 @@ def measure(trials: int = 8, ratings_per_trial: int = 3,
                                 "pio_stream_cursor_lag",
                                 "pio_stream_drift_score"))
     finally:
+        if load_stop is not None:
+            load_stop.set()
+            load_thread.join(timeout=30)
+            if load_box:
+                stats, wall = load_box[0]
+                out["load"] = {"offered_qps": load_qps,
+                               **stats.summary(wall)}
         qs.stop_stream()
         en_srv.shutdown()
         ev_srv.shutdown()
@@ -203,9 +249,16 @@ def main() -> int:
     from predictionio_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
 
+    argv = sys.argv[1:]
+    load_qps = 0.0
+    if "--with-load" in argv:
+        i = argv.index("--with-load")
+        load_qps = float(argv[i + 1])
+        del argv[i:i + 2]
     budget_ms = float(os.environ.get("STREAM_SMOKE_BUDGET_S",
                                      "5")) * 1000.0
-    res = measure(trials=int(os.environ.get("STREAM_SMOKE_TRIALS", "8")))
+    res = measure(trials=int(os.environ.get("STREAM_SMOKE_TRIALS", "8")),
+                  load_qps=load_qps)
     checks = {
         "all_trials_servable": res.get("samples") == res["trials"],
         "p50_under_budget": (

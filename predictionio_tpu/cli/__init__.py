@@ -338,7 +338,9 @@ def cmd_deploy(args, storage: Storage) -> int:
         trace_ring=args.trace_ring,
         trace_slow_ms=args.trace_slow_ms,
         access_log_sample=args.access_log_sample,
-        profile_dir=args.profile_dir or None)
+        profile_dir=args.profile_dir or None,
+        slo_specs=args.slo_specs or None,
+        slo_interval_ms=args.slo_interval_ms)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     server = deploy(
         ctx, engine, engine_params,
@@ -981,6 +983,96 @@ def cmd_stream(args, storage: Storage) -> int:
     return 1
 
 
+def cmd_slo(args, storage: Storage) -> int:
+    """``ptpu slo`` (ISSUE 15, docs/slo.md):
+
+    - ``status`` — a running server's live burn rates / budgets
+      (``GET /slo.json``), one line per spec;
+    - ``check`` — the CI capacity gate: diff a ``load_harness``
+      ``CAPACITY.json`` against the committed spec file with ratchet
+      semantics (regressions fail naming the spec, the measurement
+      window, and the measured value; ``--update`` tightens the
+      committed gates toward a better run, never loosens them).
+    """
+    if args.slo_command == "status":
+        try:
+            payload = _server_call(args, "/slo.json")
+        except Exception as e:  # noqa: BLE001 — report, don't traceback
+            _err(f"server at {args.ip}:{args.port} unreachable: "
+                 f"{_http_err_detail(e)}")
+            return 1
+        p = payload or {}
+        if not p.get("enabled", False):
+            _out("SLO engine is disabled on this server "
+                 f"({p.get('hint', '')})")
+            return 0
+        burning = p.get("burning") or []
+        for sp in p.get("specs") or []:
+            budget = sp.get("budgetRemaining")
+            bits = [f"{sp['name']:<28} {sp['state']:<18}"]
+            for key, label in (("burnFast", "fast"),
+                               ("burnSlow", "slow")):
+                v = sp.get(key)
+                bits.append(f"burn[{label}] "
+                            + (f"{v:6.2f}x" if v is not None
+                               else "     ?"))
+            bits.append("budget "
+                        + (f"{budget * 100:6.1f}%" if budget is not None
+                           else "     ?"))
+            bits.append(f"violations {sp.get('violations', 0)}")
+            _out("  ".join(bits))
+        _out(f"{len(p.get('specs') or [])} spec(s), "
+             + (f"BURNING: {', '.join(burning)}" if burning
+                else "none burning")
+             + f" ({p.get('ticks', 0)} evaluation ticks)")
+        return 1 if burning else 0
+    # check: gate CAPACITY.json against the committed spec file
+    from ..slo import (
+        gate_capacity,
+        load_specs,
+        ratchet_gates,
+        write_gates,
+    )
+
+    try:
+        with open(args.capacity, encoding="utf-8") as f:
+            capacity = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _err(f"cannot read capacity model {args.capacity}: {e}")
+        return 1
+    try:
+        _specs, gates = load_specs(args.specs)
+    except (OSError, ValueError) as e:
+        _err(f"cannot read SLO spec file {args.specs}: {e}")
+        return 1
+    if not gates:
+        _err(f"{args.specs} commits no capacity gates; add a "
+             f"'capacity' section (docs/slo.md)")
+        return 1
+    failures = gate_capacity(capacity, gates)
+    for line in failures:
+        _err(f"FAIL {line}")
+    if failures:
+        _err(f"{len(failures)} capacity regression(s) vs {args.specs} "
+             f"— fix the regression or, for an accepted trade-off, "
+             f"loosen the committed gate in an explicit commit")
+        return 1
+    n_checked = sum(len(g) for g in gates.values())
+    _out(f"capacity gate PASS: {n_checked} committed limit(s) over "
+         f"{len(gates)} config(s) hold for {args.capacity}")
+    if args.update:
+        new_gates, changes = ratchet_gates(capacity, gates)
+        if changes:
+            write_gates(args.specs, new_gates)
+            for c in changes:
+                _out(f"ratchet {c}")
+            _out(f"tightened {len(changes)} gate(s) in {args.specs} — "
+                 f"commit the file")
+        else:
+            _out("no gate beat its committed value; nothing to ratchet")
+    return 0
+
+
 def cmd_trace(args, storage: Storage) -> int:
     """``ptpu trace`` — read a running server's tail-sampled flight
     recorder (ISSUE 12, docs/tracing.md): recorder status, the N
@@ -1601,6 +1693,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="artifact dir for POST /profile device "
                         "captures (default $PTPU_PROFILE_DIR or "
                         "<tmp>/ptpu-profiles)")
+    s.add_argument("--slo-specs", default="",
+                   help="SLO spec file (docs/slo.md) evaluated "
+                        "continuously against this server's metrics; "
+                        "default: the built-in availability/latency/"
+                        "freshness objectives")
+    s.add_argument("--slo-interval-ms", type=float, default=1000.0,
+                   help="SLO evaluation tick; 0 disables the engine")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -1704,6 +1803,34 @@ def build_parser() -> argparse.ArgumentParser:
             c.add_argument("--drift-threshold", type=float,
                            default=None)
             c.add_argument("--canary-probes", type=int, default=None)
+
+    s = sub.add_parser(
+        "slo", help="service-level objectives: live burn rates from a "
+                    "running server, or capacity-gate a load_harness "
+                    "run against committed SLOs (docs/slo.md)")
+    slo_sub = s.add_subparsers(dest="slo_command", required=True)
+    c = slo_sub.add_parser(
+        "status", help="per-spec burn rates, budgets, breach state "
+                       "from GET /slo.json (exit 1 while burning)")
+    c.add_argument("--ip", default="127.0.0.1")
+    c.add_argument("--port", type=int, default=8000)
+    c.add_argument("--accesskey", default="")
+    c.add_argument("--https", action="store_true")
+    c.add_argument("--insecure", action="store_true")
+    c = slo_sub.add_parser(
+        "check", help="gate a CAPACITY.json against the committed "
+                      "spec file's capacity section (the CI merge "
+                      "gate; regressions fail naming spec, window, "
+                      "and measured value)")
+    c.add_argument("--capacity", default="CAPACITY.json",
+                   help="capacity model emitted by "
+                        "benchmarks/load_harness.py")
+    c.add_argument("--specs", default="slo/specs/ci.json",
+                   help="committed SLO spec file with the capacity "
+                        "gates")
+    c.add_argument("--update", action="store_true",
+                   help="ratchet: tighten committed gates toward a "
+                        "better measurement (never loosens)")
 
     s = sub.add_parser(
         "trace", help="flight recorder: list the slowest retained "
@@ -1878,6 +2005,7 @@ COMMANDS = {
     "release": cmd_release,
     "cache": cmd_cache,
     "stream": cmd_stream,
+    "slo": cmd_slo,
     "trace": cmd_trace,
     "batchpredict": cmd_batchpredict,
     "start-all": cmd_start_all,

@@ -213,7 +213,13 @@ def window_quantile(start: List[Tuple[float, int]],
     bucket ARE the window's own histogram; the rollout health gate
     windows candidate-vs-stable p99 this way). Interpolates inside the
     target bucket like :meth:`StreamingHistogram.quantile`; returns
-    None on an empty window or mismatched snapshots."""
+    None on an empty window, mismatched snapshots, or a *wrapped*
+    window (any per-bucket delta negative — the histogram was reset or
+    swapped between the snapshots, so the delta is not a histogram of
+    anything; before this guard a reset mid-window could synthesize
+    quantiles out of garbage, and a lookback that predates the first
+    sample could report "quantiles" from an empty delta instead of
+    admitting it has no data — ISSUE 15 satellite)."""
     if not 0.0 <= q <= 1.0:
         raise ValueError("q must be in [0, 1]")
     if len(start) != len(now):
@@ -225,7 +231,12 @@ def window_quantile(start: List[Tuple[float, int]],
     for (le_s, cum_s), (le_n, cum_n) in zip(start, now):
         if le_s != le_n:
             return None
-        deltas.append((le_n, (cum_n - prev_n) - (cum_s - prev_s)))
+        d = (cum_n - prev_n) - (cum_s - prev_s)
+        if d < 0:
+            # the "now" snapshot has FEWER observations than "start"
+            # in this bucket: reset/swap between snapshots — refuse
+            return None
+        deltas.append((le_n, d))
         prev_s, prev_n = cum_s, cum_n
     total = sum(c for _, c in deltas)
     if total <= 0:

@@ -250,6 +250,14 @@ class MetricsRegistry:
                     f"metric {name!r} already registered as {fam.kind}")
         return fam
 
+    def get(self, name: str) -> Optional[_Family]:
+        """The registered family called ``name`` (None when absent) —
+        the read side consumers like the SLO engine evaluate against:
+        ``family.kind`` says how to read it, ``family.children()``
+        yields ``(label items, child)`` pairs."""
+        with self._lock:
+            return self._families.get(name)
+
     def counter(self, name: str, help: str = "") -> _Family:
         return self._family(name, help, "counter")
 

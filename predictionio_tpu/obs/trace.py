@@ -398,6 +398,9 @@ class Tracer:
       this tracer's own duration histogram once ``min_samples`` have
       been seen (before that, ``slow_floor_ms`` when set, else nothing
       is "slow" yet). A fixed ``slow_ms`` overrides the adaptive rule.
+    - ``slo`` (or whatever reason :meth:`force_retention` set) — the
+      SLO engine is mid-breach and EVERY trace is evidence: retain
+      unconditionally until the burn clears (ISSUE 15)
     - anything a caller passed to :meth:`Trace.mark` (e.g. ``stream``)
     """
 
@@ -411,6 +414,16 @@ class Tracer:
         self._started = 0
         self._retained: Dict[str, int] = {}
         self._count_lock = threading.Lock()
+        #: while set, finish() retains every trace the normal policy
+        #: would drop, under this reason (the SLO engine's burn window:
+        #: every violation must arrive with flight-recorder exemplars)
+        self._force_reason: Optional[str] = None
+
+    def force_retention(self, reason: Optional[str]) -> None:
+        """Turn unconditional retention on (``reason``, e.g. ``"slo"``)
+        or back off (None). The ring stays bounded either way — a long
+        burn evicts its own oldest evidence, never grows memory."""
+        self._force_reason = reason or None
 
     # -- lifecycle ---------------------------------------------------------
     def begin(self, name: str, traceparent: Optional[str] = None,
@@ -466,6 +479,10 @@ class Tracer:
                 # otherwise retain every request as "slow"
                 if threshold is not None and duration > threshold:
                     reason = "slow"
+        if reason is None:
+            # SLO-burn force-retention is the WEAKEST reason: a trace
+            # that is also slow/errored keeps its specific attribution
+            reason = self._force_reason
         # the duration feeds the adaptive threshold AFTER the verdict:
         # a single slow burst should be retained against the p99 that
         # preceded it, not against itself
@@ -498,6 +515,7 @@ class Tracer:
             "evicted": self.recorder.dropped,
             "slowThresholdMs": (round(threshold * 1000, 3)
                                 if threshold is not None else None),
+            "forcedReason": self._force_reason,
             "recent": [t.summary() for t in self.recorder.recent(5)],
         }
 
@@ -514,13 +532,14 @@ class Tracer:
         retained_fam = registry.gauge(
             "pio_trace_retained_total",
             "Traces retained by the tail sampler, by reason "
-            "(slow | error | deadline | fault | stream)")
+            "(slow | error | deadline | fault | stream | slo)")
 
         def _bind(fam, reason):
             fam.labels(reason=reason).set_fn(
                 lambda: float(self._retained.get(reason, 0)))
 
-        for r in ("slow", "error", "deadline", "fault", "stream"):
+        for r in ("slow", "error", "deadline", "fault", "stream",
+                  "slo"):
             _bind(retained_fam, r)
         registry.gauge(
             "pio_trace_ring_size",

@@ -293,6 +293,18 @@ class ServerConfig:
     #: artifact directory for on-demand ``POST /profile`` device
     #: captures (None: $PTPU_PROFILE_DIR, else <tmp>/ptpu-profiles)
     profile_dir: Optional[str] = None
+    #: SLO engine (ISSUE 15, docs/slo.md): declarative service
+    #: objectives evaluated continuously against this server's live
+    #: metric registry via multi-window error-budget burn rates
+    #: (pio_slo_* series, /slo.json, an slo block on /status.json).
+    #: None = the built-in default specs (availability + latency on
+    #: /queries.json, freshness while streaming); a path loads a
+    #: committed spec file (slo/specs/*.json). Breach transitions
+    #: force-retain flight-recorder traces for the duration of the
+    #: burn, so every violation arrives with exemplar evidence.
+    slo_specs: Optional[str] = None
+    #: evaluation tick; 0 disables the SLO engine entirely
+    slo_interval_ms: float = 1000.0
     #: consecutive failed dispatches on one replicated lane before the
     #: lane is declared dead and its traffic redistributed across the
     #: surviving lanes (degraded mode — pio_serving_degraded)
@@ -594,6 +606,50 @@ class QueryServer:
         self.stream = None
         if self.config.streaming:
             self.start_stream()
+        # SLO engine (ISSUE 15, docs/slo.md): every objective is
+        # accounted against the registry built above, on a background
+        # tick. The server owns it (like the tracer/registry) so
+        # direct query() embedders burn the same budgets HTTP traffic
+        # does; build_app serves /slo.json off it. Breach transitions
+        # flip the tracer into force-retention — the flight recorder
+        # carries the evidence for every violation counted.
+        self.slo = None
+        if self.config.slo_interval_ms > 0:
+            from ..slo import SLOEngine, default_specs, load_specs
+
+            if self.config.slo_specs:
+                # fail fast at deploy: a server that silently dropped
+                # its objectives is worse than one that errors
+                slo_specs, _ = load_specs(self.config.slo_specs)
+            else:
+                slo_specs = default_specs(
+                    streaming=self.config.streaming)
+            self.slo = SLOEngine(self.metrics, slo_specs,
+                                 on_transition=self._on_slo_transition)
+            self.slo.register_metrics(self.metrics)
+            self.slo.start(self.config.slo_interval_ms / 1000.0)
+
+    def _on_slo_transition(self, spec, breached: bool, info) -> None:
+        """ok↔breach edge hook: while ANY spec burns, the tail sampler
+        retains every trace (reason ``slo``) — an SLO violation must
+        never arrive without flight-recorder exemplars riding along."""
+        tracer = self.tracer
+        if tracer is None or self.slo is None:
+            return
+        tracer.force_retention("slo" if self.slo.burning() else None)
+
+    def slo_status(self) -> dict:
+        """The ``slo`` block of ``/status.json`` (and ``/slo.json``)."""
+        if self.slo is None:
+            return {"enabled": False,
+                    "hint": "deploy with --slo-specs FILE (or leave "
+                            "slo_interval_ms at its default) to "
+                            "evaluate service objectives"}
+        return self.slo.status()
+
+    def stop_slo(self) -> None:
+        if self.slo is not None:
+            self.slo.stop()
 
     def _warm_serving(self, gen: int) -> None:
         """Pre-compile the serving path's device shapes (single query +
@@ -2315,6 +2371,26 @@ def build_app(server: QueryServer) -> HTTPApp:
         return ("<li>" + html.escape(" · ".join(parts))
                 + " (<a href='/stream.json'>stream.json</a>)</li>")
 
+    def _slo_line() -> str:
+        """One status-page line on the SLO engine: specs watched,
+        anything burning, the thinnest remaining budget (ISSUE 15)."""
+        s = server.slo_status()
+        if not s.get("enabled", False) or not s.get("specs"):
+            return ""
+        parts = [f"SLOs: {len(s['specs'])} watched"]
+        burning = s.get("burning") or []
+        if burning:
+            parts.append("BURNING: " + ", ".join(burning))
+        budgets = [(sp["budgetRemaining"], sp["name"])
+                   for sp in s["specs"]
+                   if sp.get("budgetRemaining") is not None]
+        if budgets:
+            worst, name = min(budgets)
+            parts.append(f"thinnest budget {worst * 100:.1f}% "
+                         f"({name})")
+        return ("<li>" + html.escape(" · ".join(parts))
+                + " (<a href='/slo.json'>slo.json</a>)</li>")
+
     def _trace_line() -> str:
         """One status-page line on the flight recorder: retained
         count/ring, live slow threshold, profiler state."""
@@ -2449,7 +2525,7 @@ def build_app(server: QueryServer) -> HTTPApp:
 <li>average serving: {server.avg_serving_sec * 1000:.3f} ms</li>
 <li>last serving: {server.last_serving_sec * 1000:.3f} ms</li>
 <li>compiles since warm: {server.recompile_sentinel.since_armed}</li>
-{_sharding_line()}{_pipeline_line()}{_stream_line()}{_cache_line()}{_trace_line()}
+{_sharding_line()}{_pipeline_line()}{_stream_line()}{_cache_line()}{_slo_line()}{_trace_line()}
 </ul>{_mesh_panel()}{release_panel}{table}
 <p><a href="/metrics">Prometheus metrics</a> ·
 <a href="/status.json">status.json</a></p></body></html>"""
@@ -2473,6 +2549,7 @@ def build_app(server: QueryServer) -> HTTPApp:
             "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
             "pipeline": server.pipeline_status(),
+            "slo": server.slo_status(),
             "trace": (server.tracer.status()
                       if server.tracer is not None
                       else {"enabled": False}),
@@ -2492,6 +2569,14 @@ def build_app(server: QueryServer) -> HTTPApp:
                       else {"enabled": False}),
             **_phase_table(),
         })
+
+    # -- service-level objectives (ISSUE 15, docs/slo.md) --------------------
+    @app.route("GET", "/slo.json")
+    def slo_json(req: Request) -> Response:
+        """Live SLO state: per-spec burn rates (fast/slow window),
+        error-budget remaining, breach/violation accounting — what
+        ``ptpu slo status`` prints."""
+        return json_response(server.slo_status())
 
     # -- streaming incremental training (ISSUE 10) ---------------------------
     @app.route("GET", "/stream.json")
@@ -2720,6 +2805,7 @@ def build_app(server: QueryServer) -> HTTPApp:
         if server.rollout is not None:
             server.rollout.stop()  # loop only; bindings die with us
         server.stop_stream()  # cursor already persisted; no-op if off
+        server.stop_slo()  # evaluator thread only; series stay readable
 
         def delayed_shutdown():
             # grace period so THIS response flushes before the listener

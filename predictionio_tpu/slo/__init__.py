@@ -1,0 +1,25 @@
+"""Service-level objectives: burn-rate accounting + the capacity gate.
+
+The observability layer's enforcement half (ISSUE 15, docs/slo.md):
+:class:`SLOSpec` declares what the service promises,
+:class:`SLOEngine` continuously accounts the promise against the live
+``pio_*`` telemetry with multi-window error-budget burn rates, and the
+:mod:`.gate` turns ``load_harness``'s measured capacity model into a
+CI merge gate with ratchet semantics.
+"""
+
+from .engine import SLOEngine
+from .gate import GATE_KEYS, gate_capacity, ratchet_gates, write_gates
+from .spec import OBJECTIVES, SLOSpec, default_specs, load_specs
+
+__all__ = [
+    "GATE_KEYS",
+    "OBJECTIVES",
+    "SLOEngine",
+    "SLOSpec",
+    "default_specs",
+    "gate_capacity",
+    "load_specs",
+    "ratchet_gates",
+    "write_gates",
+]

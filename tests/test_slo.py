@@ -1,0 +1,712 @@
+"""SLO engine battery (ISSUE 15): burn-rate math under a synthetic
+clock, multi-window agreement, budget exhaustion at the configured
+rate, label-scoped isolation, cold-window insufficiency, /slo.json +
+pio_slo_* rendering through a real in-process engine server, the
+breach→flight-recorder force-retention wiring, and the capacity gate's
+ratchet semantics."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import MetricsRegistry, StreamingHistogram
+from predictionio_tpu.obs.histogram import window_quantile
+from predictionio_tpu.obs.trace import Tracer
+from predictionio_tpu.slo import (
+    SLOEngine,
+    SLOSpec,
+    default_specs,
+    gate_capacity,
+    load_specs,
+    ratchet_gates,
+    write_gates,
+)
+
+
+# ---------------------------------------------------------------------------
+# spec validation + (de)serialization
+# ---------------------------------------------------------------------------
+
+class TestSLOSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOSpec(name="", objective="availability")
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="uptime")
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="availability", target=1.0)
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="latency")  # no threshold
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="availability",
+                    window_fast_sec=600, window_slow_sec=60)
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="availability",
+                    window_slow_sec=3600, budget_window_sec=60)
+
+    def test_resolved_metric_by_objective_and_scope(self):
+        assert SLOSpec(name="a", objective="availability") \
+            .resolved_metric() == "pio_http_requests_total"
+        assert SLOSpec(name="f", objective="freshness",
+                       threshold_ms=1000).resolved_metric() \
+            == "pio_stream_freshness_seconds"
+        lat = SLOSpec(name="l", objective="latency", threshold_ms=100)
+        assert lat.resolved_metric() == "pio_query_latency_seconds"
+        assert SLOSpec(name="l2", objective="latency", threshold_ms=100,
+                       scope={"route": "/queries.json"}) \
+            .resolved_metric() == "pio_http_request_duration_seconds"
+        assert SLOSpec(name="l3", objective="latency", threshold_ms=100,
+                       scope={"arm": "candidate"}) \
+            .resolved_metric() == "pio_release_latency_seconds"
+        assert SLOSpec(name="l4", objective="latency", threshold_ms=100,
+                       metric="my_hist").resolved_metric() == "my_hist"
+
+    def test_json_roundtrip(self):
+        spec = SLOSpec(name="x", objective="latency", target=0.95,
+                       threshold_ms=150.0, scope={"route": "/q"},
+                       window_fast_sec=5, window_slow_sec=20,
+                       budget_window_sec=60)
+        again = SLOSpec.from_json(spec.to_json())
+        assert again == spec
+
+    def test_from_json_rejects_unknown_fields(self):
+        with pytest.raises(ValueError, match="unknown"):
+            SLOSpec.from_json({"name": "x",
+                               "objective": "availability",
+                               "burn": 2})
+
+    def test_load_specs_file(self, tmp_path):
+        path = tmp_path / "specs.json"
+        path.write_text(json.dumps({
+            "specs": [{"name": "a", "objective": "availability"}],
+            "capacity": {"staged": {"min_knee_qps": 5}}}))
+        specs, gates = load_specs(str(path))
+        assert specs[0].name == "a"
+        assert gates["staged"]["min_knee_qps"] == 5
+        path.write_text(json.dumps({"specs": []}))
+        with pytest.raises(ValueError):
+            load_specs(str(path))
+
+    def test_default_specs(self):
+        names = {s.name for s in default_specs()}
+        assert "queries-availability" in names
+        assert "stream-freshness" not in names
+        names = {s.name for s in default_specs(streaming=True)}
+        assert "stream-freshness" in names
+
+    def test_committed_ci_specs_parse(self):
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "slo", "specs", "ci.json")
+        specs, gates = load_specs(path)
+        assert {s.objective for s in specs} == {
+            "availability", "latency", "freshness"}
+        assert gates  # the CI capacity gate has committed limits
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math under a synthetic clock
+# ---------------------------------------------------------------------------
+
+def make_engine(spec, families):
+    """Registry + engine + a fake clock list: ``clock[0]`` is now."""
+    reg = MetricsRegistry()
+    made = {}
+    for name, kind in families.items():
+        made[name] = (reg.counter(name) if kind == "counter"
+                      else reg.histogram(name))
+    clock = [0.0]
+    eng = SLOEngine(reg, [spec] if isinstance(spec, SLOSpec) else spec,
+                    clock=lambda: clock[0])
+    return reg, eng, clock, made
+
+
+AVAIL = dict(name="avail", objective="availability", target=0.9,
+             scope={"route": "/q"}, window_fast_sec=5,
+             window_slow_sec=20, budget_window_sec=60)
+
+
+class TestBurnMath:
+    def test_fast_slow_agree_under_constant_rate(self):
+        """A constant error fraction reads the SAME burn on both
+        windows once both are covered — the multi-window pair only
+        disagrees during transients."""
+        spec = SLOSpec(**AVAIL)
+        _, eng, clock, fams = make_engine(
+            spec, {"pio_http_requests_total": "counter"})
+        ok = fams["pio_http_requests_total"].labels(route="/q",
+                                                    status="200")
+        bad = fams["pio_http_requests_total"].labels(route="/q",
+                                                     status="500")
+        for t in range(30):
+            clock[0] = float(t)
+            ok.inc(5)
+            bad.inc(5)  # 50% errors, budget 10% → burn 5
+            eng.observe()
+        sp = eng.status()["specs"][0]
+        assert sp["state"] == "ok"  # 5 < burn_fast default 14.4
+        assert sp["burnFast"] == pytest.approx(5.0)
+        assert sp["burnSlow"] == pytest.approx(5.0)
+
+    def test_budget_exhaustion_exactly_at_configured_rate(self):
+        """Burning at exactly 1× budget over the whole budget window
+        leaves 0 remaining; at 0.5× it leaves half."""
+        for frac, remaining in ((0.10, 0.0), (0.05, 0.5)):
+            spec = SLOSpec(**AVAIL)
+            _, eng, clock, fams = make_engine(
+                spec, {"pio_http_requests_total": "counter"})
+            ok = fams["pio_http_requests_total"].labels(route="/q",
+                                                        status="200")
+            bad = fams["pio_http_requests_total"].labels(route="/q",
+                                                         status="503")
+            for t in range(70):  # past the 60s budget window
+                clock[0] = float(t)
+                ok.inc(100 * (1 - frac))
+                bad.inc(100 * frac)
+                eng.observe()
+            sp = eng.status()["specs"][0]
+            assert sp["budgetRemaining"] == pytest.approx(
+                remaining, abs=1e-6)
+
+    def test_breach_transition_counts_violations_once(self):
+        spec = SLOSpec(**dict(AVAIL, burn_fast=2.0, burn_slow=2.0))
+        _, eng, clock, fams = make_engine(
+            spec, {"pio_http_requests_total": "counter"})
+        ok = fams["pio_http_requests_total"].labels(route="/q",
+                                                    status="200")
+        bad = fams["pio_http_requests_total"].labels(route="/q",
+                                                     status="500")
+        edges = []
+        eng.on_transition = lambda s, b, info: edges.append(b)
+        for t in range(30):
+            clock[0] = float(t)
+            ok.inc(10)
+            eng.observe()
+        assert eng.status()["specs"][0]["state"] == "ok"
+        for t in range(30, 70):  # sustained 50% errors → burn 5 ≥ 2
+            clock[0] = float(t)
+            ok.inc(5)
+            bad.inc(5)
+            eng.observe()
+        sp = eng.status()["specs"][0]
+        assert sp["state"] == "breach"
+        assert sp["violations"] == 1  # ONE transition, many ticks
+        assert eng.burning() == ["avail"]
+        for t in range(70, 140):  # recover
+            clock[0] = float(t)
+            ok.inc(10)
+            eng.observe()
+        sp = eng.status()["specs"][0]
+        assert sp["state"] == "ok"
+        assert sp["violations"] == 1
+        assert edges == [True, False]
+
+    def test_latency_objective_histogram_buckets(self):
+        spec = SLOSpec(name="lat", objective="latency", target=0.9,
+                       threshold_ms=100.0, burn_fast=1.5,
+                       burn_slow=1.5, window_fast_sec=5,
+                       window_slow_sec=20, budget_window_sec=60)
+        _, eng, clock, fams = make_engine(
+            spec, {"pio_query_latency_seconds": "histogram"})
+        hist = fams["pio_query_latency_seconds"].labels()
+        for t in range(40):
+            clock[0] = float(t)
+            for i in range(10):
+                # 30% of samples way past the 100ms threshold:
+                # budget 10% → burn 3 ≥ 1.5 on both windows
+                hist.observe(0.5 if i < 3 else 0.01)
+            eng.observe()
+        sp = eng.status()["specs"][0]
+        assert sp["state"] == "breach"
+        assert sp["burnFast"] == pytest.approx(3.0, rel=0.05)
+        assert sp["current"]["p99Ms"] is not None
+        assert sp["current"]["badFraction"] == pytest.approx(
+            0.3, rel=0.05)
+
+    def test_label_scope_isolates_one_routes_breach(self):
+        """Errors on route A breach A's spec; B's spec — same family,
+        different scope — stays ok."""
+        spec_a = SLOSpec(**dict(AVAIL, name="route-a",
+                                scope={"route": "/a"},
+                                burn_fast=2.0, burn_slow=2.0))
+        spec_b = SLOSpec(**dict(AVAIL, name="route-b",
+                                scope={"route": "/b"},
+                                burn_fast=2.0, burn_slow=2.0))
+        _, eng, clock, fams = make_engine(
+            [spec_a, spec_b], {"pio_http_requests_total": "counter"})
+        fam = fams["pio_http_requests_total"]
+        a_ok = fam.labels(route="/a", status="200")
+        a_bad = fam.labels(route="/a", status="500")
+        b_ok = fam.labels(route="/b", status="200")
+        for t in range(40):
+            clock[0] = float(t)
+            a_ok.inc(5)
+            a_bad.inc(5)
+            b_ok.inc(10)
+            eng.observe()
+        by_name = {s["name"]: s for s in eng.status()["specs"]}
+        assert by_name["route-a"]["state"] == "breach"
+        assert by_name["route-b"]["state"] == "ok"
+        assert eng.burning() == ["route-a"]
+
+    def test_cold_window_is_insufficient_data_not_breach(self):
+        """100% errors from tick one must NOT breach while the slow
+        window still reaches back past the first sample (ISSUE 15
+        satellite: a cold window says nothing)."""
+        spec = SLOSpec(**dict(AVAIL, burn_fast=1.0, burn_slow=1.0))
+        _, eng, clock, fams = make_engine(
+            spec, {"pio_http_requests_total": "counter"})
+        bad = fams["pio_http_requests_total"].labels(route="/q",
+                                                     status="500")
+        for t in range(10):  # < window_slow_sec=20
+            clock[0] = float(t)
+            bad.inc(10)
+            eng.observe()
+        sp = eng.status()["specs"][0]
+        assert sp["state"] == "insufficient_data"
+        assert sp["violations"] == 0
+        # burn is reported (since-start) but never acted on
+        assert sp["burnFast"] == pytest.approx(10.0)
+        for t in range(10, 40):  # windows now covered → breach
+            clock[0] = float(t)
+            bad.inc(10)
+            eng.observe()
+        sp = eng.status()["specs"][0]
+        assert sp["state"] == "breach"
+        assert sp["violations"] == 1
+
+    def test_idle_and_missing_metric(self):
+        spec = SLOSpec(**AVAIL)
+        reg = MetricsRegistry()
+        clock = [0.0]
+        eng = SLOEngine(reg, [spec], clock=lambda: clock[0])
+        for t in range(5):
+            clock[0] = float(t)
+            eng.observe()  # family does not exist yet
+        assert eng.status()["specs"][0]["state"] == "insufficient_data"
+        fam = reg.counter("pio_http_requests_total")
+        fam.labels(route="/q", status="200").inc(0)
+        for t in range(5, 40):
+            clock[0] = float(t)
+            eng.observe()
+        # family exists, windows covered, zero traffic → idle
+        assert eng.status()["specs"][0]["state"] == "idle"
+
+    def test_metrics_rendering(self):
+        spec = SLOSpec(**dict(AVAIL, burn_fast=2.0, burn_slow=2.0))
+        reg, eng, clock, fams = make_engine(
+            spec, {"pio_http_requests_total": "counter"})
+        eng.register_metrics(reg)
+        bad = fams["pio_http_requests_total"].labels(route="/q",
+                                                     status="500")
+        for t in range(40):
+            clock[0] = float(t)
+            bad.inc(10)
+            eng.observe()
+        text = reg.render()
+        assert 'pio_slo_burn_rate{slo="avail",window="fast"}' in text
+        assert 'pio_slo_burn_rate{slo="avail",window="slow"}' in text
+        assert 'pio_slo_breach{slo="avail"} 1' in text
+        assert 'pio_slo_violations_total{slo="avail"} 1' in text
+        assert 'pio_slo_budget_remaining{slo="avail"} 0' in text
+
+    def test_ticker_start_stop(self):
+        import time as _time
+
+        spec = SLOSpec(**AVAIL)
+        reg = MetricsRegistry()
+        reg.counter("pio_http_requests_total") \
+            .labels(route="/q", status="200").inc()
+        eng = SLOEngine(reg, [spec])
+        eng.start(0.01)
+        eng.start(0.01)  # idempotent
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline \
+                and eng.status()["ticks"] < 3:
+            _time.sleep(0.01)
+        assert eng.status()["ticks"] >= 3
+        assert eng.status()["running"]
+        eng.stop()
+        assert not eng.status()["running"]
+        eng.stop()  # idempotent
+
+    def test_duplicate_spec_names_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            SLOEngine(reg, [SLOSpec(**AVAIL), SLOSpec(**AVAIL)])
+
+
+# ---------------------------------------------------------------------------
+# breach → flight-recorder force-retention
+# ---------------------------------------------------------------------------
+
+class TestForceRetention:
+    def test_breach_forces_trace_retention(self):
+        """The QueryServer wiring in miniature: while a spec burns,
+        every finished trace is retained with reason ``slo``; after
+        recovery the normal tail-sampling policy resumes."""
+        spec = SLOSpec(**dict(AVAIL, burn_fast=2.0, burn_slow=2.0))
+        reg, eng, clock, fams = make_engine(
+            spec, {"pio_http_requests_total": "counter"})
+        tracer = Tracer(ring=16)
+
+        def on_transition(s, breached, info):
+            tracer.force_retention("slo" if eng.burning() else None)
+
+        eng.on_transition = on_transition
+        ok = fams["pio_http_requests_total"].labels(route="/q",
+                                                    status="200")
+        bad = fams["pio_http_requests_total"].labels(route="/q",
+                                                     status="500")
+        for t in range(30):
+            clock[0] = float(t)
+            ok.inc(10)
+            eng.observe()
+        trace = tracer.begin("healthy")
+        retained, _ = tracer.finish(trace, status=200, duration=0.001)
+        assert not retained  # fast + healthy → dropped
+        for t in range(30, 70):
+            clock[0] = float(t)
+            bad.inc(10)
+            eng.observe()
+        assert eng.burning() == ["avail"]
+        trace = tracer.begin("during-burn")
+        retained, reason = tracer.finish(trace, status=200,
+                                         duration=0.001)
+        assert retained and reason == "slo"
+        assert tracer.recorder.get(trace.trace_id) is not None
+        # stronger reasons keep their specific attribution
+        trace = tracer.begin("errored-during-burn")
+        _, reason = tracer.finish(trace, status=500, duration=0.001)
+        assert reason == "error"
+        for t in range(70, 140):
+            clock[0] = float(t)
+            ok.inc(10)
+            eng.observe()
+        assert eng.burning() == []
+        trace = tracer.begin("after-recovery")
+        retained, _ = tracer.finish(trace, status=200, duration=0.001)
+        assert not retained
+
+
+# ---------------------------------------------------------------------------
+# window_quantile regression battery (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+class TestWindowQuantileColdWindows:
+    def test_empty_window_is_none(self):
+        h = StreamingHistogram([0.1, 1.0])
+        h.observe(0.05)
+        snap = h.bucket_counts()
+        assert window_quantile(snap, snap, 0.99) is None
+
+    def test_no_samples_at_all_is_none(self):
+        h = StreamingHistogram([0.1, 1.0])
+        snap = h.bucket_counts()
+        assert window_quantile(snap, snap, 0.5) is None
+
+    def test_partial_window_uses_only_the_delta(self):
+        h = StreamingHistogram([0.1, 1.0, 10.0])
+        for _ in range(100):
+            h.observe(0.05)  # old traffic, before the window
+        start = h.bucket_counts()
+        for _ in range(10):
+            h.observe(5.0)  # everything IN the window is slow
+        q = window_quantile(start, h.bucket_counts(), 0.5)
+        assert q is not None and q > 1.0  # old fast samples invisible
+
+    def test_wrapped_window_reset_between_snapshots_is_none(self):
+        """A histogram reset (rebind swapping series) makes 'now' hold
+        FEWER counts than 'start' in some bucket — the delta is not a
+        histogram of anything and must read as no-data, not as a
+        quantile."""
+        h = StreamingHistogram([0.1, 1.0])
+        for _ in range(50):
+            h.observe(0.05)
+        start = h.bucket_counts()
+        h.reset()
+        for _ in range(10):
+            h.observe(5.0)
+        assert window_quantile(start, h.bucket_counts(), 0.5) is None
+
+    def test_mismatched_bounds_is_none(self):
+        a = StreamingHistogram([0.1, 1.0])
+        b = StreamingHistogram([0.2, 2.0])
+        a.observe(0.05)
+        b.observe(0.05)
+        assert window_quantile(a.bucket_counts(),
+                               b.bucket_counts(), 0.5) is None
+        c = StreamingHistogram([0.1])
+        c.observe(0.05)
+        assert window_quantile(c.bucket_counts(),
+                               a.bucket_counts(), 0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# the capacity gate (ratchet semantics)
+# ---------------------------------------------------------------------------
+
+CAPACITY = {
+    "step_sec": 3.0,
+    "configs": {
+        "staged": {
+            "step_sec": 3.0,
+            "frontier": [{"offered_qps": 8.0}, {"offered_qps": 32.0}],
+            "knee_qps": 32.0,
+            "p99_at_80pct_knee_ms": 120.0,
+            "freshness_under_load_ms": 800.0,
+        },
+    },
+}
+
+
+class TestCapacityGate:
+    def test_pass(self):
+        gates = {"staged": {"min_knee_qps": 16.0,
+                            "max_p99_at_80pct_knee_ms": 500.0}}
+        assert gate_capacity(CAPACITY, gates) == []
+
+    def test_regression_names_spec_window_and_value(self):
+        gates = {"staged": {"min_knee_qps": 64.0}}
+        failures = gate_capacity(CAPACITY, gates)
+        assert len(failures) == 1
+        msg = failures[0]
+        assert "staged" in msg
+        assert "knee_qps 32.0" in msg          # the measured value
+        assert "min_knee_qps 64.0" in msg      # the committed spec
+        assert "3.0s/rate" in msg              # the window
+        assert "8.0-32.0 qps" in msg
+
+    def test_missing_config_and_missing_measurement_fail(self):
+        failures = gate_capacity(
+            CAPACITY, {"sharded": {"min_knee_qps": 1.0}})
+        assert "no measurement" in failures[0]
+        failures = gate_capacity(
+            CAPACITY,
+            {"staged": {"max_device_idle_fraction": 0.5}})
+        assert "was not measured" in failures[0]
+
+    def test_unknown_gate_key_fails_loud(self):
+        failures = gate_capacity(
+            CAPACITY, {"staged": {"min_tps": 5}})
+        assert "unknown gate key" in failures[0]
+
+    def test_ratchet_tightens_never_loosens(self):
+        gates = {"staged": {"min_knee_qps": 16.0,
+                            "max_p99_at_80pct_knee_ms": 100.0}}
+        new, changes = ratchet_gates(CAPACITY, gates)
+        # knee 32 × 0.8 = 25.6 > 16 → floor rises
+        assert new["staged"]["min_knee_qps"] == pytest.approx(25.6)
+        # measured p99 120 is WORSE than the committed 100 ceiling:
+        # the ratchet must not loosen it
+        assert new["staged"]["max_p99_at_80pct_knee_ms"] == 100.0
+        assert len(changes) == 1
+        new2, changes2 = ratchet_gates(CAPACITY, new)
+        assert changes2 == []  # fixed point
+
+    def test_write_gates_preserves_specs(self, tmp_path):
+        path = tmp_path / "ci.json"
+        path.write_text(json.dumps({
+            "specs": [{"name": "a", "objective": "availability"}],
+            "capacity": {"staged": {"min_knee_qps": 1.0}}}))
+        write_gates(str(path), {"staged": {"min_knee_qps": 2.0}})
+        specs, gates = load_specs(str(path))
+        assert specs[0].name == "a"
+        assert gates["staged"]["min_knee_qps"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# the live HTTP surface: /slo.json, /status.json block, /metrics
+# ---------------------------------------------------------------------------
+
+def _boot(tmp_path, spec_file=None):
+    from datetime import datetime, timezone
+
+    import numpy as np
+
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.data.storage.base import (
+        STATUS_COMPLETED,
+        EngineInstance,
+    )
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        create_engine_server,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+
+    rng = np.random.default_rng(0)
+    n_users = n_items = rank = 16
+    model = ALSModel(
+        user_factors=rng.standard_normal(
+            (n_users, rank)).astype(np.float32),
+        item_factors=rng.standard_normal(
+            (n_items, rank)).astype(np.float32),
+        n_users=n_users, n_items=n_items,
+        user_ids=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_ids=BiMap({f"i{i}": i for i in range(n_items)}),
+        params=ALSParams(rank=rank))
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.apps().insert(App(0, "slotest"))
+    ctx = Context(app_name="slotest", _storage=storage)
+    now = datetime.now(timezone.utc)
+    inst = EngineInstance(
+        id="slo-test", status=STATUS_COMPLETED, start_time=now,
+        end_time=now, engine_id="slo-test", engine_version="1",
+        engine_variant="engine.json", engine_factory="synthetic")
+    qs = QueryServer(
+        ctx, recommendation_engine(),
+        default_engine_params("slotest", rank=rank), [model], inst,
+        ServerConfig(warm_start=False, slo_specs=spec_file,
+                     slo_interval_ms=50.0))
+    srv = create_engine_server(qs, host="127.0.0.1", port=0)
+    srv.start_background()
+    return qs, srv
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+class TestHTTPSurface:
+    def test_slo_json_status_block_and_metrics(self, tmp_path):
+        spec_file = tmp_path / "specs.json"
+        spec_file.write_text(json.dumps({"specs": [{
+            "name": "smoke-latency", "objective": "latency",
+            "target": 0.9, "threshold_ms": 200.0,
+            "scope": {"route": "/queries.json"},
+            "window_fast_sec": 0.2, "window_slow_sec": 0.5,
+            "budget_window_sec": 2.0}]}))
+        qs, srv = _boot(tmp_path, spec_file=str(spec_file))
+        try:
+            import time as _time
+
+            for i in range(20):
+                body = json.dumps({"user": f"u{i % 16}",
+                                   "num": 3}).encode()
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/queries.json",
+                    data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30).read()
+            deadline = _time.monotonic() + 10
+            payload = {}
+            while _time.monotonic() < deadline:
+                # keep traffic flowing: the smoke windows are so
+                # short that a finished burst drains back to idle
+                body = json.dumps({"user": "u1", "num": 3}).encode()
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/queries.json",
+                    data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30).read()
+                payload = _get(srv.port, "/slo.json")
+                sp = (payload.get("specs") or [{}])[0]
+                if sp.get("state") in ("ok", "breach"):
+                    break
+                _time.sleep(0.05)
+            assert payload["enabled"] and payload["running"]
+            assert payload["specs"][0]["name"] == "smoke-latency"
+            assert payload["specs"][0]["state"] in ("ok", "breach")
+            status = _get(srv.port, "/status.json")
+            assert status["slo"]["specs"][0]["name"] == "smoke-latency"
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=30).read().decode()
+            assert 'pio_slo_burn_rate{slo="smoke-latency"' in text
+            assert "pio_slo_violations_total" in text
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/",
+                timeout=30).read().decode()
+            assert "slo.json" in page
+        finally:
+            qs.stop_slo()
+            srv.shutdown()
+
+    def test_default_specs_active_without_spec_file(self, tmp_path):
+        qs, srv = _boot(tmp_path)
+        try:
+            payload = _get(srv.port, "/slo.json")
+            assert payload["enabled"]
+            names = {s["name"] for s in payload["specs"]}
+            assert "queries-availability" in names
+        finally:
+            qs.stop_slo()
+            srv.shutdown()
+
+    def test_slo_disabled_reports_hint(self, tmp_path):
+        """slo_interval_ms=0 turns the engine off; /slo.json and the
+        status block say so instead of 404ing."""
+        qs, srv = _boot(tmp_path)
+        try:
+            # a server without an engine (slo_interval_ms=0 leaves
+            # qs.slo as None) reports disabled with the enable hint
+            qs.stop_slo()
+            qs.slo = None
+            payload = _get(srv.port, "/slo.json")
+            assert payload["enabled"] is False
+            assert "hint" in payload
+        finally:
+            srv.shutdown()
+
+
+class TestDeployFlagSync:
+    def test_cli_deploy_flags_cover_slo_config(self):
+        """`ptpu deploy --slo-specs/--slo-interval-ms` defaults must
+        track ServerConfig's (the pattern the trace/stream flags
+        follow)."""
+        from predictionio_tpu.cli import build_parser
+        from predictionio_tpu.server.engineserver import ServerConfig
+
+        args = build_parser().parse_args(["deploy"])
+        cfg = ServerConfig()
+        assert (args.slo_specs or None) == cfg.slo_specs
+        assert args.slo_interval_ms == cfg.slo_interval_ms
+
+    def test_slo_check_cli(self, tmp_path, capsys):
+        from predictionio_tpu.cli import main as cli_main
+
+        cap = tmp_path / "CAPACITY.json"
+        cap.write_text(json.dumps(CAPACITY))
+        specs = tmp_path / "ci.json"
+        specs.write_text(json.dumps({
+            "specs": [{"name": "a", "objective": "availability"}],
+            "capacity": {"staged": {"min_knee_qps": 16.0}}}))
+        rc = cli_main(["slo", "check", "--capacity", str(cap),
+                       "--specs", str(specs)], storage=object())
+        assert rc == 0
+        assert "PASS" in capsys.readouterr().out
+        specs.write_text(json.dumps({
+            "specs": [{"name": "a", "objective": "availability"}],
+            "capacity": {"staged": {"min_knee_qps": 64.0}}}))
+        rc = cli_main(["slo", "check", "--capacity", str(cap),
+                       "--specs", str(specs)], storage=object())
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "knee_qps 32.0" in err and "64.0" in err
+
+    def test_slo_check_update_ratchets(self, tmp_path, capsys):
+        from predictionio_tpu.cli import main as cli_main
+
+        cap = tmp_path / "CAPACITY.json"
+        cap.write_text(json.dumps(CAPACITY))
+        specs = tmp_path / "ci.json"
+        specs.write_text(json.dumps({
+            "specs": [{"name": "a", "objective": "availability"}],
+            "capacity": {"staged": {"min_knee_qps": 16.0}}}))
+        rc = cli_main(["slo", "check", "--capacity", str(cap),
+                       "--specs", str(specs), "--update"],
+                      storage=object())
+        assert rc == 0
+        _, gates = load_specs(str(specs))
+        assert gates["staged"]["min_knee_qps"] == pytest.approx(25.6)
