@@ -43,11 +43,13 @@ from .core import (
     iter_py_files,
     run_check,
 )
+from .numerics import NUMERICS_RULES
 from .report import findings_to_json, findings_to_sarif
 from .rules import RULES, Rule
 from .sharding import SHARDING_RULES, count_sharding_pragmas
 
 __all__ = [
+    "NUMERICS_RULES",
     "SHARDING_RULES",
     "count_sharding_pragmas",
     "CheckContext",

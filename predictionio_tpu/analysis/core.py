@@ -479,6 +479,11 @@ class FunctionInfo:
         #: spec (implicit-reshard; collected by analysis/sharding.py)
         self.spec_sinks: Dict[int, Witness] = {}
         self.spec_constraints: Dict[int, str] = {}
+        #: param position → Witness: the param is reduced (sum/dot/
+        #: einsum/@) at operand precision — no f32 accumulator — so a
+        #: caller passing bf16/f16 inherits the loss
+        #: (low-precision-reduction; collected by analysis/numerics.py)
+        self.lowprec_sinks: Dict[int, Witness] = {}
 
     def hot(self, dir_parts: Set[str]) -> bool:
         return bool(set(self.mod.path.split("/")[:-1]) & dir_parts)
@@ -707,6 +712,11 @@ class ProjectIndex:
         for pos, (spec, w) in collect_spec_sinks(fn).items():
             fn.spec_sinks[pos] = w
             fn.spec_constraints[pos] = spec
+        # numerics-flow direct sites: params this function reduces at
+        # operand precision (low-precision-reduction)
+        from .numerics import collect_lowprec_sinks
+        for pos, w in collect_lowprec_sinks(fn).items():
+            fn.lowprec_sinks[pos] = w
 
     # -- propagation --------------------------------------------------
 
@@ -788,6 +798,13 @@ class ProjectIndex:
                         "callback-under-lock", fn.mod.path, call.line,
                         call.col, "", via=f"{callee.qname}#{pos}")
                     changed = True
+                if pos in callee.lowprec_sinks \
+                        and my_pos not in fn.lowprec_sinks:
+                    fn.lowprec_sinks[my_pos] = Witness(
+                        "low-precision-reduction", fn.mod.path,
+                        call.line, call.col, "",
+                        via=f"{callee.qname}#{pos}")
+                    changed = True
                 if pos in callee.spec_constraints \
                         and my_pos not in fn.spec_constraints:
                     fn.spec_sinks[my_pos] = Witness(
@@ -827,7 +844,8 @@ class ProjectIndex:
         while fn is not None and (fn.qname, pos) not in seen:
             seen.add((fn.qname, pos))
             sinks = {"index": fn.index_sinks, "call": fn.call_sinks,
-                     "spec": fn.spec_sinks}[kind]
+                     "spec": fn.spec_sinks,
+                     "lowprec": fn.lowprec_sinks}[kind]
             w = sinks.get(pos)
             if w is None:
                 break

@@ -450,10 +450,26 @@ def rule_recompile_hazard(mod: ModuleInfo,
                 continue
             if id(node) in flagged:
                 continue
-            test_loads = {n.id for n in ast.walk(node.test)
-                          if isinstance(n, ast.Name)
-                          and isinstance(n.ctx, ast.Load)}
-            bad = sorted(test_loads & traced)
+            test_loads: Dict[str, int] = {}
+            for n in ast.walk(node.test):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    test_loads[n.id] = test_loads.get(n.id, 0) + 1
+            # `x is None` / `x is not None` resolves by pytree STRUCTURE
+            # at trace time (None is a static empty pytree): a bounded
+            # Optional specialization, not a value-dependent retrace.
+            # Exempt names used ONLY that way in this test.
+            structural: Dict[str, int] = {}
+            for c in ast.walk(node.test):
+                if (isinstance(c, ast.Compare) and len(c.ops) == 1
+                        and isinstance(c.ops[0], (ast.Is, ast.IsNot))
+                        and isinstance(c.left, ast.Name)
+                        and isinstance(c.comparators[0], ast.Constant)
+                        and c.comparators[0].value is None):
+                    structural[c.left.id] = \
+                        structural.get(c.left.id, 0) + 1
+            bad = sorted(name for name, cnt in test_loads.items()
+                         if name in traced
+                         and structural.get(name) != cnt)
             if bad:
                 flagged.add(id(node))
                 kind = {ast.If: "if", ast.While: "while",
@@ -940,6 +956,16 @@ from .kernels import (  # noqa: E402 — registry assembly
     rule_missing_interpret_fallback,
     rule_vmem_overbudget,
 )
+from .metrics_catalog import (  # noqa: E402 — registry assembly
+    rule_metric_catalog_drift,
+)
+from .numerics import (  # noqa: E402 — registry assembly
+    rule_dequant_outside_funnel,
+    rule_low_precision_reduction,
+    rule_quantize_without_parity_gate,
+    rule_requant_torn_pair,
+    rule_unguarded_domain,
+)
 from .sharding import (  # noqa: E402 — registry assembly
     rule_implicit_reshard,
     rule_missing_donation_sharded,
@@ -1035,4 +1061,35 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
          "bus/plugin callbacks invoked while holding the publisher's "
          "lock (re-entrancy deadlock)",
          rule_callback_under_lock),
+    Rule("low-precision-reduction",
+         "sum/mean/dot/einsum/@ over bf16/f16 operands accumulating "
+         "at operand precision (no f32 preferred_element_type or "
+         "upcast) in models/ops/streaming — directly or through any "
+         "helper chain",
+         rule_low_precision_reduction, project=True),
+    Rule("dequant-outside-funnel",
+         "f32 materialization of quantized table data outside the "
+         "blessed dequantize_table/table_host_f32/_host_row_f32 "
+         "funnels — the silent HBM-win defeat",
+         rule_dequant_outside_funnel),
+    Rule("quantize-without-parity-gate",
+         "QuantizedFactors/_quantize_rows construction bypassing "
+         "quantize_serving_model's NDCG@10 parity probe and "
+         "auto-fallback path",
+         rule_quantize_without_parity_gate),
+    Rule("unguarded-domain",
+         "log/sqrt/rsqrt/division over traced or accumulated values "
+         "with no epsilon/clip guard (drift.py's max(x, 1e-9) is the "
+         "blessed idiom)",
+         rule_unguarded_domain),
+    Rule("requant-torn-pair",
+         "QuantizedFactors.data written (assignment or "
+         "dataclasses.replace) without the paired scale update across "
+         "the fold-in/hot-swap seam",
+         rule_requant_torn_pair),
+    Rule("metric-catalog-drift",
+         "pio_* families registered in code but missing from the "
+         "docs/observability.md catalog, or documented but never "
+         "emitted (both directions)",
+         rule_metric_catalog_drift, project=True),
 )}

@@ -1461,6 +1461,98 @@ def cmd_audit_hlo(args) -> int:
     return 0
 
 
+def cmd_audit_numerics(args) -> int:
+    """``ptpu audit-numerics`` — abstract-interpret the registered
+    numeric entry points (a jaxpr walk, no device execution), extract
+    the per-entry dtype census (op counts, cast inventory,
+    accumulation dtypes, bytes by dtype) and gate against the
+    committed golden manifest (``analysis/numerics_baseline.json``)
+    with the same ratchet semantics as ``audit-hlo``. The static
+    dtype-flow rules catch the narrowings the AST can see; this
+    catches the ones only the traced program sees. Non-zero exit on
+    new casts / narrowed accumulators / grown bytes (see
+    --baseline-grow); docs/static-analysis.md has the diff-reading
+    runbook."""
+    from ..analysis import numerics_audit as na
+
+    if args.list_entries:
+        for name, (_b, desc) in na.ENTRY_POINTS.items():
+            _out(f"{name}: {desc}")
+        return 0
+    try:
+        manifest = na.run_audit(args.entry or None)
+    except na.AuditError as e:
+        _err(f"ptpu audit-numerics: {e}")
+        return 2
+    baseline_path = args.baseline or na.DEFAULT_BASELINE
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    if args.write_baseline:
+        cap = None
+        if not args.baseline_grow and os.path.exists(baseline_path):
+            try:
+                cap = na.load_manifest(baseline_path)
+            except (OSError, ValueError) as e:
+                _err(f"ptpu audit-numerics: cannot read baseline: {e}")
+                return 2
+        na.write_manifest(baseline_path, manifest, cap=cap)
+        _err(f"ptpu audit-numerics: wrote "
+             f"{len(manifest['entries'])} entry point(s) to "
+             f"{baseline_path}"
+             f"{' (ratchet: shrink-only)' if cap is not None else ''}.")
+        if cap is not None:
+            violations, _ = na.diff_manifests(manifest, cap)
+            if violations:
+                _err(f"ptpu audit-numerics: {len(violations)} "
+                     f"regression(s) were NOT absorbed (the baseline "
+                     f"only ratchets down; fix them or re-record "
+                     f"deliberately with --baseline-grow):")
+                for v in violations:
+                    _err(f"  {v}")
+                return 1
+        return 0
+    if args.format == "json":
+        _out(json.dumps(manifest, indent=2, sort_keys=True))
+    else:
+        _out(na.format_text(manifest))
+    if not os.path.exists(baseline_path):
+        _err(f"ptpu audit-numerics: no baseline at {baseline_path} — "
+             f"record one with --write-baseline (gate skipped).")
+        return 0
+    try:
+        baseline = na.load_manifest(baseline_path)
+    except (OSError, ValueError) as e:
+        _err(f"ptpu audit-numerics: cannot read baseline: {e}")
+        return 2
+    if args.entry:
+        # a subset run gates only the audited entries — the others
+        # were not traced, not "no longer reproduced"
+        keep = set(args.entry)
+        baseline = {**baseline,
+                    "entries": {k: v
+                                for k, v in baseline["entries"].items()
+                                if k in keep}}
+    violations, shrinkable = na.diff_manifests(manifest, baseline)
+    if shrinkable:
+        _err(f"ptpu audit-numerics: {len(shrinkable)} baseline entr"
+             f"{'y is' if len(shrinkable) == 1 else 'ies are'} no "
+             f"longer fully reproduced — ratchet down with "
+             f"--write-baseline:")
+        for s in shrinkable:
+            _err(f"  {s}")
+    if violations:
+        _err(f"ptpu audit-numerics: {len(violations)} precision "
+             f"regression(s) vs {baseline_path}:")
+        for v in violations:
+            _err(f"  {v}")
+        return 1
+    _err("ptpu audit-numerics: traced dtype census matches the "
+         "golden manifest.")
+    return 0
+
+
 def cmd_template(args, storage: Storage) -> int:
     _out("Bundled engine templates (predictionio_tpu.templates):")
     _out("  recommendation  — ALS top-N (module: "
@@ -1984,6 +2076,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "collectives/entries (deliberate schedule "
                         "changes) instead of the shrink-only ratchet")
 
+    s = sub.add_parser("audit-numerics", help="abstract-interpret the "
+                       "registered numeric entry points and diff the "
+                       "dtype census (casts, accumulation dtypes, "
+                       "bytes) against the committed golden manifest "
+                       "(the runtime complement of the ptpu check "
+                       "dtype-flow rules)")
+    s.add_argument("--entry", action="append", default=[],
+                   help="audit only the named entry point (repeatable)")
+    s.add_argument("--list-entries", action="store_true",
+                   help="print the entry-point catalogue and exit")
+    s.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format for the fresh manifest")
+    s.add_argument("--out", default="",
+                   help="also write the fresh manifest JSON to FILE "
+                        "(the CI artifact)")
+    s.add_argument("--baseline", default="",
+                   help="golden manifest to gate against (default: the "
+                        "committed analysis/numerics_baseline.json)")
+    s.add_argument("--write-baseline", action="store_true",
+                   help="record the fresh manifest as the baseline; "
+                        "against an existing one this only RATCHETS "
+                        "(shrinks counts/bytes) and fails on growth")
+    s.add_argument("--baseline-grow", action="store_true",
+                   help="with --write-baseline: allow recording new "
+                        "casts/entries (deliberate precision changes) "
+                        "instead of the shrink-only ratchet")
+
     sub.add_parser("template", help="list bundled engine templates")
     sub.add_parser("shell", help="interactive shell with storage preloaded")
     s = sub.add_parser("run", help="run module.path:callable with storage "
@@ -2039,6 +2158,13 @@ def main(argv: Optional[List[str]] = None,
 
         ensure_cpu_devices()
         return cmd_audit_hlo(args)
+    if args.command == "audit-numerics":
+        # jaxpr tracing only (no compile), but half the entries trace
+        # through 8-device meshes — same topology pin as audit-hlo
+        from ..analysis.numerics_audit import ensure_cpu_devices
+
+        ensure_cpu_devices()
+        return cmd_audit_numerics(args)
     if args.command in ("train", "eval", "deploy", "batchpredict",
                         "run", "shell", "status"):
         # device-using commands share one persistent XLA program cache

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import numerics as _numerics
 from ..ops.ragged import BucketedHistories, PaddedHistories, SplitHistories
 from ..ops.solve import gramian, solve_spd_batch
 from ..parallel.mesh import rows_spec
@@ -236,7 +237,11 @@ def _lhs_fn(table: jax.Array, indices: jax.Array, wa: jax.Array,
     # ~1GB block budget, and eliminated entirely under gram_mode="fused"
     F = table[indices]  # [d, B, L, r] — cross-shard gather under a mesh
     A = gram_dispatch(F, wa, mode=gram, bf16=bf16)
-    b = jnp.einsum("...lr,...l->...r", F, wb)
+    # F can be the bf16 shadow: keep the RHS accumulation f32, matching
+    # the Gramian side (ops/gram.py contract) — without this the Σ_l
+    # wb·f sum runs at bf16 and fold-in solves drift
+    b = jnp.einsum("...lr,...l->...r", F, wb,
+                   preferred_element_type=jnp.float32)
     return A, b
 
 
@@ -1726,11 +1731,18 @@ def _device_topk(user_table, item_table, idx: np.ndarray, k_dev: int,
         # the index stays uncommitted numpy (int32 — the kernel's SMEM
         # staging dtype): the jitted kernel places it, no eager
         # host→device hop for the transfer guard to flag
-        return fused_topk_dispatch(ud, np.asarray(idx, dtype=np.int32),
-                                   vd, us, vs, k=k_dev,
-                                   n_items=n_items)
-    return _serve_topk(user_table, item_table, idx, k=k_dev,
-                       n_items=n_items)
+        out = fused_topk_dispatch(ud, np.asarray(idx, dtype=np.int32),
+                                  vd, us, vs, k=k_dev,
+                                  n_items=n_items)
+    else:
+        out = _serve_topk(user_table, item_table, idx, k=k_dev,
+                          n_items=n_items)
+    if _numerics.active():
+        # debug_numerics: host NaN probe on the served scores (forces
+        # the dispatch sync — the documented debug-mode cost);
+        # nan_only because padded slots legitimately score -inf
+        _numerics.check_array("serve_topk", out[0], nan_only=True)
+    return out
 
 
 #: serializes SHARDED serving dispatches process-wide. The mesh program
@@ -2485,10 +2497,14 @@ def fold_in_rows(fixed, indices: np.ndarray, values: np.ndarray,
             G = jnp.zeros((table.shape[-1],) * 2, jnp.float32)
         gsrc = table.astype(jnp.bfloat16) \
             if params.gather_dtype == "bfloat16" else table
-        new = _update_block(gsrc, G, idx, val, cnt, params.reg,
-                            params.alpha, implicit,
-                            params.scale_reg_by_count, bf16=bf16,
-                            gram=params.gram_mode, mesh=None)
+        # debug_numerics routes the solve through checkify (NaN/Inf
+        # attributed HERE, before a hot-swap can poison the serving
+        # table); pass-through one bool check when off
+        new = _numerics.checked_call(
+            "fold_in_rows", _update_block, gsrc, G, idx, val, cnt,
+            params.reg, params.alpha, implicit,
+            params.scale_reg_by_count, bf16=bf16,
+            gram=params.gram_mode, mesh=None)
         return np.asarray(jax.device_get(new[0][:B]), dtype=np.float32)
 
     if _is_row_sharded(table):

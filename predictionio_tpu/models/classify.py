@@ -74,6 +74,10 @@ def train_naive_bayes_multinomial(features: np.ndarray, labels: np.ndarray,
         raise ValueError("features must be [N, F] aligned with labels")
     if (features < 0).any():
         raise ValueError("multinomial NB requires non-negative features")
+    if lam <= 0:
+        # λ=0 sends log(counts + λ) to -inf for any empty class/feature
+        # and poisons every downstream score with NaN
+        raise ValueError("lam (Laplace smoothing) must be positive")
     classes, class_idx = np.unique(labels, return_inverse=True)
     C, F = len(classes), features.shape[1]
     counts = np.bincount(class_idx, minlength=C).astype(np.float64)

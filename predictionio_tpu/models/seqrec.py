@@ -217,14 +217,20 @@ def _train_step(w, opt_m, opt_v, step, seq, key, p: SeqRecParams,
     # inline Adam (no optax state-pytree plumbing across shardings)
     b1, b2, eps = 0.9, 0.999, 1e-8
     step = step + 1
+    # bias corrections are positive for step >= 1 in exact arithmetic,
+    # but step is traced — floor them so a host-side step=0 (restored
+    # checkpoint counter) divides by 0.1, not 0.0; v is a sum of
+    # squares but bf16 rounding can produce -0-ish values under sqrt
+    bc1 = jnp.maximum(1 - b1 ** step, 1e-9)
+    bc2 = jnp.maximum(1 - b2 ** step, 1e-9)
     new_w, new_m, new_v = {}, {}, {}
     for kname, g in grads.items():
         m = b1 * opt_m[kname] + (1 - b1) * g
         v = b2 * opt_v[kname] + (1 - b2) * g * g
-        mh = m / (1 - b1 ** step)
-        vh = v / (1 - b2 ** step)
+        mh = m / bc1
+        vh = v / bc2
         new_w[kname] = w[kname] - p.learning_rate * mh / (
-            jnp.sqrt(vh) + eps)
+            jnp.sqrt(jnp.maximum(vh, 0.0)) + eps)
         new_m[kname], new_v[kname] = m, v
     return new_w, new_m, new_v, step, loss
 

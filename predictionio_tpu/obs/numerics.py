@@ -1,0 +1,188 @@
+"""Opt-in runtime NaN/Inf sentinels for the numeric serving stack.
+
+The static dtype-flow rules and ``ptpu audit-numerics`` gate precision
+*structure*; this module watches the *values* at the two seams where a
+nonfinite can enter production silently: the streaming fold-in solve
+(a NaN row hot-swapped into the serving table poisons every score it
+touches) and the serving top-k scores themselves.
+
+Design constraints (the fault-registry pattern,
+:mod:`predictionio_tpu.faults.registry`):
+
+- **Zero overhead off.** Every instrumented site goes through one
+  module-global bool check; production pays nothing. Enabled via
+  ``ServerConfig.debug_numerics`` or ``PTPU_DEBUG_NUMERICS=1``.
+- **Device-side where it matters.** :func:`checked_call` wraps a
+  jitted entry point with ``jax.experimental.checkify``
+  (``float_checks``), so a NaN is attributed to the entry that
+  *produced* it even when later ops would mask it (a ``jnp.where``
+  or top-k can hide an upstream NaN from a host probe).
+- **Host-side at the seams.** :func:`check_array` is a plain
+  ``np.isfinite`` sweep for host-resident boundaries.
+- **Listener fan-out.** The engine server subscribes a listener that
+  bumps ``pio_numerics_checks_total`` /
+  ``pio_numerics_nonfinite_total{entry=…}`` and flags ``nonfinite``
+  in ``/status.json``'s degraded block (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Tuple
+
+#: the ONE fast-path gate: False ⇒ instrumented sites return before
+#: touching anything else — serving hot paths stay free in production
+_ACTIVE = False
+
+_lock = threading.Lock()
+_stats: Dict[str, List[int]] = {}   # entry → [checks, nonfinite]
+_listeners: List[Callable[[str, bool], None]] = []
+_checked_cache: Dict[Tuple[str, int], Callable] = {}
+
+
+def debug_env() -> bool:
+    """``PTPU_DEBUG_NUMERICS=1`` — the no-config-change enable (the
+    staging runbook path, mirroring ``PTPU_DEBUG_LOCKS``)."""
+    return os.environ.get("PTPU_DEBUG_NUMERICS", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def enable() -> None:
+    global _ACTIVE
+    _ACTIVE = True
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = False
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def add_listener(cb: Callable[[str, bool], None]) -> None:
+    """``cb(entry, nonfinite)`` after every delivered check."""
+    with _lock:
+        _listeners.append(cb)
+
+
+def remove_listener(cb: Callable[[str, bool], None]) -> None:
+    with _lock:
+        try:
+            _listeners.remove(cb)
+        except ValueError:
+            pass
+
+
+def _record(entry: str, bad: bool) -> None:
+    with _lock:
+        st = _stats.setdefault(entry, [0, 0])
+        st[0] += 1
+        if bad:
+            st[1] += 1
+        listeners = list(_listeners)
+    for cb in listeners:
+        try:
+            cb(entry, bad)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+
+def check_array(entry: str, arr, *, nan_only: bool = False) -> bool:
+    """Host finiteness probe; True when clean (or inactive). Forces a
+    device sync for device arrays — the documented cost of the debug
+    mode. ``nan_only`` is for seams where ±inf is a legitimate mask
+    sentinel (top-k scores pad with -inf)."""
+    if not _ACTIVE:
+        return True
+    import numpy as np
+
+    a = np.asarray(arr)
+    if a.dtype.kind != "f":
+        bad = False
+    elif nan_only:
+        bad = bool(np.isnan(a).any())
+    else:
+        bad = bool(not np.isfinite(a).all())
+    _record(entry, bad)
+    return not bad
+
+
+def checked_call(entry: str, fn: Callable, *args, **kwargs):
+    """Run ``fn`` under checkify ``float_checks`` when active —
+    transparent pass-through when off. The wrapped function is cached
+    per ``(entry, fn)`` so the checkified trace compiles once; the
+    error readback forces a device sync (debug-mode cost). Falls back
+    to a plain call plus a host probe of the first output if checkify
+    cannot trace the callable."""
+    if not _ACTIVE:
+        return fn(*args, **kwargs)
+    key = (entry, id(fn))
+    wrapped = _checked_cache.get(key)
+    if wrapped is None:
+        try:
+            from jax.experimental import checkify
+
+            wrapped = checkify.checkify(fn,
+                                        errors=checkify.float_checks)
+        except Exception:  # noqa: BLE001 — checkify unavailable
+            wrapped = False
+        _checked_cache[key] = wrapped
+    if wrapped is False:
+        out = fn(*args, **kwargs)
+        first = out[0] if isinstance(out, tuple) and out else out
+        check_array(entry, first)
+        return out
+    try:
+        err, out = wrapped(*args, **kwargs)
+    except Exception:
+        # a callable checkify accepted at wrap time but cannot trace
+        # (e.g. exotic static-arg plumbing): degrade to the host probe
+        # permanently for this entry rather than failing the serve path
+        _checked_cache[key] = False
+        out = fn(*args, **kwargs)
+        first = out[0] if isinstance(out, tuple) and out else out
+        check_array(entry, first)
+        return out
+    bad = err.get() is not None
+    _record(entry, bad)
+    return out
+
+
+def nonfinite_seen() -> bool:
+    """Any sentinel check observed NaN/Inf since the last reset — the
+    ``nonfinite`` flag of ``/status.json``'s degraded block."""
+    with _lock:
+        return any(st[1] for st in _stats.values())
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    with _lock:
+        return {entry: {"checks": st[0], "nonfinite": st[1]}
+                for entry, st in sorted(_stats.items())}
+
+
+def reset_for_tests() -> None:
+    global _ACTIVE
+    with _lock:
+        _stats.clear()
+        _listeners.clear()
+        _checked_cache.clear()
+    _ACTIVE = False
+
+
+__all__ = [
+    "active",
+    "add_listener",
+    "check_array",
+    "checked_call",
+    "debug_env",
+    "disable",
+    "enable",
+    "nonfinite_seen",
+    "remove_listener",
+    "reset_for_tests",
+    "stats",
+]

@@ -372,6 +372,15 @@ def fused_topk_reference(user_table: jax.Array, idx: jax.Array,
     return s, ids
 
 
+#: compiled wrapper for the dispatch fallback lanes: without jit the
+#: reference runs op-by-op and `item_table.astype(f32)` materializes a
+#: full-width copy of the serving table in HBM — exactly the 4×
+#: footprint the quantized tables exist to avoid. Compiled, the upcast
+#: fuses into the score matmul.
+_reference_compiled = jax.jit(fused_topk_reference,
+                              static_argnames=("k", "n_items"))
+
+
 def _tpu_attached() -> bool:
     try:
         dev = jax.devices()[0]
@@ -427,14 +436,14 @@ def fused_topk_dispatch(user_table: jax.Array, idx: jax.Array,
       what tier-1 covers without a TPU).
     """
     if not _HAVE_PALLAS:
-        return fused_topk_reference(user_table, idx, item_table,
-                                    user_scale, item_scale, base,
-                                    k=k, n_items=n_items)
+        return _reference_compiled(user_table, idx, item_table,
+                                   user_scale, item_scale, base,
+                                   k=k, n_items=n_items)
     if _tpu_attached():
         if not fused_topk_supported():
-            return fused_topk_reference(user_table, idx, item_table,
-                                        user_scale, item_scale, base,
-                                        k=k, n_items=n_items)
+            return _reference_compiled(user_table, idx, item_table,
+                                       user_scale, item_scale, base,
+                                       k=k, n_items=n_items)
         return fused_topk(user_table, idx, item_table, user_scale,
                           item_scale, base, k=k, n_items=n_items)
     return fused_topk(user_table, idx, item_table, user_scale,

@@ -30,7 +30,10 @@ def gram_weighted(F: jax.Array, w: jax.Array,
         Fc = F.astype(jnp.bfloat16)
         return jnp.einsum("...lr,...ls->...rs", Fw, Fc,
                           preferred_element_type=jnp.float32)
-    return jnp.einsum("...lr,...ls,...l->...rs", F, F, w)
+    # F may still be a bf16 gather shadow even when the bf16 *compute*
+    # mode is off — pin the accumulator wide either way
+    return jnp.einsum("...lr,...ls,...l->...rs", F, F, w,
+                      preferred_element_type=jnp.float32)
 
 
 def gram_pairs(F: jax.Array, w: jax.Array,

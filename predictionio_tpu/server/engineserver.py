@@ -48,6 +48,7 @@ from ..obs import (
     OverlapTracker,
     hbm_stats,
 )
+from ..obs import numerics as numerics_sentinel
 from ..obs.trace import (
     activate_traces,
     add_stage_spans,
@@ -216,6 +217,18 @@ class ServerConfig:
     #: PTPU_DEBUG_LOCKS=1 env var enables it without a config change
     #: (the staging runbook path, docs/operations.md).
     debug_locks: bool = False
+    #: Runtime NaN/Inf sentinels on the numeric serving stack
+    #: (docs/observability.md): streaming fold-in solves run
+    #: checkify-wrapped (device-side nonfinite detection before a
+    #: hot-swap can poison the serving table) and serving top-k
+    #: scores get a host NaN probe, feeding the
+    #: pio_numerics_checks_total / pio_numerics_nonfinite_total
+    #: counters and the ``nonfinite`` flag of /status.json's degraded
+    #: block. Off by default: the instrumented sites are one bool
+    #: check — zero overhead (the fault-registry pattern). The
+    #: PTPU_DEBUG_NUMERICS=1 env var enables it without a config
+    #: change.
+    debug_numerics: bool = False
     #: Row-quantized serving factor tables (ISSUE 13,
     #: docs/kernels.md): "int8" stores per-row-scaled int8 factors
     #: (~4x more users per HBM, ~4x less bandwidth per scored batch),
@@ -367,6 +380,10 @@ class QueryServer:
             # so the cache/rollout/batcher locks built below are all
             # DebugLocks feeding one process order graph
             instrument_locks(True)
+        if self.config.debug_numerics or numerics_sentinel.debug_env():
+            # arm the NaN/Inf sentinels BEFORE the bind so warmup
+            # fold-ins and probe serves are covered too
+            numerics_sentinel.enable()
         self._lock = new_rlock("QueryServer._lock")
         # serving cache hierarchy (ISSUE 4): built BEFORE the first
         # _bind so the bind can wire the feature tier into algorithms
@@ -509,6 +526,30 @@ class QueryServer:
             "pio_fault_enabled",
             "1 while any fault-injection spec is armed in this process",
             fn=lambda: 1.0 if fault_registry().enabled() else 0.0)
+        # numeric-sentinel observability (debug_numerics /
+        # PTPU_DEBUG_NUMERICS=1): checks delivered anywhere in this
+        # process, attributed by entry point; any nonfinite sample
+        # also raises the `nonfinite` flag in /status.json's degraded
+        # block
+        self._numerics_checks = self.metrics.counter(
+            "pio_numerics_checks_total",
+            "Numeric-sentinel NaN/Inf checks delivered, by entry "
+            "point (debug_numerics only; absent in production)")
+        self._numerics_nonfinite = self.metrics.counter(
+            "pio_numerics_nonfinite_total",
+            "Numeric-sentinel checks that observed NaN/Inf, by entry "
+            "point — nonzero flags nonfinite in /status.json")
+
+        def _on_numerics(entry: str, bad: bool) -> None:
+            self._numerics_checks.labels(entry=entry).inc()
+            if bad:
+                self._numerics_nonfinite.labels(entry=entry).inc()
+
+        if numerics_sentinel.active():
+            self._numerics_listener = _on_numerics
+            numerics_sentinel.add_listener(_on_numerics)
+        else:
+            self._numerics_listener = None
         # progressive delivery (ISSUE 3): per-release-arm series the
         # rollout health gate windows over, the release registry this
         # server's deploy/reload/promote/rollback actions are recorded
@@ -1269,12 +1310,15 @@ class QueryServer:
         def _total(fam) -> int:
             return int(sum(child.value for _, child in fam.children()))
 
+        nonfinite = numerics_sentinel.active() \
+            and numerics_sentinel.nonfinite_seen()
         return {
-            "active": bool(dead),
+            "active": bool(dead) or nonfinite,
             "deadLanes": dead,
             "laneRestarts": _total(self._lane_restarts),
             "laneFailures": _total(self._lane_failures),
             "faultInjection": fault_registry().enabled(),
+            "nonfinite": nonfinite,
         }
 
     def spans_summary(self) -> dict:

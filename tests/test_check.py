@@ -610,7 +610,10 @@ class TestRepoWide:
             "vmem-overbudget", "dma-unwaited",
             "low-precision-accumulator", "missing-interpret-fallback",
             "implicit-reshard", "shard-map-spec-mismatch",
-            "unsharded-capture", "missing-donation-sharded"}
+            "unsharded-capture", "missing-donation-sharded",
+            "low-precision-reduction", "dequant-outside-funnel",
+            "quantize-without-parity-gate", "unguarded-domain",
+            "requant-torn-pair", "metric-catalog-drift"}
 
     def test_kernel_files_clean_under_kernel_rules(self):
         # the acceptance bar: the real Pallas kernels pass the rules

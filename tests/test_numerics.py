@@ -1,0 +1,1041 @@
+"""Numerics-flow tests: the five dtype-lattice rules (positive /
+negative / pragma, incl. the two-hop interprocedural chain), the
+metric-catalog drift gate, the `ptpu audit-numerics` census + ratchet,
+the checkify NaN sentinel (unit and over live HTTP), the CLI contract,
+and the acceptance fixture proving a seeded bf16-accumulation
+regression fails BOTH the static rule and the audit gate."""
+
+import copy
+import json
+import os
+import textwrap
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.analysis import RULES, check_project, check_source
+from predictionio_tpu.analysis import numerics_audit as na
+from predictionio_tpu.analysis.numerics import NUMERICS_RULES
+from predictionio_tpu.cli import main
+from predictionio_tpu.obs import numerics as sentinel
+
+MODELS = "predictionio_tpu/models/m.py"   # precision rules patrol here
+UTILS = "predictionio_tpu/utils/u.py"     # ...and not here
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def src(text):
+    return textwrap.dedent(text)
+
+
+# ---------------------------------------------------------------------------
+# rule: low-precision-reduction (direct)
+# ---------------------------------------------------------------------------
+
+class TestLowPrecisionReduction:
+    def test_positive_einsum_over_bf16(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def gram(table):
+                shadow = table.astype(jnp.bfloat16)
+                return jnp.einsum("lr,ls->rs", shadow, shadow)
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["low-precision-reduction"]
+        assert "bfloat16" in findings[0].message
+        assert "preferred_element_type" in findings[0].message
+
+    def test_positive_sum_method_and_matmul(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def acc(x):
+                lo = x.astype(jnp.float16)
+                a = lo.sum()
+                b = lo @ lo
+                return a, b
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["low-precision-reduction"] * 2
+
+    def test_negative_preferred_element_type(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def gram(table):
+                shadow = table.astype(jnp.bfloat16)
+                return jnp.einsum("lr,ls->rs", shadow, shadow,
+                                  preferred_element_type=jnp.float32)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_upcast_before_reduction(self):
+        # (scoped to the rule: the bf16→f32 upcast itself is
+        # dequant-outside-funnel territory, judged separately)
+        code = src("""
+            import jax.numpy as jnp
+
+            def gram(table):
+                shadow = table.astype(jnp.bfloat16)
+                wide = shadow.astype(jnp.float32)
+                return jnp.sum(wide)
+        """)
+        assert check_source(code, path=MODELS,
+                            rule_names=["low-precision-reduction"]) \
+            == []
+
+    def test_negative_outside_hot_dirs(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def gram(table):
+                shadow = table.astype(jnp.bfloat16)
+                return jnp.sum(shadow)
+        """)
+        assert check_source(code, path=UTILS) == []
+
+    def test_conditional_shadow_ifexp_is_seen(self):
+        # the fold-in idiom: `t.astype(jnp.bfloat16) if bf16 else t`
+        code = src("""
+            import jax.numpy as jnp
+
+            def solve(table, bf16):
+                gsrc = table.astype(jnp.bfloat16) if bf16 else table
+                return jnp.sum(gsrc)
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["low-precision-reduction"]
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def gram(table):
+                shadow = table.astype(jnp.bfloat16)
+                return jnp.sum(shadow)  # ptpu: allow[low-precision-reduction] — short sum, loss bounded
+        """)
+        assert check_source(code, path=MODELS) == []
+
+
+class TestLowPrecisionInterprocedural:
+    LEAF = src("""
+        import jax.numpy as jnp
+
+        def accumulate(x):
+            return jnp.sum(x)
+    """)
+    MID = src("""
+        from pkg.ops.leaf import accumulate
+
+        def shuttle(x):
+            return accumulate(x) + 1
+    """)
+
+    def _project(self, caller):
+        return check_project({
+            "pkg/ops/leaf.py": self.LEAF,
+            "pkg/ops/mid.py": self.MID,
+            "pkg/models/fold.py": src(caller),
+        })
+
+    def test_two_hop_chain_flagged_at_caller(self):
+        findings = self._project("""
+            import jax.numpy as jnp
+            from pkg.ops.mid import shuttle
+
+            def fold(table):
+                shadow = table.astype(jnp.bfloat16)
+                return shuttle(shadow)
+        """)
+        assert rules_of(findings) == ["low-precision-reduction"]
+        f = findings[0]
+        # anchored at the bf16 call site, not inside the helpers
+        assert f.path == "pkg/models/fold.py"
+        # ...with the helper chain in the message
+        assert "shuttle" in f.message and "accumulate" in f.message
+        assert "bfloat16" in f.message
+        # ...and hop locations machine-readable for SARIF
+        assert [p for p, _, _ in f.related] == [
+            "pkg/ops/mid.py", "pkg/ops/leaf.py"]
+
+    def test_negative_upcast_at_call_site(self):
+        findings = self._project("""
+            import jax.numpy as jnp
+            from pkg.ops.mid import shuttle
+
+            def fold(table):
+                shadow = table.astype(jnp.bfloat16)
+                return shuttle(shadow.astype(jnp.float32))
+        """)
+        assert "low-precision-reduction" not in rules_of(findings)
+
+    def test_pragma_at_leaf_blesses_callers(self):
+        blessed_leaf = src("""
+            import jax.numpy as jnp
+
+            def accumulate(x):
+                return jnp.sum(x)  # ptpu: allow[low-precision-reduction] — callers bound the length
+        """)
+        findings = check_project({
+            "pkg/ops/leaf.py": blessed_leaf,
+            "pkg/ops/mid.py": self.MID,
+            "pkg/models/fold.py": src("""
+                import jax.numpy as jnp
+                from pkg.ops.mid import shuttle
+
+                def fold(table):
+                    shadow = table.astype(jnp.bfloat16)
+                    return shuttle(shadow)
+            """),
+        })
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rule: dequant-outside-funnel
+# ---------------------------------------------------------------------------
+
+class TestDequantOutsideFunnel:
+    def test_positive_adhoc_data_upcast(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def serve(table):
+                wide = table.data.astype(jnp.float32)
+                return wide
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["dequant-outside-funnel"]
+        assert "dequantize_table" in findings[0].message
+
+    def test_negative_inside_blessed_funnel(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def dequantize_table(table):
+                return table.data.astype(jnp.float32)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_module_level_dequant_lambda(self):
+        # the als.py `_dequant_scaled = jax.jit(lambda ...)` idiom
+        code = src("""
+            import jax
+            import jax.numpy as jnp
+
+            _dequant_scaled = jax.jit(
+                lambda d, s: d.astype(jnp.float32) * s)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_upcast_of_unquantized_value(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def widen(x):
+                return x.astype(jnp.float32)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def debug_dump(table):
+                return table.data.astype(jnp.float32)  # ptpu: allow[dequant-outside-funnel] — offline debug dump
+        """)
+        assert check_source(code, path=MODELS) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: quantize-without-parity-gate
+# ---------------------------------------------------------------------------
+
+class TestQuantizeWithoutParityGate:
+    def test_positive_raw_construction(self):
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def ship(data, scale):
+                return QuantizedFactors(data, scale, "int8")
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["quantize-without-parity-gate"]
+        assert "quantize_serving_model" in findings[0].message
+
+    def test_positive_raw_quantize_rows(self):
+        code = src("""
+            from predictionio_tpu.models.als import _quantize_rows
+
+            def ship(rows):
+                return _quantize_rows(rows, "int8")
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["quantize-without-parity-gate"]
+
+    def test_negative_inside_parity_funnel(self):
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def quantize_serving_model(model):
+                return QuantizedFactors(model.data, model.scale, "int8")
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_copy_constructor_residency_move(self):
+        # quant= carries an EXISTING table's decision — a pinning /
+        # residency move, not a fresh (ungated) quantization
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def pin(t, dev):
+                return QuantizedFactors(put(t.data, dev),
+                                        put(t.scale, dev), t.quant)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def fixture(data, scale):
+                return QuantizedFactors(data, scale, "int8")  # ptpu: allow[quantize-without-parity-gate] — test fixture
+        """)
+        assert check_source(code, path=MODELS) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: unguarded-domain
+# ---------------------------------------------------------------------------
+
+class TestUnguardedDomain:
+    def test_positive_division_no_guard(self):
+        code = src("""
+            def mean_score(total, count):
+                return total / count
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["unguarded-domain"]
+        assert "count" in findings[0].message
+
+    def test_positive_log_no_guard(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def ll(p):
+                return jnp.log(p)
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["unguarded-domain"]
+
+    def test_negative_maximum_guard(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def ll(p):
+                return jnp.log(jnp.maximum(p, 1e-9))
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_eps_shift(self):
+        code = src("""
+            import jax.numpy as jnp
+
+            def norm(x, eps):
+                return x / (jnp.sum(x) + eps)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_counter_bumped_before_divide(self):
+        code = src("""
+            def rate(events):
+                n = 0
+                total = 0.0
+                for e in events:
+                    n += 1
+                    total += e
+                return total / n
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_branch_tested(self):
+        code = src("""
+            def safe(total, count):
+                return total / count if count else 0.0
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_negative_positive_literal_default(self):
+        # the `lam: float = 1.0` Laplace idiom (classify.py)
+        code = src("""
+            import jax.numpy as jnp
+
+            def smooth(counts, lam: float = 1.0):
+                return jnp.log(counts + lam)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            def mean_score(total, count):
+                return total / count  # ptpu: allow[unguarded-domain] — caller validates count
+        """)
+        assert check_source(code, path=MODELS) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: requant-torn-pair
+# ---------------------------------------------------------------------------
+
+class TestRequantTornPair:
+    def test_positive_torn_attribute_write(self):
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def hot_swap(table: QuantizedFactors, rows):
+                table.data = rows
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["requant-torn-pair"]
+        assert "stale" in findings[0].message.lower() \
+            or "scale" in findings[0].message
+
+    def test_negative_paired_write(self):
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def hot_swap(table: QuantizedFactors, rows, scales):
+                table.data = rows
+                table.scale = scales
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_positive_replace_missing_scale(self):
+        code = src("""
+            import dataclasses
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def hot_swap(table: QuantizedFactors, rows):
+                return dataclasses.replace(table, data=rows)
+        """)
+        findings = check_source(code, path=MODELS)
+        assert rules_of(findings) == ["requant-torn-pair"]
+        assert "replace" in findings[0].message
+
+    def test_negative_replace_with_both(self):
+        code = src("""
+            import dataclasses
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def hot_swap(table: QuantizedFactors, rows, scales):
+                return dataclasses.replace(table, data=rows,
+                                           scale=scales)
+        """)
+        assert check_source(code, path=MODELS) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            from predictionio_tpu.models.als import QuantizedFactors
+
+            def debug_poke(table: QuantizedFactors, rows):
+                table.data = rows  # ptpu: allow[requant-torn-pair] — scale updated by caller
+        """)
+        assert check_source(code, path=MODELS) == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: metric-catalog drift gate
+# ---------------------------------------------------------------------------
+
+REGISTERING = src("""
+    def wire(metrics):
+        c = metrics.counter("pio_test_widgets_total", "widgets")
+        g = metrics.gauge("pio_test_depth", "depth")
+        return c, g
+""")
+
+
+class TestMetricCatalogDrift:
+    @pytest.fixture()
+    def catalog(self, tmp_path, monkeypatch):
+        path = tmp_path / "observability.md"
+
+        def write(text):
+            path.write_text(text)
+            return path
+
+        monkeypatch.setattr(
+            "predictionio_tpu.analysis.metrics_catalog.CATALOG_PATH",
+            str(path))
+        return write
+
+    def test_undocumented_family_flagged_at_registration(self, catalog):
+        catalog("| `pio_test_widgets_total` | counter |\n")
+        findings = check_source(REGISTERING, path=MODELS,
+                                rule_names=["metric-catalog-drift"])
+        assert rules_of(findings) == ["metric-catalog-drift"]
+        assert "pio_test_depth" in findings[0].message
+        assert findings[0].path == MODELS
+
+    def test_documented_but_never_emitted_flagged_at_doc_line(
+            self, catalog):
+        catalog("| `pio_test_widgets_total` | counter |\n"
+                "| `pio_test_depth` | gauge |\n"
+                "| `pio_test_ghost_total` | counter |\n")
+        findings = check_source(REGISTERING, path=MODELS,
+                                rule_names=["metric-catalog-drift"])
+        assert rules_of(findings) == ["metric-catalog-drift"]
+        assert "pio_test_ghost_total" in findings[0].message
+        assert findings[0].path.endswith("observability.md")
+        assert findings[0].line == 3
+
+    def test_clean_when_both_sides_agree(self, catalog):
+        catalog("| `pio_test_widgets_total` | counter |\n"
+                "| `pio_test_depth` | gauge |\n")
+        assert check_source(REGISTERING, path=MODELS,
+                            rule_names=["metric-catalog-drift"]) == []
+
+    def test_prefix_prose_is_not_a_row(self, catalog):
+        # `pio_lane_*`-style prose must not register as a documented
+        # family (nor demand an emitter)
+        catalog("| `pio_test_widgets_total` | counter |\n"
+                "| `pio_test_depth` | gauge |\n"
+                "the `pio_test_lane_*` family is per-lane\n")
+        assert check_source(REGISTERING, path=MODELS,
+                            rule_names=["metric-catalog-drift"]) == []
+
+    def test_silent_without_registrations(self, catalog):
+        catalog("| `pio_test_ghost_total` | counter |\n")
+        assert check_source("X = 1\n", path=MODELS,
+                            rule_names=["metric-catalog-drift"]) == []
+
+    def test_repo_catalog_and_code_agree(self):
+        # the real gate over the real tree rides the repo-wide clean
+        # test in test_check.py; here just pin that the rule is
+        # registered and the catalog exists where the rule looks
+        from predictionio_tpu.analysis import metrics_catalog as mc
+
+        assert "metric-catalog-drift" in RULES
+        assert os.path.exists(mc.CATALOG_PATH)
+
+
+# ---------------------------------------------------------------------------
+# audit-numerics: census goldens
+# ---------------------------------------------------------------------------
+
+class TestCensusJaxpr:
+    def test_bf16_dot_accumulates_bf16_without_preferred(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.ones((4, 4), jnp.bfloat16)
+        closed = jax.make_jaxpr(
+            lambda x, y: jnp.einsum("ij,jk->ik", x, y))(a, a)
+        rec = na.census_jaxpr(closed)
+        assert rec["reductions"].get("dot_general") == {"bfloat16": 1}
+
+    def test_preferred_element_type_widens_the_accumulator(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.ones((4, 4), jnp.bfloat16)
+        closed = jax.make_jaxpr(
+            lambda x, y: jnp.einsum(
+                "ij,jk->ik", x, y,
+                preferred_element_type=jnp.float32))(a, a)
+        rec = na.census_jaxpr(closed)
+        assert rec["reductions"]["dot_general"] == {"float32": 1}
+        assert "bfloat16" not in rec["reductions"]["dot_general"]
+
+    def test_cast_inventory_and_bytes(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.ones((8,), jnp.bfloat16)
+        closed = jax.make_jaxpr(
+            lambda x: x.astype(jnp.float32) * 2.0)(a)
+        rec = na.census_jaxpr(closed)
+        assert rec["casts"] == {"bfloat16->float32": 1}
+        assert rec["bytes"]["float32"] >= 8 * 4
+
+    def test_sub_jaxprs_counted_once(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.ones((8,), jnp.bfloat16)
+        inner = jax.jit(lambda y: y.astype(jnp.float32))
+        closed = jax.make_jaxpr(lambda x: inner(x))(a)
+        rec = na.census_jaxpr(closed)
+        # the pjit call eqn contributes only its body — the cast
+        # appears exactly once, not once per nesting level
+        assert rec["casts"] == {"bfloat16->float32": 1}
+
+
+# ---------------------------------------------------------------------------
+# audit-numerics: run + ratchet diff
+# ---------------------------------------------------------------------------
+
+SUBSET = ["quantize_serving_model", "device_topk_int8"]
+
+
+@pytest.fixture(scope="class")
+def manifest():
+    return na.run_audit(SUBSET)
+
+
+class TestRunAuditAndRatchet:
+    def test_manifest_shape(self, manifest):
+        assert manifest["version"] == na.MANIFEST_VERSION
+        assert manifest["devices"] == na.AUDIT_DEVICE_COUNT
+        assert set(manifest["entries"]) == set(SUBSET)
+        for rec in manifest["entries"].values():
+            assert set(rec) == {"ops", "casts", "reductions", "bytes"}
+
+    def test_dequant_funnels_in_the_census(self, manifest):
+        casts = manifest["entries"]["quantize_serving_model"]["casts"]
+        assert casts.get("int8->float32") == 1
+        assert casts.get("bfloat16->float32") == 1
+
+    def test_unknown_entry_raises(self):
+        with pytest.raises(na.AuditError, match="unknown entry"):
+            na.run_audit(["nope"])
+
+    def test_diff_against_itself_is_clean(self, manifest):
+        violations, shrinkable = na.diff_manifests(manifest, manifest)
+        assert violations == [] and shrinkable == []
+
+    def test_committed_baseline_matches_live_trace(self, manifest):
+        """The committed golden baseline reproduces on this machine
+        for the audited subset — the CI gate's premise."""
+        baseline = na.load_manifest(na.DEFAULT_BASELINE)
+        for name in manifest["entries"]:
+            rec, brec = manifest["entries"][name], \
+                baseline["entries"][name]
+            assert rec["casts"] == brec["casts"], name
+            assert rec["reductions"] == brec["reductions"], name
+
+    def test_new_cast_is_a_violation(self, manifest):
+        base = copy.deepcopy(manifest)
+        del base["entries"]["quantize_serving_model"]["casts"][
+            "int8->float32"]
+        violations, _ = na.diff_manifests(manifest, base)
+        assert any("quantize_serving_model" in v
+                   and "int8->float32" in v for v in violations)
+
+    def test_low_precision_reduction_growth_is_a_violation(
+            self, manifest):
+        cur = copy.deepcopy(manifest)
+        cur["entries"]["device_topk_int8"]["reductions"][
+            "dot_general"] = {"bfloat16": 1}
+        violations, _ = na.diff_manifests(cur, manifest)
+        assert any("dot_general" in v and "bfloat16" in v
+                   and "f32 accumulator" in v for v in violations)
+
+    def test_wide_reduction_growth_is_not_a_violation(self, manifest):
+        # MORE f32 reductions is not a precision regression
+        cur = copy.deepcopy(manifest)
+        reds = cur["entries"]["device_topk_int8"]["reductions"]
+        reds["dot_general"] = dict(reds["dot_general"])
+        reds["dot_general"]["float32"] = \
+            reds["dot_general"].get("float32", 0) + 3
+        violations, _ = na.diff_manifests(cur, manifest)
+        assert violations == []
+
+    def test_bytes_blowup_is_a_violation(self, manifest):
+        cur = copy.deepcopy(manifest)
+        b = cur["entries"]["device_topk_int8"]["bytes"]
+        b["float32"] = int(b.get("float32", 0) * 4 + 10_000_000)
+        violations, _ = na.diff_manifests(cur, manifest)
+        assert any("device_topk_int8" in v and "float32" in v
+                   for v in violations)
+
+    def test_unrecorded_entry_is_a_violation(self, manifest):
+        base = copy.deepcopy(manifest)
+        del base["entries"]["device_topk_int8"]
+        violations, _ = na.diff_manifests(manifest, base)
+        assert any("device_topk_int8" in v and "baseline-grow" in v
+                   for v in violations)
+
+    def test_device_count_mismatch_is_a_violation(self, manifest):
+        base = copy.deepcopy(manifest)
+        base["devices"] = 4
+        violations, _ = na.diff_manifests(manifest, base)
+        assert any("device count" in v for v in violations)
+
+    def test_shrink_is_reported_not_fatal(self, manifest):
+        base = copy.deepcopy(manifest)
+        base["entries"]["quantize_serving_model"]["casts"][
+            "int8->float32"] += 5
+        violations, shrinkable = na.diff_manifests(manifest, base)
+        assert violations == []
+        assert any("int8->float32" in s for s in shrinkable)
+
+    def test_write_ratchets_shrink_only(self, manifest, tmp_path):
+        path = str(tmp_path / "b.json")
+        grown = copy.deepcopy(manifest)
+        grown["entries"]["quantize_serving_model"]["casts"][
+            "float32->int8"] = 7          # a key the baseline never had
+        grown["entries"]["extra_entry"] = \
+            copy.deepcopy(manifest["entries"]["device_topk_int8"])
+        na.write_manifest(path, grown, cap=manifest)
+        doc = na.load_manifest(path)
+        assert "extra_entry" not in doc["entries"]
+        assert "float32->int8" not in \
+            doc["entries"]["quantize_serving_model"]["casts"]
+
+    def test_baseline_grow_writes_as_is(self, manifest, tmp_path):
+        path = str(tmp_path / "b.json")
+        grown = copy.deepcopy(manifest)
+        grown["entries"]["extra_entry"] = \
+            copy.deepcopy(manifest["entries"]["device_topk_int8"])
+        na.write_manifest(path, grown, cap=None)   # --baseline-grow
+        doc = na.load_manifest(path)
+        assert "extra_entry" in doc["entries"]
+
+    def test_load_rejects_wrong_version(self, tmp_path):
+        p = tmp_path / "v.json"
+        p.write_text(json.dumps({"version": 99, "entries": {}}))
+        with pytest.raises(ValueError, match="version"):
+            na.load_manifest(str(p))
+
+    def test_all_registered_entries_meet_the_floor(self):
+        # the acceptance criterion: CI gates at least 10 entry points
+        assert len(na.ENTRY_POINTS) >= 10
+        baseline = na.load_manifest(na.DEFAULT_BASELINE)
+        assert set(baseline["entries"]) == set(na.ENTRY_POINTS)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a seeded bf16-accumulation regression fails BOTH gates
+# ---------------------------------------------------------------------------
+
+class TestSeededRegressionFailsBothGates:
+    # the regression: ops/gram.py's einsum with the f32 accumulator
+    # contract dropped — a one-line diff someone could plausibly ship
+    BAD = src("""
+        import jax.numpy as jnp
+
+        def gram_weighted(F, w):
+            lo = F.astype(jnp.bfloat16)
+            return jnp.einsum("lr,ls->rs", lo, lo)
+    """)
+
+    def test_static_rule_catches_it(self):
+        findings = check_source(self.BAD,
+                                path="predictionio_tpu/ops/gram.py")
+        assert "low-precision-reduction" in rules_of(findings)
+
+    def test_audit_gate_catches_it(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.ones((16, 4), jnp.bfloat16)
+        closed = jax.make_jaxpr(
+            lambda F: jnp.einsum("lr,ls->rs", F, F))(a)
+        rec = na.census_jaxpr(closed)
+        assert rec["reductions"]["dot_general"] == {"bfloat16": 1}, \
+            "fixture broken — regression produced no bf16 reduction"
+        current = {"version": na.MANIFEST_VERSION,
+                   "devices": na.AUDIT_DEVICE_COUNT,
+                   "entries": {"gram": rec}}
+        golden = copy.deepcopy(current)
+        golden["entries"]["gram"]["reductions"] = {
+            "dot_general": {"float32": 1}}
+        violations, _ = na.diff_manifests(current, golden)
+        assert violations, "the lost f32 accumulator must fail the gate"
+        assert any("dot_general" in v and "bfloat16" in v
+                   for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# runtime sentinel: unit
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def clean_sentinel():
+    sentinel.reset_for_tests()
+    yield sentinel
+    sentinel.reset_for_tests()
+
+
+class TestSentinelUnit:
+    def test_inactive_is_a_pass_through(self, clean_sentinel):
+        assert not sentinel.active()
+        assert sentinel.check_array("x", np.array([np.nan]))
+        assert sentinel.stats() == {}   # off ⇒ nothing recorded
+        out = sentinel.checked_call("x", lambda a: a + 1, 1)
+        assert out == 2 and sentinel.stats() == {}
+
+    def test_check_array_catches_nan_and_inf(self, clean_sentinel):
+        sentinel.enable()
+        assert sentinel.check_array("e", np.ones(3, np.float32))
+        assert not sentinel.check_array(
+            "e", np.array([1.0, np.nan], np.float32))
+        assert not sentinel.check_array(
+            "e", np.array([np.inf], np.float32))
+        assert sentinel.stats() == {
+            "e": {"checks": 3, "nonfinite": 2}}
+        assert sentinel.nonfinite_seen()
+
+    def test_nan_only_lets_mask_infs_through(self, clean_sentinel):
+        # top-k pads with -inf: a legitimate sentinel, not corruption
+        sentinel.enable()
+        assert sentinel.check_array(
+            "topk", np.array([1.0, -np.inf], np.float32),
+            nan_only=True)
+        assert not sentinel.check_array(
+            "topk", np.array([np.nan], np.float32), nan_only=True)
+
+    def test_non_float_arrays_never_flag(self, clean_sentinel):
+        sentinel.enable()
+        assert sentinel.check_array("i", np.array([1, 2], np.int32))
+        assert not sentinel.nonfinite_seen()
+
+    def test_checked_call_attributes_a_device_nan(self, clean_sentinel):
+        import jax
+        import jax.numpy as jnp
+
+        sentinel.enable()
+        fn = jax.jit(lambda x: x * 2.0)
+        clean = sentinel.checked_call(
+            "solve", fn, jnp.ones(4, jnp.float32))
+        np.testing.assert_allclose(np.asarray(clean), 2.0)
+        sentinel.checked_call(
+            "solve", fn, jnp.array([1.0, np.nan], jnp.float32))
+        assert sentinel.stats()["solve"] == {
+            "checks": 2, "nonfinite": 1}
+
+    def test_checked_call_degrades_for_untraceable_callables(
+            self, clean_sentinel):
+        # when checkify can't wrap/trace a callable, checked_call
+        # falls back to a plain call + host probe of the result —
+        # prime the cache the way a failed wrap would leave it
+        sentinel.enable()
+
+        def host_fn(x):
+            return np.asarray(x) * np.float32(np.nan)
+
+        sentinel._checked_cache[("host", id(host_fn))] = False
+        out = sentinel.checked_call("host", host_fn,
+                                    np.ones(2, np.float32))
+        assert np.isnan(out).all()
+        st = sentinel.stats()["host"]
+        assert st["checks"] == 1 and st["nonfinite"] == 1
+
+    def test_listener_fan_out_and_errors_swallowed(
+            self, clean_sentinel):
+        sentinel.enable()
+        events = []
+        sentinel.add_listener(lambda e, bad: events.append((e, bad)))
+        sentinel.add_listener(
+            lambda e, bad: (_ for _ in ()).throw(RuntimeError("boom")))
+        sentinel.check_array("a", np.array([np.nan], np.float32))
+        sentinel.check_array("a", np.ones(1, np.float32))
+        assert events == [("a", True), ("a", False)]
+
+    def test_debug_env_arms_the_sentinel(self, clean_sentinel,
+                                         monkeypatch):
+        monkeypatch.setenv("PTPU_DEBUG_NUMERICS", "1")
+        assert sentinel.debug_env()
+        monkeypatch.setenv("PTPU_DEBUG_NUMERICS", "0")
+        assert not sentinel.debug_env()
+
+
+# ---------------------------------------------------------------------------
+# runtime sentinel: over live HTTP (ServerConfig.debug_numerics)
+# ---------------------------------------------------------------------------
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture(scope="class")
+def numerics_served():
+    import urllib.request
+
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.server.engineserver import (
+        ServerConfig,
+        deploy,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import run_train
+
+    sentinel.reset_for_tests()
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, "numapp"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(11)
+    events, t = [], T0
+    for u in range(12):
+        for i in rng.choice(12, size=4, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": float(rng.integers(1, 6))}),
+                event_time=t))
+            t += timedelta(seconds=30)
+    es.insert_batch(events, app_id)
+    ctx = Context(app_name="numapp", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("numapp", rank=4, num_iterations=2,
+                               seed=5)
+    run_train(ctx, engine, ep, engine_id="num", engine_version="1")
+    srv = deploy(ctx, engine, ep, engine_id="num", engine_version="1",
+                 config=ServerConfig(debug_numerics=True),
+                 host="127.0.0.1", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+    sentinel.reset_for_tests()
+
+
+def _call(port, method, path, body=None):
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req) as resp:
+        raw = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+        return resp.status, (json.loads(raw) if "json" in ctype
+                             else raw.decode())
+
+
+class TestSentinelHTTP:
+    def test_config_arms_the_global_sentinel(self, numerics_served):
+        assert sentinel.active()
+
+    def test_clean_serving_stays_undegraded(self, numerics_served):
+        srv = numerics_served
+        status, body = _call(srv.port, "POST", "/queries.json",
+                             {"user": "u1", "num": 3})
+        assert status == 200 and len(body["itemScores"]) == 3
+        status, body = _call(srv.port, "GET", "/status.json")
+        assert status == 200
+        assert body["degraded"]["nonfinite"] is False
+
+    def test_seeded_nan_fold_in_degrades_and_counts(
+            self, numerics_served):
+        from predictionio_tpu.models.als import ALSParams, fold_in_rows
+
+        srv = numerics_served
+        fixed = np.ones((16, 8), np.float32)
+        fixed[0, 0] = np.nan        # one poisoned factor row
+        params = ALSParams(rank=8, implicit_prefs=True,
+                           gather_dtype="bfloat16")
+        idx = np.zeros((2, 3), np.int32)    # histories hit row 0
+        val = np.ones((2, 3), np.float32)
+        cnt = np.full((2,), 3, np.int32)
+        fold_in_rows(fixed, idx, val, cnt, params)
+
+        st = sentinel.stats()["fold_in_rows"]
+        assert st["checks"] >= 1 and st["nonfinite"] >= 1
+
+        status, body = _call(srv.port, "GET", "/status.json")
+        assert status == 200
+        assert body["degraded"]["nonfinite"] is True
+        assert body["degraded"]["active"] is True
+
+        status, text = _call(srv.port, "GET", "/metrics")
+        assert status == 200
+        assert 'pio_numerics_checks_total{entry="fold_in_rows"}' \
+            in text
+        assert 'pio_numerics_nonfinite_total{entry="fold_in_rows"}' \
+            in text
+
+
+# ---------------------------------------------------------------------------
+# CLI: ptpu audit-numerics + the check registry
+# ---------------------------------------------------------------------------
+
+class TestAuditNumericsCLI:
+    def test_list_entries(self, capsys):
+        assert main(["audit-numerics", "--list-entries"]) == 0
+        out = capsys.readouterr().out
+        assert "foldin_update_bf16" in out
+        assert "device_topk_int8" in out
+
+    def test_unknown_entry_exits_2(self):
+        assert main(["audit-numerics", "--entry", "nope"]) == 2
+
+    def test_subset_json_and_artifact(self, capsys, tmp_path):
+        artifact = str(tmp_path / "numerics.json")
+        rc = main(["audit-numerics", "--entry",
+                   "quantize_serving_model", "--format", "json",
+                   "--out", artifact])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        casts = doc["entries"]["quantize_serving_model"]["casts"]
+        assert casts["int8->float32"] == 1
+        assert os.path.exists(artifact)
+
+    def test_text_format_shows_census(self, capsys):
+        rc = main(["audit-numerics", "--entry", "device_topk_int8"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "device_topk_int8" in out
+        assert "int8->float32" in out
+
+    def test_write_and_gate_roundtrip(self, tmp_path, capsys):
+        path = str(tmp_path / "b.json")
+        assert main(["audit-numerics", "--entry",
+                     "quantize_serving_model", "--baseline", path,
+                     "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert main(["audit-numerics", "--entry",
+                     "quantize_serving_model", "--baseline",
+                     path]) == 0
+
+    def test_gate_fails_on_doctored_baseline(self, tmp_path, capsys):
+        path = str(tmp_path / "b.json")
+        assert main(["audit-numerics", "--entry",
+                     "quantize_serving_model", "--baseline", path,
+                     "--write-baseline"]) == 0
+        doc = na.load_manifest(path)
+        del doc["entries"]["quantize_serving_model"]["casts"][
+            "int8->float32"]
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        capsys.readouterr()
+        assert main(["audit-numerics", "--entry",
+                     "quantize_serving_model", "--baseline",
+                     path]) == 1
+        out = capsys.readouterr().out + capsys.readouterr().err
+        assert "int8->float32" in out
+
+    def test_numerics_rules_registered_for_check(self):
+        assert set(NUMERICS_RULES) <= set(RULES)
+        assert "metric-catalog-drift" in RULES
+
+    def test_check_sarif_declares_and_reports_numerics_rules(
+            self, tmp_path, capsys):
+        bad = tmp_path / "models"
+        bad.mkdir()
+        (bad / "m.py").write_text(src("""
+            import jax.numpy as jnp
+
+            def gram(table):
+                shadow = table.astype(jnp.bfloat16)
+                return jnp.sum(shadow)
+        """))
+        assert main(["check", str(tmp_path), "--format",
+                     "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        run = doc["runs"][0]
+        declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert set(NUMERICS_RULES) <= declared
+        assert any(r["ruleId"] == "low-precision-reduction"
+                   for r in run["results"])
