@@ -162,25 +162,57 @@ def run_load(worker_factory: Callable[[], Callable[[int], str]],
     return stats, wall
 
 
+def parse_endpoints(spec: Iterable[str]) -> list:
+    """``["host:port", "http://host:port", ...]`` →
+    ``[(host, port), ...]`` — the ``--endpoints`` grammar of the
+    multi-replica harness (schemes are accepted and stripped; the
+    load core speaks plain keep-alive HTTP)."""
+    out = []
+    for item in spec:
+        item = str(item).strip().rstrip("/")
+        if not item:
+            continue
+        if "://" in item:
+            item = item.split("://", 1)[1]
+        host, _, port = item.rpartition(":")
+        if not host:
+            raise ValueError(f"endpoint {item!r} needs host:port")
+        out.append((host, int(port)))
+    if not out:
+        raise ValueError("no endpoints given")
+    return out
+
+
 def json_post_sender(port: int, path, body_fn: Callable[[int], bytes],
                      check: Optional[Callable[[int, bytes],
                                               Optional[str]]] = None,
                      shed_status: Iterable[int] = (503,),
                      host: str = "127.0.0.1",
-                     timeout: float = 120.0
+                     timeout: float = 120.0,
+                     endpoints: Optional[Iterable[str]] = None
                      ) -> Callable[[], Callable[[int], str]]:
     """A ``worker_factory`` POSTing JSON over one keep-alive
     connection per worker. ``path`` is a string or ``path(k)``;
     ``check(status, payload)`` returns an error string for a bad
     response (None = OK; default accepts exactly 200). A transport
     error closes the connection — ``http.client`` reconnects lazily on
-    the next request."""
+    the next request.
+
+    ``endpoints`` (ISSUE 17): a list of ``host:port`` targets sprayed
+    round-robin — request ``k`` goes to target ``k % N``, so an
+    open-loop schedule splits evenly across a replica fleet. Each
+    worker keeps one keep-alive connection PER target. Overrides
+    ``host``/``port`` when given."""
     shed = set(shed_status)
+    targets = (parse_endpoints(endpoints) if endpoints
+               else [(host, port)])
 
     def factory() -> Callable[[int], str]:
-        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conns = [http.client.HTTPConnection(h, p, timeout=timeout)
+                 for h, p in targets]
 
         def send(k: int) -> str:
+            conn = conns[k % len(conns)]
             body = body_fn(k)
             try:
                 conn.request(
@@ -202,7 +234,11 @@ def json_post_sender(port: int, path, body_fn: Callable[[int], bytes],
                 raise RuntimeError(f"status {resp.status}")
             return OK
 
-        send.close = conn.close  # type: ignore[attr-defined]
+        def close() -> None:
+            for c in conns:
+                c.close()
+
+        send.close = close  # type: ignore[attr-defined]
         return send
 
     return factory

@@ -1,0 +1,334 @@
+"""Fleet observability smoke (ISSUE 17) — the CI gate for the
+federated metrics plane.
+
+End-to-end over REAL HTTP on whatever device is available (CI: CPU):
+three live engine-server replicas, one :class:`FleetAggregator`
+scraping them, and every fleet claim checked against ground truth:
+
+1. **exact federation** — after an asymmetric load phase (plus a
+   round-robin ``endpoints=`` spray from the shared load core), a
+   quiesced ``POST /scrape`` must leave the fleet's merged
+   ``pio_http_requests_total`` children EQUAL to the per-replica sums
+   and the merged latency-histogram bucket vector EQUAL to the
+   per-bucket sum of the replicas' vectors — the merged p99 is then by
+   construction the pooled-population quantile. A latency fault armed
+   only while replica 2 is driven skews its distribution, so the smoke
+   also shows the number the merge refuses to produce:
+   average-of-per-replica-p99s visibly disagrees with the pooled p99;
+2. **cross-replica trace lookup** — a fault-injected slow query sent
+   with a fixed ``traceparent`` to replica 2 ONLY must come back
+   through the fleet's ``GET /trace.json?id=`` naming that replica;
+3. **fleet SLO** — with background load on, the fleet-scoped latency
+   spec (committed ``slo/specs/ci.json``, evaluated over the MERGED
+   registry) must go ok → breach under an injected ``serving.dispatch``
+   latency fault → back to ok after the fault clears;
+4. **hot keys** — the fleet-wide Space-Saving union must surface the
+   Zipf-hottest entity and conserve the per-replica demand totals.
+
+Prints one JSON line; exits non-zero when any check fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _loadgen import (  # noqa: E402
+    expect_json_field,
+    json_post_sender,
+    run_load,
+    sample_entities,
+)
+
+SPEC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "slo", "specs", "ci.json")
+
+#: the fleet-scoped latency spec the injected fault must breach
+LATENCY_SPEC = "queries-p99-latency"
+N_USERS = 48
+ROUTE = "/queries.json"
+#: a fixed W3C trace id (32 hex) the smoke plants on replica 2 only
+TRACE_ID = "abadcafe" * 4
+SPAN_ID = "deadbeefcafef00d"
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _post(port: int, path: str, body: bytes = b"",
+          headers: Optional[dict] = None) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _drive(n: int, seed: int, endpoints=None, port: int = 0,
+           rate=None, threads: int = 4, stop=None) -> None:
+    """Closed-loop (or open-loop at ``rate``) Zipf-skewed query load
+    against one replica or round-robin across ``endpoints``."""
+    rng = np.random.default_rng(seed)
+    users = sample_entities(rng, N_USERS, n, zipf=1.5)
+    sender = json_post_sender(
+        port, ROUTE,
+        body_fn=lambda k: json.dumps({"user": f"u{users[k]}",
+                                      "num": 5}).encode(),
+        check=expect_json_field("itemScores"), endpoints=endpoints)
+    stats, _wall = run_load(sender, n, threads, rate_qps=rate,
+                            stop=stop)
+    if stats.errors and stop is None:
+        raise RuntimeError(
+            f"{len(stats.errors)} failed queries under smoke load "
+            f"(first: {stats.errors[0]})")
+
+
+def _route_children(export: dict, family: str) -> dict:
+    """label-items → child dict, for the children scoped to the
+    query route (the fleet's own HTTP traffic lives on other routes,
+    so this comparison is exact by construction)."""
+    out = {}
+    for child in (export.get(family) or {}).get("children") or []:
+        labels = dict(child.get("labels") or {})
+        if labels.get("route") == ROUTE:
+            out[tuple(sorted(labels.items()))] = child
+    return out
+
+
+def _dense(buckets) -> list:
+    """Cumulative ``[le, cum]`` export pairs → per-bucket counts."""
+    counts, prev = [], 0
+    for _le, cum in buckets:
+        counts.append(int(cum) - prev)
+        prev = int(cum)
+    return counts
+
+
+def _fleet_spec(fleet_port: int, name: str) -> dict:
+    for sp in (_get(fleet_port, "/slo.json").get("specs") or []):
+        if sp["name"] == name:
+            return sp
+    raise RuntimeError(f"spec {name!r} not evaluated by the fleet")
+
+
+def _await_fleet_state(fleet_port: int, name: str, want,
+                       timeout_s: float) -> dict:
+    deadline = time.monotonic() + timeout_s
+    sp = _fleet_spec(fleet_port, name)
+    while time.monotonic() < deadline:
+        sp = _fleet_spec(fleet_port, name)
+        if sp["state"] in want:
+            return sp
+        time.sleep(0.25)
+    return sp
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    from predictionio_tpu import faults
+    from predictionio_tpu.fleet import FleetConfig, create_fleet_server
+    from predictionio_tpu.obs import StreamingHistogram
+    from predictionio_tpu.server.engineserver import ServerConfig
+    from serving_bench import _boot_server, _wait_warm, synth_model
+
+    model = synth_model(N_USERS, 64, 8, device=False)
+    replicas = [_boot_server(model, ServerConfig(
+        batching=True, max_batch=16, batch_window_ms=2.0,
+        queue_deadline_ms=10_000.0)) for _ in range(3)]
+    ports = [srv.port for _qs, srv in replicas]
+    names = [f"127.0.0.1:{p}" for p in ports]
+
+    agg, fleet_srv = create_fleet_server(
+        FleetConfig(replicas=names, scrape_interval_sec=0.25,
+                    slo_specs=SPEC_PATH, slo_interval_sec=0.2,
+                    hot_keys_k=64),
+        host="127.0.0.1", port=0)
+    fleet_srv.start_background()
+    fport = fleet_srv.port
+
+    checks: dict = {}
+    out: dict = {"bench": "fleet_smoke", "replicas": names,
+                 "specs": SPEC_PATH}
+    stop_evt = threading.Event()
+    bg: Optional[threading.Thread] = None
+    try:
+        for i, p in enumerate(ports):
+            _wait_warm(p, f"fleet_smoke replica {i}")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if _get(fport, "/fleet.json")["replicasUp"] == 3:
+                break
+            time.sleep(0.25)
+        checks["replicas_up"] = \
+            _get(fport, "/fleet.json")["replicasUp"] == 3
+
+        # phase 1 — asymmetric load: replica 2's share runs under a
+        # 60 ms dispatch fault (below the SLO threshold; its only job
+        # is to make per-replica latency distributions DIFFER), then a
+        # round-robin endpoints= spray from the shared load core
+        _drive(100, seed=3, port=ports[0])
+        _drive(40, seed=5, port=ports[1])
+        faults.inject("serving.dispatch", "latency", delay_ms=60.0)
+        try:
+            _drive(12, seed=7, port=ports[2], threads=2)
+        finally:
+            faults.clear("serving.dispatch")
+        _drive(60, seed=9, endpoints=names, threads=6)
+
+        _post(fport, "/scrape")
+        rep_exports = [_get(p, "/metrics.json") for p in ports]
+        fleet_export = _get(fport, "/metrics.json")
+
+        # exact counter federation: every /queries.json child of the
+        # merged family equals the sum of the replicas' children
+        fam = "pio_http_requests_total"
+        sums: dict = {}
+        for ex in rep_exports:
+            for key, child in _route_children(ex, fam).items():
+                sums[key] = sums.get(key, 0.0) + float(child["value"])
+        fleet_vals = {k: float(c["value"]) for k, c in
+                      _route_children(fleet_export, fam).items()}
+        out["query_requests"] = {"fleet": sum(fleet_vals.values()),
+                                 "replicas": sum(sums.values())}
+        checks["counters_sum_exact"] = bool(sums) and fleet_vals == sums
+
+        # exact histogram federation: merged per-bucket counts equal
+        # the per-bucket sum of the replicas' vectors, so the merged
+        # p99 IS the pooled-population p99 — and visibly NOT the
+        # average of per-replica p99s (replica 2's faulted share)
+        fam = "pio_http_request_duration_seconds"
+        hsums: dict = {}
+        p99s = []
+        for ex in rep_exports:
+            for key, child in _route_children(ex, fam).items():
+                dense = _dense(child["buckets"])
+                prev = hsums.get(key)
+                hsums[key] = ([a + b for a, b in zip(prev, dense)]
+                              if prev else dense)
+                p99s.append(StreamingHistogram.from_buckets(
+                    child["buckets"]).quantile(0.99))
+        fleet_hists = _route_children(fleet_export, fam)
+        checks["histogram_buckets_exact"] = bool(hsums) and all(
+            _dense(fleet_hists[key]["buckets"]) == dense
+            for key, dense in hsums.items()
+            if key in fleet_hists) and set(hsums) == set(fleet_hists)
+        pooled_p99 = max(
+            StreamingHistogram.from_buckets(c["buckets"]).quantile(0.99)
+            for c in fleet_hists.values())
+        avg_p99 = sum(p99s) / len(p99s) if p99s else 0.0
+        out["pooled_p99_ms"] = round(pooled_p99 * 1e3, 2)
+        out["avg_of_replica_p99s_ms"] = round(avg_p99 * 1e3, 2)
+        checks["pooled_p99_not_avg_of_p99s"] = \
+            pooled_p99 > 1.2 * avg_p99
+
+        # scrape again with zero new traffic: the merge is
+        # delta-based, so a quiescent cycle must change nothing
+        _post(fport, "/scrape")
+        fleet_vals2 = {
+            k: float(c["value"]) for k, c in _route_children(
+                _get(fport, "/metrics.json"),
+                "pio_http_requests_total").items()}
+        checks["quiescent_scrape_idempotent"] = fleet_vals2 == fleet_vals
+
+        # hot keys: the Zipf-hottest entity tops the fleet union and
+        # the union conserves total demand across replicas
+        hot = _get(fport, "/hotkeys.json")
+        top_keys = [k["key"] for k in hot["fleet"][:3]]
+        out["hot_keys_top3"] = top_keys
+        checks["hot_key_found"] = "u0" in top_keys
+        fleet_total = _get(fport, "/fleet.json")["hotKeys"]["total"]
+        rep_total = sum(
+            (_get(p, "/status.json").get("hotKeys") or {}
+             ).get("total") or 0.0 for p in ports)
+        out["hot_key_totals"] = {"fleet": fleet_total,
+                                 "replicas": rep_total}
+        checks["hot_key_demand_conserved"] = fleet_total == rep_total
+
+        # phase 2 — cross-replica trace lookup: ONE fault-injected
+        # slow query rides a fixed traceparent into replica 2 only;
+        # the fleet fan-out must find it there by id
+        faults.inject("serving.dispatch", "latency", delay_ms=300.0)
+        try:
+            _post(ports[2], ROUTE,
+                  body=json.dumps({"user": "u1", "num": 5}).encode(),
+                  headers={
+                      "Content-Type": "application/json",
+                      "traceparent": f"00-{TRACE_ID}-{SPAN_ID}-01"})
+        finally:
+            faults.clear("serving.dispatch")
+        try:
+            found = _get(fport, f"/trace.json?id={TRACE_ID}")
+        except urllib.error.HTTPError as e:
+            found = {"error": e.code}
+        out["trace_found_on"] = found.get("replica")
+        checks["trace_found_on_right_replica"] = \
+            found.get("replica") == names[2]
+
+        # phase 3 — fleet SLO green → lit → green over the MERGED
+        # registry, with steady background load on all replicas
+        bg = threading.Thread(
+            target=lambda: _drive(1 << 20, seed=13, endpoints=names,
+                                  threads=6, rate=25.0, stop=stop_evt),
+            daemon=True, name="fleet-bg-load")
+        bg.start()
+        green0 = _await_fleet_state(fport, LATENCY_SPEC,
+                                    ("ok",), 20.0)
+        checks["slo_green_before"] = green0["state"] == "ok"
+
+        faults.inject("serving.dispatch", "latency", delay_ms=400.0)
+        t_inject = time.monotonic()
+        lit = _await_fleet_state(fport, LATENCY_SPEC,
+                                 ("breach",), 30.0)
+        out["breach"] = {k: lit.get(k) for k in
+                         ("state", "burnFast", "burnSlow",
+                          "violations")}
+        out["detect_sec"] = round(time.monotonic() - t_inject, 1)
+        checks["slo_breach_detected"] = lit["state"] == "breach"
+        metrics_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{fport}/metrics", timeout=30
+        ).read().decode()
+        checks["fleet_slo_series_exported"] = any(
+            ln.startswith("pio_slo_burn_rate")
+            and f'slo="{LATENCY_SPEC}"' in ln
+            for ln in metrics_text.splitlines())
+
+        faults.clear("serving.dispatch")
+        recovered = _await_fleet_state(fport, LATENCY_SPEC,
+                                       ("ok", "idle"), 60.0)
+        out["recovery_state"] = recovered["state"]
+        checks["slo_recovered"] = recovered["state"] in ("ok", "idle")
+    finally:
+        faults.clear()
+        stop_evt.set()
+        if bg is not None:
+            bg.join(timeout=60)
+        agg.stop()
+        fleet_srv.shutdown()
+        for qs, srv in replicas:
+            qs.stop_slo()
+            srv.shutdown()
+    ok = all(bool(v) for v in checks.values())
+    print(json.dumps({"ok": ok, **out, **checks}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,10 +38,18 @@ Usage: python benchmarks/load_harness.py
            [--rate-max QPS] [--step-sec S] [--zipf ALPHA]
            [--ingest-frac F] [--canary F|0] [--freshness-trials N]
            [--out CAPACITY.json] [--ci]
+           [--endpoints URL[,URL...]]
 
 ``--ci`` picks small, runner-friendly defaults (the CI capacity-gate
 step). Configs: host | staged | serial | cached | replicated |
 sharded | quantized (mesh configs skip themselves on one device).
+
+``--endpoints`` (ISSUE 17) switches to **external-fleet mode**: no
+local stack is booted — the query lane sprays round-robin across the
+given already-running replicas (request *k* → replica ``k % N``), so
+the same open-loop frontier sweep measures a multi-replica fleet
+behind a ``ptpu fleet serve`` aggregator. Ingest/canary/freshness
+lanes are skipped (they need the in-process stack).
 """
 
 from __future__ import annotations
@@ -481,6 +489,69 @@ def measure(configs="host,staged,cached", rate_min: float = 8.0,
     return out
 
 
+def measure_endpoints(endpoints, rate_min: float = 8.0,
+                      rate_max: float = 128.0, step_sec: float = 4.0,
+                      zipf: float = 1.2,
+                      n_entities: int = N_SEED_USERS) -> dict:
+    """External-fleet mode: the frontier sweep against already-running
+    replicas, round-robin per request. Boots nothing and imports no
+    jax — the replicas own the devices; this process is purely a
+    coordinated-omission-safe traffic source."""
+    targets = [e.strip() for e in endpoints if e.strip()]
+    rates = []
+    r = rate_min
+    while r <= rate_max:
+        rates.append(float(r))
+        r *= 2
+    frontier = []
+    knee = None
+    for rate in rates:
+        n = max(int(rate * step_sec), 8)
+        rng = np.random.default_rng(int(rate) + 17)
+        users = sample_entities(rng, n_entities, n, zipf)
+        sender = json_post_sender(
+            0, "/queries.json",
+            body_fn=lambda k: json.dumps(
+                {"user": f"u{users[k]}", "num": 5}).encode(),
+            check=expect_json_field("itemScores"),
+            shed_status=(503,), endpoints=targets)
+        stats, wall = run_load(sender, n,
+                               int(min(64, max(8, rate // 2))),
+                               rate_qps=rate)
+        row = {
+            "offered_qps": rate,
+            "achieved_qps": (round(len(stats.lat) / wall, 1)
+                             if wall > 0 else 0.0),
+            "window_sec": round(wall, 2),
+            **stats.summary(wall),
+        }
+        row.pop("qps", None)
+        total = len(stats.lat) + len(stats.shed)
+        row["sustained"] = bool(
+            stats.lat
+            and not stats.errors
+            and row["achieved_qps"] >= SUSTAIN_FRAC * rate
+            and len(stats.shed) <= SHED_FRAC * max(total, 1))
+        if stats.errors:
+            row["first_error"] = stats.errors[0][:160]
+        frontier.append(row)
+        if row["sustained"]:
+            knee = rate
+        else:
+            break
+    return {
+        "bench": "load_harness",
+        "mode": "endpoints",
+        "endpoints": targets,
+        "replicas": len(targets),
+        "step_sec": step_sec,
+        "zipf": zipf,
+        "rates": rates,
+        "frontier": frontier,
+        "knee_qps": knee,
+    }
+
+
 def main() -> int:
     from predictionio_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
@@ -498,6 +569,7 @@ def main() -> int:
     ci = "--ci" in argv
     if ci:
         argv.remove("--ci")
+    endpoints = flag("--endpoints", "", str)
     configs = flag("--configs",
                    "host,staged,cached", str)
     rate_min = flag("--rate-min", 8.0)
@@ -510,6 +582,18 @@ def main() -> int:
     out_path = flag("--out", "", str)
     if argv:
         raise SystemExit(f"unknown arguments: {argv}")
+
+    if endpoints:
+        result = measure_endpoints(
+            endpoints.split(","), rate_min=rate_min,
+            rate_max=rate_max, step_sec=step_sec, zipf=zipf)
+        result["measured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        if out_path:
+            with open(out_path, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        return 0 if result["knee_qps"] is not None else 1
 
     capacity = measure(configs=configs, rate_min=rate_min,
                        rate_max=rate_max, step_sec=step_sec,

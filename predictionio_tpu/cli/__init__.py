@@ -340,15 +340,56 @@ def cmd_deploy(args, storage: Storage) -> int:
         access_log_sample=args.access_log_sample,
         profile_dir=args.profile_dir or None,
         slo_specs=args.slo_specs or None,
-        slo_interval_ms=args.slo_interval_ms)
+        slo_interval_ms=args.slo_interval_ms,
+        hot_keys_k=args.hot_keys_k)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
+    scheme = "https" if ssl_ctx else "http"
+    if args.fleet_of > 1:
+        # fleet deploy (ISSUE 17, docs/fleet.md): N replicas on
+        # consecutive ports, each a full engine server, fronted by the
+        # fleet aggregator (merged metrics, fleet SLO, cross-replica
+        # traces). The aggregator holds the foreground; replicas run
+        # in background threads of this process.
+        from ..fleet import FleetConfig, create_fleet_server
+
+        servers = []
+        for i in range(args.fleet_of):
+            servers.append(deploy(
+                ctx, engine, engine_params,
+                engine_id=args.engine_id or variant.get("id", "default"),
+                engine_version=(args.engine_version
+                                or variant.get("version", "1")),
+                engine_variant=args.engine_json,
+                config=config, host=args.ip, port=args.port + i,
+                ssl_context=ssl_ctx))
+        for srv in servers:
+            srv.start_background()
+            _out(f"Replica live at {scheme}://{args.ip}:{srv.port}.")
+        fleet_cfg = FleetConfig(
+            replicas=[f"{scheme}://127.0.0.1:{srv.port}"
+                      for srv in servers],
+            scrape_interval_sec=args.fleet_scrape_interval_ms / 1000.0,
+            slo_specs=args.slo_specs or None,
+            slo_interval_sec=args.slo_interval_ms / 1000.0,
+            accesskey=args.accesskey or None)
+        agg, fleet_srv = create_fleet_server(
+            fleet_cfg, host=args.ip, port=args.fleet_port,
+            ssl_context=ssl_ctx)
+        _out(f"Fleet aggregator live at "
+             f"{scheme}://{args.ip}:{fleet_srv.port} — merged "
+             f"/metrics, /fleet.json, /trace.json, /hotkeys.json.")
+        try:
+            fleet_srv.serve_forever()
+        except KeyboardInterrupt:
+            _out("Shutting down.")
+            agg.stop()
+        return 0
     server = deploy(
         ctx, engine, engine_params,
         engine_id=args.engine_id or variant.get("id", "default"),
         engine_version=args.engine_version or variant.get("version", "1"),
         engine_variant=args.engine_json,
         config=config, host=args.ip, port=args.port, ssl_context=ssl_ctx)
-    scheme = "https" if ssl_ctx else "http"
     _out(f"Engine is deployed and running. Engine API is live at "
          f"{scheme}://{args.ip}:{server.port}.")
     _out(f"Telemetry: {scheme}://{args.ip}:{server.port}/metrics "
@@ -983,6 +1024,36 @@ def cmd_stream(args, storage: Storage) -> int:
     return 1
 
 
+def _print_slo_payload(payload: Optional[dict]) -> int:
+    """One line per spec from a ``/slo.json`` body (shared by ``ptpu
+    slo status`` and ``ptpu fleet slo``); exit 1 while burning."""
+    p = payload or {}
+    if not p.get("enabled", False):
+        _out("SLO engine is disabled on this server "
+             f"({p.get('hint', '')})")
+        return 0
+    burning = p.get("burning") or []
+    for sp in p.get("specs") or []:
+        budget = sp.get("budgetRemaining")
+        bits = [f"{sp['name']:<28} {sp['state']:<18}"]
+        for key, label in (("burnFast", "fast"),
+                           ("burnSlow", "slow")):
+            v = sp.get(key)
+            bits.append(f"burn[{label}] "
+                        + (f"{v:6.2f}x" if v is not None
+                           else "     ?"))
+        bits.append("budget "
+                    + (f"{budget * 100:6.1f}%" if budget is not None
+                       else "     ?"))
+        bits.append(f"violations {sp.get('violations', 0)}")
+        _out("  ".join(bits))
+    _out(f"{len(p.get('specs') or [])} spec(s), "
+         + (f"BURNING: {', '.join(burning)}" if burning
+            else "none burning")
+         + f" ({p.get('ticks', 0)} evaluation ticks)")
+    return 1 if burning else 0
+
+
 def cmd_slo(args, storage: Storage) -> int:
     """``ptpu slo`` (ISSUE 15, docs/slo.md):
 
@@ -1001,31 +1072,7 @@ def cmd_slo(args, storage: Storage) -> int:
             _err(f"server at {args.ip}:{args.port} unreachable: "
                  f"{_http_err_detail(e)}")
             return 1
-        p = payload or {}
-        if not p.get("enabled", False):
-            _out("SLO engine is disabled on this server "
-                 f"({p.get('hint', '')})")
-            return 0
-        burning = p.get("burning") or []
-        for sp in p.get("specs") or []:
-            budget = sp.get("budgetRemaining")
-            bits = [f"{sp['name']:<28} {sp['state']:<18}"]
-            for key, label in (("burnFast", "fast"),
-                               ("burnSlow", "slow")):
-                v = sp.get(key)
-                bits.append(f"burn[{label}] "
-                            + (f"{v:6.2f}x" if v is not None
-                               else "     ?"))
-            bits.append("budget "
-                        + (f"{budget * 100:6.1f}%" if budget is not None
-                           else "     ?"))
-            bits.append(f"violations {sp.get('violations', 0)}")
-            _out("  ".join(bits))
-        _out(f"{len(p.get('specs') or [])} spec(s), "
-             + (f"BURNING: {', '.join(burning)}" if burning
-                else "none burning")
-             + f" ({p.get('ticks', 0)} evaluation ticks)")
-        return 1 if burning else 0
+        return _print_slo_payload(payload)
     # check: gate CAPACITY.json against the committed spec file
     from ..slo import (
         gate_capacity,
@@ -1132,6 +1179,147 @@ def _http_err_detail(e: Exception) -> str:
         except Exception:  # noqa: BLE001 — fall back to the bare error
             return str(e)
     return str(e)
+
+
+def cmd_fleet(args) -> int:
+    """``ptpu fleet`` (ISSUE 17, docs/fleet.md) — the fleet
+    observability plane:
+
+    - ``serve`` — run the aggregator: scrape every ``--replicas``
+      member's ``/metrics.json``, merge exactly (counters sum,
+      histograms pool buckets, gauges gain replica labels + rollups),
+      evaluate fleet-scoped SLOs over the MERGED series, and serve
+      the fleet surface (``/``, ``/fleet.json``, ``/metrics``,
+      ``/slo.json``, ``/trace.json``, ``/hotkeys.json``);
+    - ``status`` — per-replica liveness/lag/flags + fleet headroom
+      from a running aggregator (exit 1 when replicas are down or a
+      fleet SLO burns);
+    - ``slo`` — the fleet SLO engine's burn rates (merged-series
+      verdicts, one line per spec);
+    - ``trace`` — cross-replica flight-recorder lookup: ``--id``
+      fans out to every replica and exports the hit, ``--slowest N``
+      merges fleet-wide;
+    - ``hotkeys`` — the fleet-wide Space-Saving top-K (and each
+      replica's own view).
+
+    Pure HTTP: needs neither storage nor jax.
+    """
+    if args.fleet_command == "serve":
+        from ..fleet import FleetConfig, create_fleet_server
+        from ..server.http import ssl_context_from
+
+        cfg = FleetConfig(
+            replicas=[r.strip() for r in args.replicas.split(",")
+                      if r.strip()],
+            scrape_interval_sec=args.scrape_interval_ms / 1000.0,
+            stale_after_sec=(args.stale_after_ms / 1000.0
+                             if args.stale_after_ms else None),
+            slo_specs=args.slo_specs or None,
+            slo_interval_sec=args.slo_interval_ms / 1000.0,
+            capacity_path=args.capacity or None,
+            hot_keys_k=args.hot_keys_k,
+            timeout_sec=args.timeout_sec,
+            accesskey=args.accesskey or None)
+        ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
+        agg, server = create_fleet_server(cfg, host=args.ip,
+                                          port=args.port,
+                                          ssl_context=ssl_ctx)
+        scheme = "https" if ssl_ctx else "http"
+        _out(f"Fleet aggregator live at {scheme}://{args.ip}:"
+             f"{server.port} over {len(cfg.replicas)} replica(s).")
+        _out(f"Merged telemetry: {scheme}://{args.ip}:{server.port}"
+             f"/metrics · /fleet.json · /slo.json · /trace.json · "
+             f"/hotkeys.json")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            _out("Shutting down.")
+            agg.stop()
+        return 0
+    try:
+        if args.fleet_command == "status":
+            payload = _server_call(args, "/fleet.json") or {}
+        elif args.fleet_command == "slo":
+            return _print_slo_payload(_server_call(args, "/slo.json"))
+        elif args.fleet_command == "hotkeys":
+            payload = _server_call(
+                args, f"/hotkeys.json?n={args.top}") or {}
+        else:  # trace
+            if args.id:
+                payload = _server_call(args,
+                                       f"/trace.json?id={args.id}")
+            elif args.slowest is not None:
+                payload = _server_call(
+                    args, f"/trace.json?slowest={args.slowest}")
+            else:
+                payload = _server_call(args, "/trace.json")
+    except Exception as e:  # noqa: BLE001 — report, don't traceback
+        _err(f"fleet aggregator at {args.ip}:{args.port} unreachable: "
+             f"{_http_err_detail(e)}")
+        return 1
+    if args.fleet_command == "status":
+        down = 0
+        for r in payload.get("replicas") or []:
+            up = r.get("up")
+            down += 0 if up else 1
+            flags = []
+            if r.get("degraded"):
+                flags.append("DEGRADED")
+            if r.get("nonfinite"):
+                flags.append("NONFINITE")
+            if r.get("sloBurning"):
+                flags.append("burning:" + ",".join(r["sloBurning"]))
+            age = r.get("lastScrapeAgeSec")
+            _out(f"{r.get('replica', '?'):<24} "
+                 f"{'up' if up else 'DOWN':<5} "
+                 f"age {age if age is not None else '?':>7}s  "
+                 f"requests {r.get('requestCount') or 0:>8}  "
+                 f"{' '.join(flags)}")
+        headroom = payload.get("capacityHeadroom")
+        burning = (payload.get("slo") or {}).get("burning") or []
+        _out(f"{payload.get('replicasUp', 0)}/"
+             f"{payload.get('replicasConfigured', 0)} replicas up, "
+             f"qps {payload.get('qps', 0.0):.2f}, headroom "
+             + (f"{headroom:.3f}" if headroom is not None else "?")
+             + (f", fleet SLO BURNING: {', '.join(burning)}"
+                if burning else ", fleet SLO ok")
+             + f" ({payload.get('cycles', 0)} scrape cycles)")
+        return 1 if (down or burning) else 0
+    if args.fleet_command == "hotkeys":
+        for k in payload.get("fleet") or []:
+            _out(f"{k['key']:<32} {k['count']:>12.0f} "
+                 f"(±{k['error']:.0f})")
+        if not payload.get("fleet"):
+            _out("No hot keys observed yet (the sketch fills from "
+                 "query-path entity ids).")
+        return 0
+    # trace
+    if args.id:
+        trace = (payload or {}).get("trace")
+        replica = (payload or {}).get("replica", "?")
+        out_path = args.output or f"trace-{args.id[:12]}.json"
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        n = len((trace or {}).get("traceEvents") or [])
+        _out(f"Trace found on replica {replica}; wrote {n} trace "
+             f"events to {out_path} — load it at "
+             f"https://ui.perfetto.dev.")
+        return 0
+    if args.slowest is not None:
+        traces = (payload or {}).get("traces") or []
+        if not traces:
+            _out("No retained traces anywhere in the fleet yet.")
+            return 0
+        for t in traces:
+            _out(f"{t.get('traceId')}  {t.get('durationMs', '?')}ms  "
+                 f"replica={t.get('replica')}  "
+                 f"status={t.get('status')}  "
+                 f"reason={t.get('reason')}  {t.get('name', '')}")
+        _out(f"Export one: ptpu fleet trace --id "
+             f"{traces[0]['traceId']} --port {args.port}")
+        return 0
+    _out(json.dumps(payload, indent=2))
+    return 0
 
 
 def cmd_export(args, storage: Storage) -> int:
@@ -1792,6 +1980,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "freshness objectives")
     s.add_argument("--slo-interval-ms", type=float, default=1000.0,
                    help="SLO evaluation tick; 0 disables the engine")
+    s.add_argument("--hot-keys-k", type=int, default=128,
+                   help="Space-Saving hot-key sketch capacity: every "
+                        "entity hotter than 1/k of query traffic is "
+                        "guaranteed tracked (pio_hot_keys, the "
+                        "/status.json hotKeys block; docs/fleet.md). "
+                        "0 disables")
+    s.add_argument("--fleet-of", type=int, default=1,
+                   help="deploy N replicas on consecutive ports "
+                        "fronted by the fleet aggregator "
+                        "(docs/fleet.md): merged metrics, fleet-scoped "
+                        "SLOs, cross-replica trace lookup")
+    s.add_argument("--fleet-port", type=int, default=8200,
+                   help="port the fleet aggregator listens on "
+                        "(--fleet-of > 1)")
+    s.add_argument("--fleet-scrape-interval-ms", type=float,
+                   default=5000.0,
+                   help="aggregator scrape cadence over the replicas")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -1941,6 +2146,70 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-o", "--output", default="",
                    help="output file for --id (default "
                         "trace-<id>.json)")
+
+    s = sub.add_parser(
+        "fleet", help="fleet observability plane (docs/fleet.md): run "
+                      "the aggregator that merges N replicas' metrics "
+                      "exactly, or query a running one")
+    fleet_sub = s.add_subparsers(dest="fleet_command", required=True)
+    c = fleet_sub.add_parser(
+        "serve", help="run the aggregator over --replicas: merged "
+                      "/metrics, fleet SLOs, cross-replica traces, "
+                      "hot keys")
+    c.add_argument("--replicas", required=True,
+                   help="comma-separated replica addresses "
+                        "(host:port or full URLs)")
+    c.add_argument("--ip", default="0.0.0.0")
+    c.add_argument("--port", type=int, default=8200)
+    c.add_argument("--scrape-interval-ms", type=float, default=5000.0,
+                   help="how often each replica's /metrics.json and "
+                        "/status.json are pulled and merged")
+    c.add_argument("--stale-after-ms", type=float, default=0.0,
+                   help="a replica unscraped this long is DOWN "
+                        "(default: 3x the scrape interval)")
+    c.add_argument("--slo-specs", default="",
+                   help="SLO spec file evaluated against the MERGED "
+                        "series (fleet-scoped burn rates); default: "
+                        "built-in availability/latency objectives")
+    c.add_argument("--slo-interval-ms", type=float, default=1000.0,
+                   help="fleet SLO evaluation tick; 0 disables")
+    c.add_argument("--capacity", default="",
+                   help="CAPACITY.json (load_harness output); its "
+                        "knee qps feeds pio_fleet_capacity_headroom")
+    c.add_argument("--hot-keys-k", type=int, default=128,
+                   help="fleet-wide merged hot-key sketch capacity")
+    c.add_argument("--timeout-sec", type=float, default=5.0,
+                   help="per-replica scrape/fan-out timeout")
+    c.add_argument("--accesskey", default="",
+                   help="require ?accessKey= on POST /scrape and "
+                        "POST /stop")
+    c.add_argument("--cert", default="", help="PEM cert to serve HTTPS")
+    c.add_argument("--key", default="", help="PEM private key")
+    for name, helptext in (
+            ("status", "per-replica liveness/lag/flags + fleet "
+                       "headroom (exit 1 on down replicas or a "
+                       "burning fleet SLO)"),
+            ("slo", "fleet SLO burn rates from the merged series"),
+            ("trace", "cross-replica flight-recorder lookup"),
+            ("hotkeys", "fleet-wide hot-key top-K")):
+        c = fleet_sub.add_parser(name, help=helptext)
+        c.add_argument("--ip", default="127.0.0.1")
+        c.add_argument("--port", type=int, default=8200)
+        c.add_argument("--accesskey", default="")
+        c.add_argument("--https", action="store_true")
+        c.add_argument("--insecure", action="store_true")
+        if name == "trace":
+            c.add_argument("--id", default="",
+                           help="fan the id out to every replica and "
+                                "export the hit as Perfetto JSON")
+            c.add_argument("--slowest", type=int, default=None,
+                           help="the fleet's N slowest retained "
+                                "traces, merged")
+            c.add_argument("-o", "--output", default="",
+                           help="output file for --id")
+        if name == "hotkeys":
+            c.add_argument("--top", type=int, default=16,
+                           help="keys to list")
 
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)
@@ -2151,6 +2420,9 @@ def main(argv: Optional[List[str]] = None,
     if args.command == "check":
         # pure-AST lint: needs neither storage nor jax
         return cmd_check(args)
+    if args.command == "fleet":
+        # pure HTTP against replicas/aggregator: no storage, no jax
+        return cmd_fleet(args)
     if args.command == "audit-hlo":
         # needs jax on a forced virtual mesh, but no storage; the
         # device topology MUST be pinned before the first jax import

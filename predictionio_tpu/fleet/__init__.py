@@ -1,0 +1,27 @@
+"""Fleet observability plane (ISSUE 17, docs/fleet.md).
+
+Makes N QueryServer replicas legible as ONE system: a
+:class:`FleetAggregator` scrapes every replica's full-fidelity
+``/metrics.json`` exposition and merges it exactly — counters sum
+(reset-compensated), gauges gain per-replica labels plus min/max/sum
+rollups, histograms add per-bucket counts so every merged quantile is
+the pooled-population quantile at bucket resolution. On top: a
+fleet-scoped SLO engine over the merged series, cross-replica trace
+lookup, fleet-wide hot-key telemetry, and capacity headroom against
+the committed CAPACITY.json knee. ``ptpu fleet serve`` (or
+``ptpu deploy --fleet-of N``) boots one.
+"""
+
+from .aggregator import (
+    FleetAggregator,
+    FleetConfig,
+    build_fleet_app,
+    create_fleet_server,
+)
+
+__all__ = [
+    "FleetAggregator",
+    "FleetConfig",
+    "build_fleet_app",
+    "create_fleet_server",
+]

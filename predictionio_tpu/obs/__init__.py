@@ -7,6 +7,7 @@ docs/observability.md for the full metric catalog.
 """
 
 from .guard import TransferGuardCounter
+from .hotkeys import SpaceSaving, mount_hot_key_metrics
 from .overlap import OverlapTracker
 from .histogram import (
     DEFAULT_LATENCY_BOUNDS,
@@ -22,7 +23,13 @@ from .registry import (
     format_value,
     render_histogram_lines,
 )
-from .runtime import build_info, hbm_stats, register_runtime_metrics
+from .runtime import (
+    build_info,
+    hbm_stats,
+    process_stats,
+    register_process_metrics,
+    register_runtime_metrics,
+)
 from .trace import (
     DeviceProfiler,
     FlightRecorder,
@@ -40,6 +47,7 @@ __all__ = [
     "FlightRecorder",
     "MetricsRegistry",
     "OverlapTracker",
+    "SpaceSaving",
     "StreamingHistogram",
     "Trace",
     "Tracer",
@@ -53,7 +61,10 @@ __all__ = [
     "hbm_stats",
     "linear_bounds",
     "mark_active_traces",
+    "mount_hot_key_metrics",
     "mount_span_metrics",
+    "process_stats",
+    "register_process_metrics",
     "register_runtime_metrics",
     "render_histogram_lines",
     "window_quantile",

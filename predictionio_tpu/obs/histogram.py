@@ -17,7 +17,7 @@ import math
 import threading
 import time
 from bisect import bisect_left
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 def exponential_bounds(start: float, factor: float,
@@ -78,6 +78,105 @@ class StreamingHistogram:
         # retained trace pay one None slot, nothing more
         self._exemplars: Optional[Dict[int, Tuple[str, float,
                                                   float]]] = None
+
+    @classmethod
+    def from_buckets(cls, buckets: Sequence[Tuple[Any, float]],
+                     sum: Optional[float] = None,
+                     minimum: Optional[float] = None,
+                     maximum: Optional[float] = None
+                     ) -> "StreamingHistogram":
+        """Rebuild a histogram from cumulative ``(le, count)`` pairs —
+        the exact :meth:`bucket_counts` / exposition shape, with the
+        last ``le`` ``inf`` (or the JSON-safe string ``"+Inf"``). The
+        inverse of the scrape: a fleet aggregator that pulled a
+        replica's cumulative buckets gets back a mergeable histogram.
+        ``sum``/``minimum``/``maximum`` carry the replica's exact
+        moments when known; absent, they are estimated from bucket
+        edges (bucket-resolution truth, same as any quantile here)."""
+        if len(buckets) < 2:
+            raise ValueError("need at least one finite bucket + +Inf")
+        les: List[float] = []
+        cums: List[float] = []
+        for le, cum in buckets:
+            if isinstance(le, str):
+                le = math.inf if le in ("+Inf", "inf", "Inf") \
+                    else float(le)
+            les.append(float(le))
+            cums.append(float(cum))
+        if not math.isinf(les[-1]):
+            raise ValueError("last bucket upper bound must be +Inf")
+        hist = cls(bounds=les[:-1])
+        prev = 0.0
+        counts: List[int] = []
+        for cum in cums:
+            d = cum - prev
+            if d < 0:
+                raise ValueError("cumulative bucket counts must be "
+                                 "non-decreasing")
+            counts.append(int(d))
+            prev = cum
+        hist._counts = counts
+        n = 0
+        for c in counts:
+            n += c
+        hist._count = n
+        if n:
+            # estimate missing moments from bucket edges: lowest
+            # occupied bucket's lower edge / highest occupied bucket's
+            # upper bound (overflow falls back to the last bound)
+            lo_i = next(i for i, c in enumerate(counts) if c)
+            hi_i = next(i for i in range(len(counts) - 1, -1, -1)
+                        if counts[i])
+            est_min = hist.bounds[lo_i - 1] if lo_i > 0 \
+                else hist.bounds[0]
+            est_max = hist.bounds[min(hi_i, len(hist.bounds) - 1)]
+            hist._min = float(minimum) if minimum is not None \
+                else est_min
+            hist._max = float(maximum) if maximum is not None \
+                else est_max
+            if sum is not None:
+                hist._sum = float(sum)
+            else:
+                s = 0.0
+                for i, c in enumerate(counts):
+                    if c:
+                        s += c * hist.bounds[min(i, len(hist.bounds)
+                                                 - 1)]
+                hist._sum = s
+        elif sum is not None:
+            hist._sum = float(sum)
+        return hist
+
+    def merge(self, other: "StreamingHistogram") -> None:
+        """Fold ``other``'s observations into this histogram —
+        LOSSLESS at bucket resolution because both sides share fixed
+        bounds: per-bucket counts ADD, so any quantile of the merged
+        histogram is the pooled-population quantile, not an
+        average-of-percentiles. Bounds must match exactly (merging
+        mismatched bucket layouts would silently mis-bin). Locks are
+        taken sequentially (snapshot ``other``, then update ``self``)
+        — never nested, so merge can never deadlock against a
+        concurrent ``record``."""
+        if other.bounds != self.bounds:
+            raise ValueError(
+                "cannot merge histograms with different bounds "
+                f"({len(other.bounds)} vs {len(self.bounds)} buckets)")
+        with other._lock:
+            counts = list(other._counts)
+            n = other._count
+            s = other._sum
+            lo, hi = other._min, other._max
+        if n == 0:
+            return
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += n
+            self._sum += s
+            if lo < self._min:
+                self._min = lo
+            if hi > self._max:
+                self._max = hi
 
     def record(self, value: float) -> None:
         """O(1): one bisect over the fixed bounds + one increment."""

@@ -214,6 +214,34 @@ class _Family:
                              f"{format_value(child.value)}")
         return lines
 
+    def export(self) -> Dict[str, Any]:
+        """Full-fidelity JSON view of the family — unlike
+        :meth:`snapshot` (which reduces histograms to percentile
+        summaries), this carries the raw cumulative buckets, so a
+        fleet aggregator can rebuild and LOSSLESSLY merge the
+        histogram (``StreamingHistogram.from_buckets``). ``inf``
+        upper bounds render as the string ``"+Inf"`` (JSON has no
+        Infinity literal)."""
+        children: List[Dict[str, Any]] = []
+        for items, child in sorted(self.children()):
+            labels = {k: v for k, v in items}
+            if self.kind == "histogram":
+                buckets = [["+Inf" if math.isinf(le) else le, cum]
+                           for le, cum in child.bucket_counts()]
+                children.append({
+                    "labels": labels,
+                    "buckets": buckets,
+                    "count": child.count,
+                    "sum": child.sum,
+                    "min": child.min,
+                    "max": child.max,
+                })
+            else:
+                children.append({"labels": labels,
+                                 "value": child.value})
+        return {"kind": self.kind, "help": self.help,
+                "children": children}
+
     def snapshot(self) -> Any:
         """JSON-friendly view: scalar for the unlabeled child, else a
         ``{"label=value,...": sample}`` map."""
@@ -303,3 +331,16 @@ class MetricsRegistry:
         with self._lock:
             families = list(self._families.values())
         return {fam.name: fam.snapshot() for fam in families}
+
+    def export(self) -> Dict[str, Any]:
+        """Full-fidelity JSON exposition (``GET /metrics.json``): every
+        family with kind/help and per-child labels, values, and — for
+        histograms — the raw cumulative buckets plus exact
+        sum/min/max. This is the fleet-scrape lane: the aggregator
+        merges these exactly (counters sum, histogram buckets add),
+        which the percentile-summary :meth:`snapshot` cannot support.
+        Render-time collectors (build info, HBM) are exposition-only
+        and deliberately absent here."""
+        with self._lock:
+            families = list(self._families.values())
+        return {fam.name: fam.export() for fam in families}

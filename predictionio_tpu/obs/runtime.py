@@ -95,6 +95,66 @@ def build_info(server: str, version: Optional[str] = None
     return info
 
 
+def process_stats() -> Dict[str, float]:
+    """Host-resource self-read off ``/proc`` (Linux only, no psutil —
+    the ISSUE 17 constraint): RSS bytes, cumulative CPU seconds
+    (user+sys), open fd count, thread count. Empty dict where /proc is
+    absent (macOS CI shards) — the gauges simply read 0 there."""
+    import os
+
+    out: Dict[str, float] = {}
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        page = os.sysconf("SC_PAGESIZE")
+        out["rss_bytes"] = float(int(fields[1]) * page)
+    except Exception:  # noqa: BLE001 — absent /proc degrades to {}
+        return {}
+    try:
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # comm can contain spaces/parens: split after the LAST ")"
+        rest = stat.rsplit(")", 1)[1].split()
+        tck = os.sysconf("SC_CLK_TCK")
+        # rest[0] is field 3 (state); utime/stime are fields 14/15
+        out["cpu_seconds_total"] = (int(rest[11]) + int(rest[12])) \
+            / float(tck)
+        out["threads"] = float(int(rest[17]))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        out["open_fds"] = float(len(os.listdir("/proc/self/fd")))
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def register_process_metrics(reg: MetricsRegistry) -> None:
+    """Mount the ``pio_process_{rss_bytes,cpu_seconds_total,open_fds,
+    threads}`` fn-gauges — the host-saturation half of a scale-out
+    decision (a replica can be SLO-green but one fd leak or one core
+    short of falling over). Callable-backed so every scrape reads the
+    live /proc values; no-op registration where /proc is absent."""
+    if not process_stats():
+        return
+
+    def _read(key: str):
+        return lambda: process_stats().get(key, 0.0)
+
+    reg.gauge("pio_process_rss_bytes",
+              "Resident set size of this server process "
+              "(/proc/self/statm)", fn=_read("rss_bytes"))
+    reg.gauge("pio_process_cpu_seconds_total",
+              "Cumulative user+system CPU seconds of this process "
+              "(/proc/self/stat)", fn=_read("cpu_seconds_total"))
+    reg.gauge("pio_process_open_fds",
+              "Open file descriptors (/proc/self/fd)",
+              fn=_read("open_fds"))
+    reg.gauge("pio_process_threads",
+              "OS threads in this process (/proc/self/stat)",
+              fn=_read("threads"))
+
+
 def register_runtime_metrics(reg: MetricsRegistry, server: str,
                              version: Optional[str] = None) -> None:
     """Mount the standard process-level series on ``reg``:
@@ -112,6 +172,9 @@ def register_runtime_metrics(reg: MetricsRegistry, server: str,
       :class:`.guard.TransferGuardCounter`
     - ``pio_device_hbm_bytes{device,kind,stat=used|limit|peak}`` —
       per-device HBM occupancy, absent off-TPU
+    - ``pio_process_{rss_bytes,cpu_seconds_total,open_fds,threads}``
+      — /proc self-read host-resource gauges
+      (:func:`register_process_metrics`), absent without /proc
     """
     # idempotent per registry: a second build_app over the same
     # registry must not double-register the hbm/span collectors
@@ -193,3 +256,4 @@ def register_runtime_metrics(reg: MetricsRegistry, server: str,
         return lines
 
     reg.register_collector(_hbm_lines)
+    register_process_metrics(reg)

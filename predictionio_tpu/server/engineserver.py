@@ -306,6 +306,14 @@ class ServerConfig:
     #: artifact directory for on-demand ``POST /profile`` device
     #: captures (None: $PTPU_PROFILE_DIR, else <tmp>/ptpu-profiles)
     profile_dir: Optional[str] = None
+    #: Hot-key telemetry (ISSUE 17, docs/fleet.md): capacity of the
+    #: Space-Saving heavy-hitter sketch fed by the query path's entity
+    #: ids — every key hotter than 1/k of traffic is guaranteed
+    #: monitored. Exported as ``pio_hot_keys{rank,key}`` and the
+    #: ``hotKeys`` block of /status.json (which the fleet aggregator
+    #: merges); the signal entity-affinity routing will consume.
+    #: 0 disables the sketch entirely.
+    hot_keys_k: int = 128
     #: SLO engine (ISSUE 15, docs/slo.md): declarative service
     #: objectives evaluated continuously against this server's live
     #: metric registry via multi-window error-budget burn rates
@@ -507,6 +515,15 @@ class QueryServer:
                               slow_ms=self.config.trace_slow_ms)
                        if self.config.tracing else None)
         self.profiler = DeviceProfiler(self.config.profile_dir)
+        # hot-key telemetry (ISSUE 17): a Space-Saving sketch over the
+        # query path's entity ids — exported per replica as
+        # pio_hot_keys{rank,key} and merged fleet-wide by the
+        # aggregator. O(k) per record, k bounded by config.
+        from ..obs.hotkeys import SpaceSaving, mount_hot_key_metrics
+        self.hotkeys: Optional[SpaceSaving] = None
+        if self.config.hot_keys_k > 0:
+            self.hotkeys = SpaceSaving(capacity=self.config.hot_keys_k)
+            mount_hot_key_metrics(self.metrics, self.hotkeys)
         # fault-injection observability: injections delivered anywhere
         # in this process, attributed by point and mode — and flagged
         # onto whatever traces the injected thread was working on, so
@@ -1395,6 +1412,11 @@ class QueryServer:
         A cache hit skips supplement and device dispatch entirely;
         concurrent identical misses compute ONCE. Returns the result
         or an ``HTTPError`` instance; may also raise ``HTTPError``."""
+        if self.hotkeys is not None:
+            # recorded BEFORE the cache: a hot key that is hot because
+            # it keeps hitting the cache is still a hot key (the
+            # router signal counts demand, not device work)
+            self.hotkeys.record(self._entity_of(query_json))
         cache = self.cache
         if cache is None:
             return self._compute_stable(query_json, obs)
@@ -1435,6 +1457,8 @@ class QueryServer:
         :meth:`serve` under the CANDIDATE instance's namespace — the
         two arms can never serve each other's cached results. Raises
         like :meth:`query_candidate`."""
+        if self.hotkeys is not None:
+            self.hotkeys.record(self._entity_of(query_json))
         cache = self.cache
         with self._lock:
             cand = self._candidate
@@ -2611,6 +2635,11 @@ def build_app(server: QueryServer) -> HTTPApp:
             "hbm": hbm_stats(),
             "cache": (server.cache.stats() if server.cache is not None
                       else {"enabled": False}),
+            # hot-key telemetry (ISSUE 17): the fleet aggregator
+            # merges these per-replica sketches into the fleet top-K
+            "hotKeys": (server.hotkeys.snapshot()
+                        if server.hotkeys is not None
+                        else {"enabled": False}),
             **_phase_table(),
         })
 

@@ -411,18 +411,45 @@ def mount_metrics(app: HTTPApp, registry, server_name: Optional[str] = None,
         tracer.register_metrics(registry)
         mount_trace_routes(app, tracer)
 
+    # scrape self-cost guard (ISSUE 17 satellite): rendering the
+    # exposition is work the server pays PER SCRAPER — an aggregator
+    # polling N replicas every 250ms must be able to see (and a
+    # regression test bound) what that costs. Sub-ms bounds: a healthy
+    # render of a few hundred series is tens of microseconds.
+    render_hist = registry.histogram(
+        "pio_metrics_render_seconds",
+        "Wall time to render one /metrics(.json) exposition, by format",
+        bounds=[0.0001 * (2.0 ** i) for i in range(16)])
+
     @app.route("GET", "/metrics")
     def metrics(req: Request) -> Response:
         # content negotiation (ISSUE 12 satellite): OpenMetrics is
         # required for exemplar rendering; everything else gets the
         # 0.0.4 text format it always got
         accept = req.header("Accept") or ""
-        if "application/openmetrics-text" in accept:
-            return Response(body=registry.render(openmetrics=True),
+        openmetrics = "application/openmetrics-text" in accept
+        t0 = time.perf_counter()
+        body = registry.render(openmetrics=openmetrics)
+        render_hist.labels(
+            format="openmetrics" if openmetrics else "text"
+        ).observe(time.perf_counter() - t0)
+        if openmetrics:
+            return Response(body=body,
                             content_type=OPENMETRICS_CONTENT_TYPE)
         return Response(
-            body=registry.render(),
+            body=body,
             content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    @app.route("GET", "/metrics.json")
+    def metrics_json(req: Request) -> Response:
+        # the fleet-scrape lane (ISSUE 17): full-fidelity JSON with
+        # raw cumulative histogram buckets, so the aggregator merges
+        # pooled populations instead of averaging percentiles
+        t0 = time.perf_counter()
+        resp = json_response(registry.export())
+        render_hist.labels(format="json").observe(
+            time.perf_counter() - t0)
+        return resp
 
     if status is not None:
         @app.route("GET", "/status.json")

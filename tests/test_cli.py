@@ -462,6 +462,8 @@ def test_deploy_batching_defaults_match_config():
     assert args.trace_ring == cfg.trace_ring
     assert args.trace_slow_ms == cfg.trace_slow_ms
     assert args.access_log_sample == cfg.access_log_sample
+    # hot-key telemetry (ISSUE 17) stays in sync the same way
+    assert args.hot_keys_k == cfg.hot_keys_k
     import inspect
 
     sig = inspect.signature(MicroBatcher.__init__)

@@ -1,0 +1,418 @@
+"""Fleet observability plane tests (ISSUE 17, docs/fleet.md):
+lossless histogram merging (merge-of-splits equals the whole
+population, bucket for bucket), registry JSON export fidelity, the
+Space-Saving hot-key sketch's guarantees, and the FleetAggregator's
+merge semantics — counter sums with reset compensation, per-replica
+gauge labels with min/max/sum rollups over live replicas only, the
+pio_slo_* merge skip, and cross-replica trace fan-out — all through an
+injected fetch, no sockets."""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.fleet import FleetAggregator, FleetConfig
+from predictionio_tpu.obs import (
+    MetricsRegistry,
+    SpaceSaving,
+    StreamingHistogram,
+    mount_hot_key_metrics,
+)
+from predictionio_tpu.server.http import HTTPError
+
+from test_observability import validate_exposition
+
+BOUNDS = [0.001, 0.01, 0.1, 1.0, 10.0]
+
+
+def _hist_of(samples, bounds=BOUNDS) -> StreamingHistogram:
+    h = StreamingHistogram(bounds)
+    for v in samples:
+        h.record(float(v))
+    return h
+
+
+# ---------------------------------------------------------------------------
+# StreamingHistogram.merge / from_buckets — the federation primitive
+# ---------------------------------------------------------------------------
+
+def _splits(samples):
+    """Adversarial partitions of one population: however the fleet's
+    observations land on replicas, the merge must reconstruct the
+    pooled distribution exactly."""
+    s = list(samples)
+    third = len(s) // 3
+    yield "round_robin", [s[0::3], s[1::3], s[2::3]]
+    srt = sorted(s)  # each replica sees a disjoint latency regime
+    yield "sorted_thirds", [srt[:third], srt[third:2 * third],
+                            srt[2 * third:]]
+    yield "one_replica_idle", [s, [], []]
+    yield "singleton_heavy", [s[:1], s[1:2], s[2:]]
+
+
+class TestHistogramMerge:
+    def test_merge_of_splits_equals_whole_population(self):
+        rng = np.random.default_rng(42)
+        samples = rng.lognormal(mean=-3.0, sigma=1.5, size=2000)
+        whole = _hist_of(samples)
+        for label, parts in _splits(samples):
+            merged = StreamingHistogram(BOUNDS)
+            for part in parts:
+                merged.merge(_hist_of(part))
+            assert merged.bucket_counts() == whole.bucket_counts(), label
+            assert merged.count == whole.count
+            assert merged.sum == pytest.approx(whole.sum)
+            assert merged.min == whole.min
+            assert merged.max == whole.max
+            for q in (0.5, 0.9, 0.99, 0.999):
+                # identical buckets ⇒ identical interpolation: the
+                # merged quantile IS the pooled-population quantile
+                assert merged.quantile(q) == whole.quantile(q), label
+
+    def test_average_of_percentiles_is_not_the_answer(self):
+        # the two-regime counterexample (docs/fleet.md): one fast
+        # replica, one slow replica — the pooled p99 lives in the slow
+        # regime, the average of per-replica p99s in neither
+        fast = _hist_of([0.002] * 99 + [0.004])
+        slow = _hist_of([0.5] * 50)
+        merged = StreamingHistogram(BOUNDS)
+        merged.merge(fast)
+        merged.merge(slow)
+        pooled = merged.quantile(0.99)
+        avg = (fast.quantile(0.99) + slow.quantile(0.99)) / 2
+        assert pooled > 0.1            # in the slow regime
+        assert avg < 0.6 * pooled      # nowhere near it
+
+    def test_merge_empty_and_into_empty(self):
+        h = _hist_of([0.05, 0.2])
+        h.merge(StreamingHistogram(BOUNDS))
+        assert h.count == 2
+        e = StreamingHistogram(BOUNDS)
+        e.merge(h)
+        assert e.bucket_counts() == h.bucket_counts()
+        assert e.min == h.min and e.max == h.max
+
+    def test_merge_bounds_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            _hist_of([0.1]).merge(StreamingHistogram([1.0, 2.0]))
+
+    def test_from_buckets_roundtrip(self):
+        h = _hist_of([0.0005, 0.05, 0.05, 0.7, 42.0])
+        rebuilt = StreamingHistogram.from_buckets(
+            h.bucket_counts(), sum=h.sum, minimum=h.min, maximum=h.max)
+        assert rebuilt.bucket_counts() == h.bucket_counts()
+        assert rebuilt.count == h.count
+        assert rebuilt.sum == pytest.approx(h.sum)
+        assert rebuilt.quantile(0.9) == h.quantile(0.9)
+
+    def test_from_buckets_estimates_missing_summaries(self):
+        h = StreamingHistogram.from_buckets(
+            [(0.1, 2), (1.0, 3), (math.inf, 3)])
+        assert h.count == 3
+        assert 0.0 <= h.min <= 0.1
+        assert 0.1 <= h.max <= 1.0
+        assert h.sum > 0.0
+
+    def test_from_buckets_validation(self):
+        with pytest.raises(ValueError):
+            StreamingHistogram.from_buckets([(math.inf, 1)])
+        with pytest.raises(ValueError):  # last bucket must be +Inf
+            StreamingHistogram.from_buckets([(0.1, 1), (1.0, 2)])
+        with pytest.raises(ValueError):  # cumulative counts regress
+            StreamingHistogram.from_buckets(
+                [(0.1, 5), (1.0, 3), (math.inf, 6)])
+
+    def test_from_buckets_accepts_exported_inf_string(self):
+        h = StreamingHistogram.from_buckets([[0.1, 1], ["+Inf", 2]])
+        assert h.count == 2
+
+
+# ---------------------------------------------------------------------------
+# Space-Saving hot-key sketch
+# ---------------------------------------------------------------------------
+
+class TestSpaceSaving:
+    def test_exact_under_capacity(self):
+        s = SpaceSaving(capacity=8)
+        for k, n in [("a", 5), ("b", 3), ("c", 1)]:
+            for _ in range(n):
+                s.record(k)
+        top = s.top()
+        assert [(t["key"], t["count"], t["error"]) for t in top] == [
+            ("a", 5.0, 0.0), ("b", 3.0, 0.0), ("c", 1.0, 0.0)]
+        assert s.total == 9.0
+
+    def test_eviction_overestimates_within_error(self):
+        # a 2-slot sketch over a heavy hitter and noise: the heavy
+        # hitter must survive with count ≥ truth, and every reported
+        # count minus its error is a lower bound on the truth
+        s = SpaceSaving(capacity=2)
+        truth = {"hot": 0}
+        for i in range(200):
+            s.record("hot")
+            truth["hot"] += 1
+            s.record(f"noise{i}")
+        top = {t["key"]: t for t in s.top()}
+        assert "hot" in top
+        hot = top["hot"]
+        assert hot["count"] >= truth["hot"]
+        assert hot["count"] - hot["error"] <= truth["hot"]
+        assert s.total == 400.0
+
+    def test_ignores_empty_keys(self):
+        s = SpaceSaving(capacity=4)
+        s.record(None)
+        s.record("")
+        assert s.total == 0.0 and s.top() == []
+
+    def test_merge_items_conserves_totals(self):
+        a = SpaceSaving(capacity=8)
+        b = SpaceSaving(capacity=8)
+        for _ in range(10):
+            a.record("x")
+        for _ in range(4):
+            b.record("x")
+        for _ in range(6):
+            b.record("y")
+        fleet = SpaceSaving(capacity=8)
+        for sk in (a, b):
+            snap = sk.snapshot()
+            fleet.merge_items(snap["top"], total=snap["total"])
+        top = {t["key"]: t["count"] for t in fleet.top()}
+        assert top == {"x": 14.0, "y": 6.0}
+        assert fleet.total == 20.0
+
+    def test_collector_exports_ranked_gauges(self):
+        reg = MetricsRegistry()
+        s = SpaceSaving(capacity=4)
+        for _ in range(3):
+            s.record("u1")
+        s.record("u2")
+        mount_hot_key_metrics(reg, s, top_n=2)
+        text = reg.render()
+        validate_exposition(text)
+        assert 'pio_hot_keys{key="u1",rank="1"} 3' in text
+        assert 'pio_hot_keys{key="u2",rank="2"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# registry JSON export (the scrape wire format)
+# ---------------------------------------------------------------------------
+
+class TestRegistryExport:
+    def test_export_shapes(self):
+        reg = MetricsRegistry()
+        reg.counter("t_total", "c").labels(route="/q").inc(3)
+        reg.gauge("t_g", "g").set(1.5)
+        reg.histogram("t_h", "h", bounds=[0.1, 1.0]).observe(0.05)
+        out = reg.export()
+        assert out["t_total"]["kind"] == "counter"
+        assert out["t_total"]["children"] == [
+            {"labels": {"route": "/q"}, "value": 3.0}]
+        assert out["t_g"]["children"][0]["value"] == 1.5
+        hist = out["t_h"]["children"][0]
+        assert hist["count"] == 1 and hist["sum"] == pytest.approx(0.05)
+        assert hist["buckets"][-1][0] == "+Inf"
+        # the export is exact: rebuilding from it reproduces the
+        # histogram the replica held
+        rebuilt = StreamingHistogram.from_buckets(
+            hist["buckets"], sum=hist["sum"],
+            minimum=hist["min"], maximum=hist["max"])
+        assert rebuilt.count == 1
+
+    def test_export_is_json_safe(self):
+        import json as _json
+
+        reg = MetricsRegistry()
+        reg.histogram("t_h", "h", bounds=[0.1]).observe(5.0)
+        _json.dumps(reg.export())
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator merge semantics (injected fetch, no sockets)
+# ---------------------------------------------------------------------------
+
+def _replica_registry(queries: float, lat, gauge_val: float,
+                      hot=None) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("pio_http_requests_total", "req").labels(
+        route="/queries.json", status="200").inc(queries)
+    h = reg.histogram("pio_http_request_duration_seconds", "lat",
+                      bounds=BOUNDS).labels(route="/queries.json")
+    for v in lat:
+        h.observe(v)
+    reg.gauge("pio_inflight_requests", "inflight").set(gauge_val)
+    # a replica-local SLO verdict: must NEVER merge (_MERGE_SKIP)
+    reg.gauge("pio_slo_burn_rate", "local verdict").labels(
+        slo="queries", window="fast").set(9.0)
+    return reg
+
+
+class _Fleet:
+    """Three fake replicas behind an injected fetch. Tests mutate
+    ``self.regs``/``self.status``/``self.traces`` and call
+    ``agg.scrape_cycle()``."""
+
+    def __init__(self, **cfg):
+        self.regs = {
+            "r0": _replica_registry(10, [0.002] * 4, 1.0),
+            "r1": _replica_registry(20, [0.002, 0.5], 2.0),
+            "r2": _replica_registry(30, [5.0], 4.0),
+        }
+        self.status = {n: {"servingWarm": True} for n in self.regs}
+        self.traces = {}          # name → {trace_id: body}
+        self.dead = set()
+        self.agg = FleetAggregator(
+            FleetConfig(replicas=list(self.regs),
+                        slo_interval_sec=0.0, **cfg),
+            fetch=self._fetch)
+
+    def _fetch(self, url, timeout):
+        name = url.split("://", 1)[1].split("/", 1)[0]
+        if name in self.dead:
+            raise OSError(f"{name} is down")
+        path = url.split(name, 1)[1]
+        if path == "/metrics.json":
+            return 200, self.regs[name].export()
+        if path == "/status.json":
+            return 200, self.status[name]
+        if path.startswith("/trace.json?id="):
+            tid = path.split("=", 1)[1]
+            body = self.traces.get(name, {}).get(tid)
+            return (200, body) if body else (404, {"error": "gone"})
+        raise AssertionError(f"unexpected fetch {url}")
+
+    def value(self, family, **labels):
+        fam = self.agg.registry.get(family)
+        assert fam is not None, family
+        want = tuple(sorted(labels.items()))
+        for items, child in fam.children():
+            if items == want:
+                return child
+        raise AssertionError(f"{family}{labels} not in merged registry")
+
+
+class TestFleetAggregator:
+    def test_counters_sum_exactly(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        child = f.value("pio_http_requests_total",
+                        route="/queries.json", status="200")
+        assert child.value == 60.0
+        # quiescent second cycle: delta-based merge adds nothing
+        f.agg.scrape_cycle()
+        assert child.value == 60.0
+
+    def test_counter_reset_compensation(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        # r0 restarts: counter starts over at 4 — the merged series
+        # must absorb the full new value, not a negative delta
+        f.regs["r0"] = _replica_registry(4, [], 1.0)
+        f.agg.scrape_cycle()
+        child = f.value("pio_http_requests_total",
+                        route="/queries.json", status="200")
+        assert child.value == 64.0
+        resets = f.value("pio_fleet_counter_resets_total", replica="r0")
+        assert resets.value >= 1.0
+
+    def test_histograms_merge_to_pooled_population(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        merged = f.value("pio_http_request_duration_seconds",
+                         route="/queries.json")
+        whole = _hist_of([0.002] * 5 + [0.5, 5.0])
+        assert merged.bucket_counts() == whole.bucket_counts()
+        assert merged.quantile(0.99) == whole.quantile(0.99)
+        # growth on one replica arrives as a delta, not a re-count
+        f.regs["r1"].get("pio_http_request_duration_seconds").labels(
+            route="/queries.json").observe(0.002)
+        f.agg.scrape_cycle()
+        assert merged.count == whole.count + 1
+
+    def test_histogram_reset_keeps_merged_monotone(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        merged = f.value("pio_http_request_duration_seconds",
+                         route="/queries.json")
+        before = merged.count
+        f.regs["r2"] = _replica_registry(1, [0.01, 0.01], 4.0)
+        f.agg.scrape_cycle()
+        assert merged.count == before + 2
+
+    def test_gauges_get_replica_labels_and_rollups(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        assert f.value("pio_inflight_requests", replica="r1").value == 2.0
+        assert f.value("pio_inflight_requests", agg="min").value == 1.0
+        assert f.value("pio_inflight_requests", agg="max").value == 4.0
+        assert f.value("pio_inflight_requests", agg="sum").value == 7.0
+
+    def test_slo_families_never_merge(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        assert f.agg.registry.get("pio_slo_burn_rate") is None
+
+    def test_down_replica_leaves_rollups_and_up_gauge(self):
+        f = _Fleet(stale_after_sec=0.01)
+        f.agg.scrape_cycle()
+        f.dead.add("r2")
+        time.sleep(0.03)
+        f.agg.scrape_cycle()
+        assert f.value("pio_fleet_replica_up", replica="r2").value == 0.0
+        assert f.value("pio_fleet_replica_up", replica="r0").value == 1.0
+        assert f.value("pio_inflight_requests", agg="sum").value == 3.0
+        assert f.value("pio_inflight_requests", agg="max").value == 2.0
+        status = f.agg.fleet_status()
+        assert status["replicasUp"] == 2
+        by_name = {r["replica"]: r for r in status["replicas"]}
+        assert by_name["r2"]["up"] is False
+        assert by_name["r2"]["lastError"]
+
+    def test_merged_exposition_is_valid(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        validate_exposition(f.agg.registry.render())
+
+    def test_hot_keys_union_over_live_replicas(self):
+        f = _Fleet()
+        f.status["r0"]["hotKeys"] = {
+            "capacity": 8, "total": 10.0,
+            "top": [{"key": "u1", "count": 7.0, "error": 0.0},
+                    {"key": "u2", "count": 3.0, "error": 0.0}]}
+        f.status["r1"]["hotKeys"] = {
+            "capacity": 8, "total": 5.0,
+            "top": [{"key": "u1", "count": 5.0, "error": 0.0}]}
+        f.agg.scrape_cycle()
+        top = {t["key"]: t["count"] for t in f.agg.hot.top()}
+        assert top == {"u1": 12.0, "u2": 3.0}
+        assert f.agg.hot.total == 15.0
+        # rebuilt (not accumulated) each cycle: cumulative replica
+        # sketches must not double-count
+        f.agg.scrape_cycle()
+        assert f.agg.hot.total == 15.0
+
+    def test_trace_fanout_finds_the_holding_replica(self):
+        f = _Fleet()
+        f.traces["r1"] = {"feed" * 8: {"traceEvents": [{"name": "q"}]}}
+        found = f.agg.trace_lookup("feed" * 8)
+        assert found["replica"] == "r1"
+        assert found["trace"]["traceEvents"]
+
+    def test_trace_fanout_404s_when_nowhere(self):
+        f = _Fleet()
+        with pytest.raises(HTTPError) as err:
+            f.agg.trace_lookup("dead" * 8)
+        assert err.value.status == 404
+
+    def test_trace_fanout_survives_a_dead_replica(self):
+        f = _Fleet()
+        f.dead.add("r0")
+        f.traces["r2"] = {"beef" * 8: {"traceEvents": []}}
+        assert f.agg.trace_lookup("beef" * 8)["replica"] == "r2"
+
+    def test_needs_at_least_one_replica(self):
+        with pytest.raises(ValueError):
+            FleetAggregator(FleetConfig(replicas=[]))

@@ -508,6 +508,103 @@ class TestEngineServerPhases:
 
 
 # ---------------------------------------------------------------------------
+# process self-telemetry + scrape self-cost (ISSUE 17 satellites)
+# ---------------------------------------------------------------------------
+
+class TestProcessMetrics:
+    def test_process_stats_sane(self):
+        from predictionio_tpu.obs import process_stats
+
+        st = process_stats()
+        if not st:
+            pytest.skip("/proc not readable on this platform")
+        assert st["rss_bytes"] > (1 << 20)
+        assert st["cpu_seconds_total"] > 0.0
+        assert st["open_fds"] >= 3
+        assert st["threads"] >= 1
+
+    def test_process_gauges_render(self):
+        from predictionio_tpu.obs import (
+            process_stats,
+            register_process_metrics,
+        )
+
+        reg = MetricsRegistry()
+        register_process_metrics(reg)
+        if not process_stats():
+            return  # no-op registration off Linux: nothing to assert
+        text = reg.render()
+        validate_exposition(text)
+        for name in ("pio_process_rss_bytes",
+                     "pio_process_cpu_seconds_total",
+                     "pio_process_open_fds", "pio_process_threads"):
+            assert re.search(rf"^{name} [0-9.e+]+$", text,
+                             re.MULTILINE), name
+
+
+class TestScrapeSelfCost:
+    def test_10k_series_render_under_budget(self):
+        # the scrape self-cost guard (ISSUE 17): a registry an order
+        # of magnitude wider than the engine server's must still
+        # render in a small fraction of the fleet scrape interval —
+        # rendering itself must never be the serving regression
+        import time as _time
+
+        reg = MetricsRegistry()
+        wide = reg.gauge("t_wide_series", "one child per shard")
+        for i in range(10_000):
+            wide.labels(shard=str(i)).set(float(i))
+        t0 = _time.perf_counter()
+        text = reg.render()
+        elapsed = _time.perf_counter() - t0
+        assert text.count("\n") >= 10_000
+        assert elapsed < 2.0, f"10k-series render took {elapsed:.2f}s"
+        t0 = _time.perf_counter()
+        reg.export()
+        assert _time.perf_counter() - t0 < 2.0
+
+    def test_render_seconds_histogram_on_metrics_routes(self):
+        # every /metrics(.json) render observes its own wall time, by
+        # format — the self-cost series the fleet plane watches
+        qs, srv = _deploy_synthetic(batching=False)
+        try:
+            status, text, _ = _call(srv.port, "GET", "/metrics")
+            assert status == 200
+            # a render observes itself AFTER snapshotting, so the
+            # first JSON scrape can't contain its own timing — read
+            # the second
+            _call(srv.port, "GET", "/metrics.json")
+            status, export, _ = _call(srv.port, "GET", "/metrics.json")
+            assert status == 200
+            fam = export["pio_metrics_render_seconds"]
+            assert fam["kind"] == "histogram"
+            by_format = {c["labels"]["format"]: c["count"]
+                         for c in fam["children"]}
+            assert by_format.get("text", 0) >= 1
+            assert by_format.get("json", 0) >= 1
+        finally:
+            srv.shutdown()
+
+    def test_metrics_json_export_matches_text_exposition(self):
+        qs, srv = _deploy_synthetic(batching=False)
+        try:
+            _call(srv.port, "POST", "/queries.json",
+                  {"user": "u1", "num": 2})
+            status, export, _ = _call(srv.port, "GET", "/metrics.json")
+            assert status == 200
+            lat = export["pio_query_latency_seconds"]["children"][0]
+            assert lat["count"] == 1
+            assert lat["buckets"][-1][0] == "+Inf"
+            assert lat["buckets"][-1][1] == 1
+            # counters carry plain values
+            total = export["pio_http_requests_total"]["children"]
+            assert any(c["labels"].get("route") == "/queries.json"
+                       and c["value"] >= 1 for c in total)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # event + storage server exposition
 # ---------------------------------------------------------------------------
 
