@@ -1,0 +1,328 @@
+"""Autoscaling smoke (ISSUE 18) — the CI gate for the router +
+replica-lifecycle + autoscaler control loop.
+
+End-to-end over REAL HTTP on whatever device is available (CI: CPU):
+three live engine-server replicas behind the entity-affinity
+:class:`QueryRouter`, a :class:`FleetAggregator` scraping them against
+a committed-knee capacity model, and the :class:`Autoscaler` closing
+the loop through a :class:`ReplicaLifecycle`:
+
+1. **10x open-loop ramp** — offered load steps from the baseline to
+   10x; fleet headroom crosses the policy floor and the autoscaler
+   must scale OUT (decision logged, new replica warm-gated into the
+   ring) while every committed SLO stays green;
+2. **chaos drill** — mid-ramp one original replica is transport-killed
+   at the PR 11 fault point (``router.forward``) and then actually
+   shut down: the router must shed to survivors with ZERO failed
+   in-deadline queries, and the autoscaler's heal pass must replace
+   the corpse (a ``replace`` decision, outside the cooldown);
+3. **scale-in without flap** — after the ramp returns to baseline,
+   sustained headroom over the ceiling must bring the fleet back to
+   ``min_replicas`` and then HOLD: no scale-out/scale-in oscillation
+   for several cooldown windows.
+
+The full decision log is written to ``autoscale_decisions.json``
+(override with ``AUTOSCALE_DECISIONS_PATH``) and uploaded as a CI
+artifact. Prints one JSON line; exits non-zero when any check fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _loadgen import (  # noqa: E402
+    expect_json_field,
+    json_post_sender,
+    run_load,
+    sample_entities,
+)
+
+SPEC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "slo", "specs", "ci.json")
+
+N_USERS = 48
+ROUTE = "/queries.json"
+BASE_QPS = 4.0
+RAMP_QPS = 40.0            # the 10x step
+#: committed single-replica knee: at 3 replicas the ramp sits well
+#: past floor (1 - 40/36 < 0.15) and the baseline well past ceiling
+#: (1 - 4/36 > 0.60), so both directions trigger deterministically
+KNEE_QPS = 12.0
+RAMP_SEC = 14.0
+KILL_AFTER_SEC = 4.0
+SETTLE_SEC = 14.0          # scale-in + flap watch after the ramp
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _load(port: int, rate: float, seconds: float, seed: int,
+          stats_sink: list, threads: int = 6) -> threading.Thread:
+    """Open-loop Zipf-skewed query load through the ROUTER for a fixed
+    duration; the LoadStats lands in ``stats_sink`` for the
+    zero-failures check."""
+    rng = np.random.default_rng(seed)
+    n = int(rate * seconds)
+    users = sample_entities(rng, N_USERS, n, zipf=1.5)
+    sender = json_post_sender(
+        port, ROUTE,
+        body_fn=lambda k: json.dumps({"user": f"u{users[k]}",
+                                      "num": 5}).encode(),
+        check=expect_json_field("itemScores"))
+
+    def run() -> None:
+        stats, wall = run_load(sender, n, threads, rate_qps=rate)
+        stats_sink.append((stats, wall))
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"autoscale-load-{seed}")
+    t.start()
+    return t
+
+
+def _await(predicate, timeout_s: float, poll: float = 0.25) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return bool(predicate())
+
+
+def main() -> int:
+    from predictionio_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+
+    from predictionio_tpu import faults
+    from predictionio_tpu.fleet import FleetConfig, create_fleet_server
+    from predictionio_tpu.router import (
+        Autoscaler,
+        AutoscalePolicy,
+        QueryRouter,
+        ReplicaLifecycle,
+        RouterConfig,
+        create_router_server,
+    )
+    from predictionio_tpu.server.engineserver import ServerConfig
+    from serving_bench import _boot_server, _wait_warm, synth_model
+
+    model = synth_model(N_USERS, 64, 8, device=False)
+    cfg = ServerConfig(batching=True, max_batch=16,
+                       batch_window_ms=2.0, queue_deadline_ms=10_000.0)
+
+    def _safe_stop(qs, srv):
+        def stop() -> None:
+            try:
+                qs.stop_slo()
+                srv.shutdown()
+            except Exception:   # double-stop after the chaos kill
+                pass
+        return stop
+
+    replicas = [_boot_server(model, cfg) for _ in range(3)]
+    names = [f"127.0.0.1:{srv.port}" for _qs, srv in replicas]
+
+    capacity_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        ".autoscale_capacity.json")
+    with open(capacity_path, "w", encoding="utf-8") as f:
+        json.dump({"configs": {"router": {"knee_qps": KNEE_QPS}}}, f)
+
+    agg, fleet_srv = create_fleet_server(
+        FleetConfig(replicas=names, scrape_interval_sec=0.25,
+                    stale_after_sec=1.5, slo_specs=SPEC_PATH,
+                    slo_interval_sec=0.2, capacity_path=capacity_path),
+        host="127.0.0.1", port=0)
+    fleet_srv.start_background()
+
+    router = QueryRouter(RouterConfig(retries=1, eject_failures=2,
+                                      eject_sec=2.0),
+                         registry=agg.registry)
+    router_srv = create_router_server(router, host="127.0.0.1", port=0)
+    router_srv.start_background()
+    agg.attach_router(router)
+    router.set_health(lambda name: {"up": True, "down": False}.get(
+        agg.replica_health(name)))
+
+    def spawn():
+        qs, srv = _boot_server(model, cfg)
+        replicas.append((qs, srv))
+        return f"127.0.0.1:{srv.port}", _safe_stop(qs, srv)
+
+    lifecycle = ReplicaLifecycle(spawn, router=router, aggregator=agg,
+                                 registry=agg.registry,
+                                 drain_deadline_sec=15.0)
+    policy = AutoscalePolicy(min_replicas=3, max_replicas=5,
+                             headroom_floor=0.15, headroom_ceiling=0.60,
+                             scale_in_sustain_sec=2.0, cooldown_sec=2.0,
+                             interval_sec=0.5)
+    autoscaler = Autoscaler(agg, lifecycle, policy,
+                            registry=agg.registry)
+    agg.attach_autoscaler(autoscaler)
+
+    checks: dict = {}
+    out: dict = {"bench": "autoscale_smoke", "replicas": names,
+                 "kneeQps": KNEE_QPS, "baseQps": BASE_QPS,
+                 "rampQps": RAMP_QPS}
+    stats_sink: list = []
+    corpse = names[1]
+    try:
+        for i, (_qs, srv) in enumerate(replicas):
+            _wait_warm(srv.port, f"autoscale_smoke replica {i}")
+        for name, (qs, srv) in zip(names, replicas):
+            lifecycle.adopt(name, stop_fn=_safe_stop(qs, srv))
+        checks["replicas_adopted"] = lifecycle.count("ready") == 3
+        autoscaler.start()
+        checks["replicas_up"] = _await(
+            lambda: _get(fleet_srv.port, "/fleet.json")[
+                "replicasUp"] == 3, 15.0)
+
+        # baseline: min_replicas pins the fleet — sustained high
+        # headroom at 3 replicas must NOT scale below the floor count
+        base_t = _load(router_srv.port, BASE_QPS, 5.0, seed=3,
+                       stats_sink=stats_sink)
+        base_t.join(timeout=60)
+        checks["baseline_holds_min"] = lifecycle.live_count() == 3
+
+        # the 10x ramp, with a chaos kill mid-ramp: transport fault
+        # at the router's PR 11 point + a REAL shutdown of the corpse
+        ramp_t = _load(router_srv.port, RAMP_QPS, RAMP_SEC, seed=5,
+                       stats_sink=stats_sink)
+
+        def _kill() -> None:
+            faults.inject("router.forward", "error",
+                          match={"replica": corpse})
+            qs, srv = replicas[1]
+            _safe_stop(qs, srv)()
+
+        killer = threading.Timer(KILL_AFTER_SEC, _kill)
+        killer.start()
+        scaled_out = _await(
+            lambda: lifecycle.live_count() > 3, RAMP_SEC + 10.0)
+        ramp_t.join(timeout=120)
+        checks["scale_out_observed"] = scaled_out
+        replaced = _await(
+            lambda: any(d["action"] == "replace" for d in
+                        autoscaler.status()["decisions"]), 20.0)
+        checks["corpse_replaced"] = replaced
+        faults.clear("router.forward")
+
+        # SLOs green through the whole ramp+kill (merged registry);
+        # specs whose traffic lane this smoke doesn't drive (stream
+        # freshness) sit in insufficient_data, which is not a breach
+        specs = _get(fleet_srv.port, "/slo.json").get("specs") or []
+        out["slo_states"] = {sp["name"]: sp["state"] for sp in specs}
+        checks["slo_green_through_ramp"] = bool(specs) and all(
+            sp["state"] in ("ok", "idle", "insufficient_data")
+            for sp in specs)
+        checks["query_slos_ok"] = all(
+            sp["state"] == "ok" for sp in specs
+            if sp["name"].startswith("queries-"))
+
+        # back to baseline: sustained headroom over the ceiling must
+        # scale the fleet back to min_replicas...
+        settle_t = _load(router_srv.port, BASE_QPS, SETTLE_SEC,
+                         seed=7, stats_sink=stats_sink)
+        scaled_in = _await(
+            lambda: (lifecycle.count("ready") == 3
+                     and lifecycle.live_count() == 3),
+            SETTLE_SEC + 30.0)
+        checks["scale_in_to_min"] = scaled_in
+        decisions = autoscaler.status()["decisions"]
+        checks["scale_in_logged"] = any(
+            d["action"] == "scale_in" for d in decisions)
+        seq_at_min = max((d["seq"] for d in decisions), default=0)
+
+        # ...and then HOLD: several cooldown windows with no policy
+        # action in either direction is the no-flap proof
+        time.sleep(3 * (policy.cooldown_sec
+                        + policy.scale_in_sustain_sec) / 2)
+        settle_t.join(timeout=60)
+        late = [d for d in autoscaler.status()["decisions"]
+                if d["seq"] > seq_at_min
+                and d["action"] in ("scale_out", "scale_in")]
+        out["late_actions"] = late
+        checks["no_flap_after_settle"] = not late
+        checks["fleet_back_to_min"] = lifecycle.live_count() == 3
+        checks["corpse_not_a_member"] = corpse not in router.members()
+
+        # zero failed in-deadline queries across baseline + ramp +
+        # kill + settle — the router shed every one to a survivor
+        errors = [e for stats, _w in stats_sink
+                  for e in stats.errors]
+        sent = sum(len(stats.lat) + len(stats.shed)
+                   for stats, _w in stats_sink)
+        out["queries_ok"] = sent
+        out["first_errors"] = errors[:3]
+        checks["zero_failed_queries"] = sent > 0 and not errors
+
+        # decisions visible on /fleet.json, series on /metrics
+        fleet = _get(fleet_srv.port, "/fleet.json")
+        auto = fleet.get("autoscale") or {}
+        checks["decisions_on_fleet_json"] = bool(auto.get("decisions"))
+        # the removed log is INTENTIONAL exits only (`ptpu fleet
+        # status` exit-code source): scale-in victims belong there,
+        # the chaos corpse must NOT — it died, it wasn't removed
+        removed = auto.get("removed") or []
+        out["removed"] = removed
+        checks["scale_in_exits_tracked"] = (
+            len(removed) >= 1 and corpse not in removed)
+        metrics_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{fleet_srv.port}/metrics",
+            timeout=30).read().decode()
+        for fam in ("pio_router_requests_total",
+                    "pio_autoscale_decisions_total",
+                    "pio_autoscale_replicas"):
+            checks[f"{fam}_exported"] = any(
+                ln.startswith(fam) for ln in metrics_text.splitlines())
+
+        out["decision_count"] = len(auto.get("decisions") or [])
+        out["routerStatus"] = {
+            "members": len(router.members()),
+            "retries": sum(
+                c.value for _i, c in (agg.registry.get(
+                    "pio_router_retries_total").children()))}
+    finally:
+        faults.clear()
+        autoscaler.stop()
+        log_path = os.environ.get("AUTOSCALE_DECISIONS_PATH",
+                                  "autoscale_decisions.json")
+        try:
+            with open(log_path, "w", encoding="utf-8") as f:
+                json.dump({"policy": autoscaler.status()["policy"],
+                           "decisions": autoscaler.status()["decisions"],
+                           "removed": autoscaler.status()["removed"]},
+                          f, indent=2)
+        except OSError:
+            pass
+        lifecycle.close(stop_replicas=True)
+        router_srv.shutdown()
+        agg.stop()
+        fleet_srv.shutdown()
+        try:
+            os.remove(capacity_path)
+        except OSError:
+            pass
+    ok = all(bool(v) for v in checks.values())
+    print(json.dumps({"ok": ok, **out, **checks}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,12 @@ Usage: python benchmarks/load_harness.py
 
 ``--ci`` picks small, runner-friendly defaults (the CI capacity-gate
 step). Configs: host | staged | serial | cached | replicated |
-sharded | quantized (mesh configs skip themselves on one device).
+sharded | quantized | router (mesh configs skip themselves on one
+device). The ``router`` config (ISSUE 18) boots TWO engine-server
+replicas behind the entity-affinity :class:`QueryRouter` and drives
+every query lane through the router's HTTP front — the frontier then
+prices the router hop and the CAPACITY.json row feeds the
+autoscaler's knee model.
 
 ``--endpoints`` (ISSUE 17) switches to **external-fleet mode**: no
 local stack is booted — the query lane sprays round-robin across the
@@ -130,6 +135,10 @@ def _server_config(name: str, app_name: str, step_sec: float):
                         batch_window_ms=2.0, serving_mode="sharded"),
         "quantized": dict(batching=True, max_batch=64,
                           batch_window_ms=2.0, serving_quant="int8"),
+        # per-replica config behind the entity-affinity router; the
+        # router itself is wired up in Stack
+        "router": dict(batching=True, max_batch=64,
+                       batch_window_ms=2.0),
     }
     if name not in table:
         raise SystemExit(f"unknown config {name!r} "
@@ -183,14 +192,48 @@ class Stack:
                   engine_factory="templates.recommendation")
         inst = get_latest_completed(ctx, engine_id=app_name)
         models = load_models_for_deploy(ctx, engine, inst, ep)
+        server_cfg = _server_config(cfg_name, app_name, step_sec)
         self.qs = QueryServer(
-            ctx, engine, ep, models, inst,
-            _server_config(cfg_name, app_name, step_sec))
+            ctx, engine, ep, models, inst, server_cfg)
         self.ev_srv = AppServer(build_event_app(storage), "127.0.0.1",
                                 0).start_background()
         self.en_srv = create_engine_server(
             self.qs, "127.0.0.1", 0).start_background()
         self._wait_warm()
+        # the router config serves through a QueryRouter in front of
+        # TWO replicas (each with its own streaming consumer cursor,
+        # so fold-ins land on both) — the query lane prices the
+        # router hop, spill, and retry machinery end to end
+        self.extra: list = []
+        self.router = None
+        self.router_srv = None
+        self.query_port = self.en_srv.port
+        if cfg_name == "router":
+            import dataclasses
+
+            from predictionio_tpu.router import (
+                QueryRouter,
+                RouterConfig,
+                create_router_server,
+            )
+
+            cfg2 = dataclasses.replace(
+                server_cfg,
+                stream_consumer=f"{server_cfg.stream_consumer}-r1")
+            qs2 = QueryServer(
+                ctx, engine, ep,
+                load_models_for_deploy(ctx, engine, inst, ep),
+                inst, cfg2)
+            srv2 = create_engine_server(
+                qs2, "127.0.0.1", 0).start_background()
+            self.extra.append((qs2, srv2))
+            self._wait_warm(srv2.port)
+            self.router = QueryRouter(RouterConfig(retries=1))
+            for port in (self.en_srv.port, srv2.port):
+                self.router.add(f"127.0.0.1:{port}")
+            self.router_srv = create_router_server(
+                self.router, "127.0.0.1", 0).start_background()
+            self.query_port = self.router_srv.port
         self.canary = False
         if canary_fraction > 0:
             # a held-open canary ramp rides along: a cohort fraction
@@ -213,11 +256,15 @@ class Stack:
             self.qs._candidate.warm_done.wait(timeout=300)
             self.canary = True
 
-    def _wait_warm(self) -> None:
+    def _wait_warm(self, port: int = 0) -> None:
+        port = port or self.en_srv.port
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
-            if self.status().get("servingWarm"):
-                return
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status.json",
+                    timeout=30) as resp:
+                if json.loads(resp.read()).get("servingWarm"):
+                    return
             time.sleep(0.2)
         raise RuntimeError("serving warmup did not finish")
 
@@ -234,6 +281,12 @@ class Stack:
             return json.loads(resp.read())
 
     def shutdown(self) -> None:
+        if self.router_srv is not None:
+            self.router_srv.shutdown()
+        for qs, srv in self.extra:
+            qs.stop_stream()
+            qs.stop_slo()
+            srv.shutdown()
         self.qs.stop_stream()
         self.qs.stop_slo()
         self.en_srv.shutdown()
@@ -268,7 +321,7 @@ def _ingest_sender(stack: Stack, tag: str):
 
 def _query_sender(stack: Stack, users: np.ndarray):
     return json_post_sender(
-        stack.en_srv.port, "/queries.json",
+        stack.query_port, "/queries.json",
         body_fn=lambda k: json.dumps({"user": f"u{users[k]}",
                                       "num": 5}).encode(),
         check=expect_json_field("itemScores"), shed_status=(503,))
@@ -364,7 +417,7 @@ def _freshness_under_load(stack: Stack, tag: str, rate: float,
             while time.monotonic() < deadline:
                 q = json.dumps({"user": user, "num": 5}).encode()
                 req = urllib.request.Request(
-                    f"http://127.0.0.1:{stack.en_srv.port}"
+                    f"http://127.0.0.1:{stack.query_port}"
                     f"/queries.json", data=q,
                     headers={"Content-Type": "application/json"})
                 try:
@@ -432,6 +485,13 @@ def measure_config(cfg_name: str, rates, step_sec: float, zipf,
                                            ).get("p99_ms")
             out["freshness_under_load_ms"] = fresh.get("p50_ms")
             out["freshness"] = fresh
+        if stack.router is not None:
+            rs = stack.router.status()
+            out["router"] = {
+                "replicas": len(stack.router.members()),
+                "vnodes": rs["ring"]["vnodes"],
+                "retries": rs["retries"],
+            }
         status = stack.status()
         overlap = (status.get("pipeline") or {}).get("overlap") or {}
         out["device_idle_fraction"] = overlap.get("deviceIdleFraction")
@@ -571,7 +631,7 @@ def main() -> int:
         argv.remove("--ci")
     endpoints = flag("--endpoints", "", str)
     configs = flag("--configs",
-                   "host,staged,cached", str)
+                   "host,staged,cached,router", str)
     rate_min = flag("--rate-min", 8.0)
     rate_max = flag("--rate-max", 64.0 if ci else 128.0)
     step_sec = flag("--step-sec", 3.0 if ci else 4.0)

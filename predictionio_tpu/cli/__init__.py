@@ -345,25 +345,40 @@ def cmd_deploy(args, storage: Storage) -> int:
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     scheme = "https" if ssl_ctx else "http"
     if args.fleet_of > 1:
-        # fleet deploy (ISSUE 17, docs/fleet.md): N replicas on
-        # consecutive ports, each a full engine server, fronted by the
-        # fleet aggregator (merged metrics, fleet SLO, cross-replica
-        # traces). The aggregator holds the foreground; replicas run
-        # in background threads of this process.
+        # fleet deploy (ISSUE 17 + 18, docs/fleet.md,
+        # docs/autoscaling.md): N replicas on consecutive ports, each
+        # a full engine server, fronted by the entity-affinity query
+        # router AND the fleet aggregator (merged metrics, fleet SLO,
+        # cross-replica traces). With --autoscale, the replica
+        # lifecycle manager + control loop grow/shrink the fleet
+        # between --min-replicas and --max-replicas. The aggregator
+        # holds the foreground; everything else runs in background
+        # threads of this process.
         from ..fleet import FleetConfig, create_fleet_server
+        from ..router import (
+            Autoscaler,
+            AutoscalePolicy,
+            QueryRouter,
+            ReplicaLifecycle,
+            RouterConfig,
+            create_router_server,
+        )
 
-        servers = []
-        for i in range(args.fleet_of):
-            servers.append(deploy(
+        def _boot_replica(port: int):
+            srv = deploy(
                 ctx, engine, engine_params,
                 engine_id=args.engine_id or variant.get("id", "default"),
                 engine_version=(args.engine_version
                                 or variant.get("version", "1")),
                 engine_variant=args.engine_json,
-                config=config, host=args.ip, port=args.port + i,
-                ssl_context=ssl_ctx))
-        for srv in servers:
+                config=config, host=args.ip, port=port,
+                ssl_context=ssl_ctx)
             srv.start_background()
+            return srv
+
+        servers = [_boot_replica(args.port + i)
+                   for i in range(args.fleet_of)]
+        for srv in servers:
             _out(f"Replica live at {scheme}://{args.ip}:{srv.port}.")
         fleet_cfg = FleetConfig(
             replicas=[f"{scheme}://127.0.0.1:{srv.port}"
@@ -371,17 +386,64 @@ def cmd_deploy(args, storage: Storage) -> int:
             scrape_interval_sec=args.fleet_scrape_interval_ms / 1000.0,
             slo_specs=args.slo_specs or None,
             slo_interval_sec=args.slo_interval_ms / 1000.0,
+            capacity_path=args.capacity or None,
             accesskey=args.accesskey or None)
         agg, fleet_srv = create_fleet_server(
             fleet_cfg, host=args.ip, port=args.fleet_port,
             ssl_context=ssl_ctx)
+        # the router registers its pio_router_* families on the
+        # aggregator's registry so they ride the fleet /metrics
+        # alongside the merged replica series and pio_autoscale_*
+        router = QueryRouter(
+            RouterConfig(accesskey=args.accesskey or None),
+            registry=agg.registry)
+        router_srv = create_router_server(router, host=args.ip,
+                                          port=args.router_port,
+                                          ssl_context=ssl_ctx)
+        router_srv.start_background()
+        agg.attach_router(router)
+        # the aggregator's liveness view vetoes routing candidates;
+        # "unknown"/"absent" (not yet scraped) is no opinion, so a
+        # fresh replica isn't vetoed during its first scrape window
+        router.set_health(
+            lambda name: {"up": True, "down": False}.get(
+                agg.replica_health(name)))
+        lifecycle = ReplicaLifecycle(
+            spawn=lambda: ((lambda srv:
+                            (f"{scheme}://127.0.0.1:{srv.port}",
+                             srv.shutdown))(_boot_replica(0))),
+            router=router, aggregator=agg,
+            registry=agg.registry,
+            accesskey=args.accesskey or None)
+        for srv in servers:
+            lifecycle.adopt(f"{scheme}://127.0.0.1:{srv.port}",
+                            stop_fn=srv.shutdown)
+        autoscaler = None
+        if args.autoscale:
+            autoscaler = Autoscaler(
+                agg, lifecycle,
+                AutoscalePolicy(min_replicas=args.min_replicas,
+                                max_replicas=args.max_replicas),
+                registry=agg.registry).start()
+            agg.attach_autoscaler(autoscaler)
+            _out(f"Autoscaler running: {args.min_replicas}-"
+                 f"{args.max_replicas} replicas, knee model "
+                 f"{'loaded' if agg.capacity_signals()['kneeQps'] else 'ABSENT'}.")
+        _out(f"Query router live at "
+             f"{scheme}://{args.ip}:{router_srv.port} — send "
+             f"/queries.json here (entity-affinity + retry + spill).")
         _out(f"Fleet aggregator live at "
              f"{scheme}://{args.ip}:{fleet_srv.port} — merged "
-             f"/metrics, /fleet.json, /trace.json, /hotkeys.json.")
+             f"/metrics, /fleet.json, /route.json, /trace.json, "
+             f"/hotkeys.json.")
         try:
             fleet_srv.serve_forever()
         except KeyboardInterrupt:
             _out("Shutting down.")
+            if autoscaler is not None:
+                autoscaler.stop()
+            lifecycle.close(stop_replicas=True)
+            router_srv.shutdown()
             agg.stop()
         return 0
     server = deploy(
@@ -1200,7 +1262,11 @@ def cmd_fleet(args) -> int:
       fans out to every replica and exports the hit, ``--slowest N``
       merges fleet-wide;
     - ``hotkeys`` — the fleet-wide Space-Saving top-K (and each
-      replica's own view).
+      replica's own view);
+    - ``route`` — the query router's view (ISSUE 18): ring
+      membership, per-backend state, where a ``--key`` would land;
+    - ``scale`` — hand the autoscaler a manual replica-count target
+      (clamped to its policy bounds, logged in the decision log).
 
     Pure HTTP: needs neither storage nor jax.
     """
@@ -1244,6 +1310,20 @@ def cmd_fleet(args) -> int:
         elif args.fleet_command == "hotkeys":
             payload = _server_call(
                 args, f"/hotkeys.json?n={args.top}") or {}
+        elif args.fleet_command == "route":
+            import urllib.parse as _up
+
+            path = "/route.json"
+            if args.key:
+                path += "?key=" + _up.quote(args.key)
+            payload = _server_call(args, path) or {}
+        elif args.fleet_command == "scale":
+            import urllib.parse as _up
+
+            path = f"/scale?to={int(args.to)}"
+            if args.reason:
+                path += "&reason=" + _up.quote(args.reason)
+            payload = _server_call(args, path, method="POST") or {}
         else:  # trace
             if args.id:
                 payload = _server_call(args,
@@ -1258,10 +1338,25 @@ def cmd_fleet(args) -> int:
              f"{_http_err_detail(e)}")
         return 1
     if args.fleet_command == "status":
+        # the autoscaler's decision log tells an INTENTIONAL exit
+        # (scale-in terminate) from a corpse: a replica it removed —
+        # or one mid-drain — is not a failure and must not flip the
+        # exit code (ISSUE 18 satellite)
+        autoscale = payload.get("autoscale") or {}
+        removed = set(autoscale.get("removed") or [])
         down = 0
         for r in payload.get("replicas") or []:
             up = r.get("up")
-            down += 0 if up else 1
+            lifecycle = r.get("lifecycle")
+            if up:
+                state = ("draining" if lifecycle == "draining"
+                         else "up")
+            elif (r.get("replica") in removed
+                  or lifecycle == "draining"):
+                state = "removed"   # scale-in, not an outage
+            else:
+                state = "DOWN"
+                down += 1
             flags = []
             if r.get("degraded"):
                 flags.append("DEGRADED")
@@ -1271,7 +1366,7 @@ def cmd_fleet(args) -> int:
                 flags.append("burning:" + ",".join(r["sloBurning"]))
             age = r.get("lastScrapeAgeSec")
             _out(f"{r.get('replica', '?'):<24} "
-                 f"{'up' if up else 'DOWN':<5} "
+                 f"{state:<9} "
                  f"age {age if age is not None else '?':>7}s  "
                  f"requests {r.get('requestCount') or 0:>8}  "
                  f"{' '.join(flags)}")
@@ -1284,6 +1379,14 @@ def cmd_fleet(args) -> int:
              + (f", fleet SLO BURNING: {', '.join(burning)}"
                 if burning else ", fleet SLO ok")
              + f" ({payload.get('cycles', 0)} scrape cycles)")
+        if autoscale.get("enabled"):
+            decisions = autoscale.get("decisions") or []
+            last = decisions[-1] if decisions else {}
+            _out(f"autoscale: target {autoscale.get('target')}, "
+                 f"{len(removed)} scaled-in, last decision "
+                 f"{last.get('action', 'none')}"
+                 + (f" ({last.get('reason')})"
+                    if last.get("reason") else ""))
         return 1 if (down or burning) else 0
     if args.fleet_command == "hotkeys":
         for k in payload.get("fleet") or []:
@@ -1292,6 +1395,28 @@ def cmd_fleet(args) -> int:
         if not payload.get("fleet"):
             _out("No hot keys observed yet (the sketch fills from "
                  "query-path entity ids).")
+        return 0
+    if args.fleet_command == "route":
+        for b in payload.get("replicas") or []:
+            _out(f"{b.get('replica', '?'):<24} "
+                 f"{b.get('state', '?'):<9} "
+                 f"inflight {b.get('inflight', 0):>4}  "
+                 f"requests {b.get('requests', 0):>8}  "
+                 f"failures {b.get('consecutiveFailures', 0)}")
+        if args.key:
+            _out(f"key {args.key!r} → {payload.get('affinity')} "
+                 f"(preference: "
+                 f"{', '.join(payload.get('preference') or [])})")
+        ring = payload.get("ring") or {}
+        _out(f"{len(payload.get('replicas') or [])} backend(s), "
+             f"{ring.get('vnodes', '?')} vnodes each; retries "
+             f"{payload.get('retries')}; spill "
+             f"{(payload.get('spill') or {}).get('share')}")
+        return 0
+    if args.fleet_command == "scale":
+        _out(f"requested {payload.get('requested')} → target "
+             f"{payload.get('target')} (clamped to policy bounds); "
+             f"the control loop converges on its next tick.")
         return 0
     # trace
     if args.id:
@@ -1997,6 +2122,24 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--fleet-scrape-interval-ms", type=float,
                    default=5000.0,
                    help="aggregator scrape cadence over the replicas")
+    s.add_argument("--router-port", type=int, default=8100,
+                   help="port the entity-affinity query router "
+                        "listens on (--fleet-of > 1; "
+                        "docs/autoscaling.md). Clients send "
+                        "/queries.json here instead of to a replica")
+    s.add_argument("--autoscale", action="store_true",
+                   help="run the SLO-driven autoscaler: scale out on "
+                        "fast-window burn or low capacity headroom, "
+                        "in against the CAPACITY.json knee with "
+                        "hysteresis + cooldown (docs/autoscaling.md)")
+    s.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscaler floor (--autoscale)")
+    s.add_argument("--max-replicas", type=int, default=8,
+                   help="autoscaler ceiling (--autoscale)")
+    s.add_argument("--capacity", default="",
+                   help="CAPACITY.json for the fleet headroom gauge "
+                        "and the autoscaler's knee model "
+                        "(benchmarks/load_harness.py output)")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")
@@ -2188,10 +2331,16 @@ def build_parser() -> argparse.ArgumentParser:
     for name, helptext in (
             ("status", "per-replica liveness/lag/flags + fleet "
                        "headroom (exit 1 on down replicas or a "
-                       "burning fleet SLO)"),
+                       "burning fleet SLO; a replica the autoscaler "
+                       "removed on purpose is NOT down)"),
             ("slo", "fleet SLO burn rates from the merged series"),
             ("trace", "cross-replica flight-recorder lookup"),
-            ("hotkeys", "fleet-wide hot-key top-K")):
+            ("hotkeys", "fleet-wide hot-key top-K"),
+            ("route", "query-router view: ring membership, per-"
+                      "backend state/inflight, hot-key spill "
+                      "(--key shows one entity's placement)"),
+            ("scale", "ask the autoscaler for a replica count "
+                      "(clamped to --min/--max-replicas)")):
         c = fleet_sub.add_parser(name, help=helptext)
         c.add_argument("--ip", default="127.0.0.1")
         c.add_argument("--port", type=int, default=8200)
@@ -2210,6 +2359,15 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "hotkeys":
             c.add_argument("--top", type=int, default=16,
                            help="keys to list")
+        if name == "route":
+            c.add_argument("--key", default="",
+                           help="show where this entity id routes "
+                                "(affinity + preference list)")
+        if name == "scale":
+            c.add_argument("--to", type=int, required=True,
+                           help="desired replica count")
+            c.add_argument("--reason", default="",
+                           help="recorded in the decision log")
 
     s = sub.add_parser("batchpredict", help="bulk predict JSON lines")
     add_engine_flags(s)

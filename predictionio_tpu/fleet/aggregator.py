@@ -155,6 +155,19 @@ class _ReplicaState:
         return (self.last_ok is not None
                 and now - self.last_ok <= stale_after)
 
+    @property
+    def draining(self) -> bool:
+        """The replica announced lifecycle=draining in /status.json:
+        it is finishing in-flight work and will exit — alive, but no
+        longer part of the fleet's capacity."""
+        return (self.status or {}).get("lifecycle") == "draining"
+
+    def serving(self, now: float, stale_after: float) -> bool:
+        """Up AND not draining — the population gauge rollups, the
+        hot-key union, and the headroom denominator are computed
+        over."""
+        return self.up(now, stale_after) and not self.draining
+
 
 class FleetAggregator:
     """Owns the merged registry, the scrape loop, and the fleet SLO
@@ -174,6 +187,14 @@ class FleetAggregator:
         for r in config.replicas:
             name, base = _normalize(r)
             self._states[name] = _ReplicaState(name, base)
+        # merge anchors of replicas that left (scale-in): if the same
+        # name rejoins — a restart on the same port — its counters
+        # resume from the last raw reading instead of re-contributing
+        # their whole lifetime to the merged series
+        self._anchor_tombstones: Dict[str, Tuple[Dict, Dict]] = {}
+        # attached control plane (deploy --autoscale wires these)
+        self.autoscaler = None
+        self.router = None
         # one cycle at a time: the interval loop and POST /scrape must
         # not interleave half-applied deltas
         self._cycle_lock = threading.Lock()
@@ -209,23 +230,27 @@ class FleetAggregator:
             "pio_fleet_replica_up",
             "1 while the replica's last successful scrape is fresher "
             "than the staleness bound")
-        age_fam = reg.gauge(
+        self._age_fam = reg.gauge(
             "pio_fleet_last_scrape_age_seconds",
             "Seconds since the replica last answered a scrape "
             "(monotone-clock read at render time)")
         for st in self._states.values():
-            self._up_gauge.labels(replica=st.name).set(0.0)
-            age_fam.labels(replica=st.name).set_fn(
-                (lambda s: lambda: (time.monotonic() - s.last_ok)
-                 if s.last_ok is not None else -1.0)(st))
-        reg.gauge(
+            self._register_replica_gauges(st)
+        replicas_fam = reg.gauge(
             "pio_fleet_replicas",
-            "Replicas currently up / configured (state=up|configured)"
-        ).labels(state="configured").set(float(len(self._states)))
-        reg.get("pio_fleet_replicas").labels(state="up").set_fn(
+            "Replicas by state (configured|up|draining); membership "
+            "is dynamic under autoscaling, so every child is "
+            "recomputed at render time")
+        replicas_fam.labels(state="configured").set_fn(
+            lambda: float(len(self._states)))
+        replicas_fam.labels(state="up").set_fn(
             lambda: float(sum(
-                1 for s in self._states.values()
+                1 for s in list(self._states.values())
                 if s.up(time.monotonic(), self.config.stale_after))))
+        replicas_fam.labels(state="draining").set_fn(
+            lambda: float(sum(
+                1 for s in list(self._states.values())
+                if s.draining)))
         self._qps_gauge = reg.gauge(
             "pio_fleet_qps",
             "Fleet-wide /queries.json request rate estimated from "
@@ -268,6 +293,87 @@ class FleetAggregator:
                  if isinstance(c, dict) and c.get("knee_qps")]
         return max(knees) if knees else None
 
+    # -- membership ---------------------------------------------------------
+    def _register_replica_gauges(self, st: _ReplicaState) -> None:
+        self._up_gauge.labels(replica=st.name).set(0.0)
+        self._age_fam.labels(replica=st.name).set_fn(
+            (lambda s: lambda: (time.monotonic() - s.last_ok)
+             if s.last_ok is not None else -1.0)(st))
+
+    def add_replica(self, replica: str) -> str:
+        """Join a replica to the scrape set (idempotent); the replica
+        lifecycle manager calls this once a spawn reports warm. A
+        rejoining name reclaims its tombstoned merge anchors so the
+        merged counters don't double-count its pre-restart lifetime."""
+        name, base = _normalize(replica)
+        with self._cycle_lock:
+            if name in self._states:
+                return name
+            st = _ReplicaState(name, base)
+            st.counters, st.hists = self._anchor_tombstones.pop(
+                name, ({}, {}))
+            self._states[name] = st
+            self._register_replica_gauges(st)
+        return name
+
+    def remove_replica(self, replica: str) -> bool:
+        """Remove a replica from the scrape set (scale-in terminate or
+        corpse removal). Its gauge children leave the exposition; its
+        merged counter/histogram contributions stay — monotone
+        history — and its anchors are tombstoned for a possible
+        rejoin."""
+        name = _normalize(replica)[0]
+        with self._cycle_lock:
+            return self._remove_locked(name)
+
+    def _remove_locked(self, name: str) -> bool:
+        st = self._states.pop(name, None)
+        if st is None:
+            return False
+        self._anchor_tombstones[name] = (st.counters, st.hists)
+        for fam in self.registry.families():
+            if fam.kind == "gauge":
+                fam.remove_matching(replica=name)
+        return True
+
+    # -- control-plane signals ----------------------------------------------
+    def capacity_signals(self) -> Dict[str, Any]:
+        """The merged signals one autoscaler tick consumes. Headroom
+        is ``None`` (not the -1 gauge sentinel) when no capacity model
+        is loaded, so the policy can tell "plenty of room" from "no
+        model to reason with"."""
+        headroom = self._headroom_gauge.labels().value
+        return {
+            "qps": self._qps_gauge.labels().value,
+            "kneeQps": self._knee_qps,
+            "headroom": headroom if self._knee_qps else None,
+        }
+
+    def replica_health(self, replica: str) -> str:
+        """``up`` | ``down`` | ``unknown`` | ``absent`` for the heal
+        pass. A member that has never answered a scrape is
+        ``unknown`` — a fresh join mid-warmup, not a corpse — so the
+        autoscaler won't kill what it just spawned."""
+        name = _normalize(replica)[0]
+        st = self._states.get(name)
+        if st is None:
+            return "absent"
+        if st.last_ok is None:
+            return "unknown"
+        return ("up"
+                if st.up(time.monotonic(), self.config.stale_after)
+                else "down")
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Surface an autoscaler's decision log on ``/fleet.json`` and
+        accept ``POST /scale`` requests for it."""
+        self.autoscaler = autoscaler
+
+    def attach_router(self, router) -> None:
+        """Surface a query router's ring/backends on the fleet's
+        ``GET /route.json``."""
+        self.router = router
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "FleetAggregator":
         if self.slo is not None:
@@ -300,8 +406,16 @@ class FleetAggregator:
         ``POST /scrape`` so tests/smokes get quiescent exact state."""
         with self._cycle_lock:
             outcomes: Dict[str, Any] = {}
-            for st in self._states.values():
+            departed: List[str] = []
+            for st in list(self._states.values()):
                 outcomes[st.name] = self._scrape_replica(st)
+                if outcomes[st.name] == "departed":
+                    departed.append(st.name)
+            # a draining replica that stopped answering finished its
+            # drain and exited: expected departure, so it leaves the
+            # membership instead of flapping pio_fleet_replica_up
+            for name in departed:
+                self._remove_locked(name)
             self._rollup_gauges()
             self._merge_hot_keys()
             self._update_capacity()
@@ -332,7 +446,14 @@ class FleetAggregator:
             st.last_err = None
             outcome = "ok"
         except Exception as e:  # noqa: BLE001 — a dead replica is a
-            st.last_err = str(e)  # data point, not a crash
+            if st.draining:       # data point, not a crash
+                # drain completed between scrapes — the silence is the
+                # expected exit, not a failure: no error outcome, no
+                # up-gauge flap, no counter-reset noise when a
+                # successor reuses the port (anchors are tombstoned)
+                st.scrape_sec = time.monotonic() - t0
+                return "departed"
+            st.last_err = str(e)
             outcome = "error"
         st.scrape_sec = time.monotonic() - t0
         self._scrape_hist.labels(replica=st.name).observe(st.scrape_sec)
@@ -437,14 +558,16 @@ class FleetAggregator:
 
     def _rollup_gauges(self) -> None:
         """``agg="min"|"max"|"sum"`` children recomputed over the
-        replicas that are currently up — a down replica's last reading
-        must not pin a rollup forever (its ``replica=``-labeled child
-        DOES keep its last value; check pio_fleet_replica_up)."""
+        replicas that are currently SERVING — a down replica's last
+        reading must not pin a rollup forever, and a draining one is
+        winding down outside the fleet's capacity (its
+        ``replica=``-labeled child DOES keep its last value; check
+        pio_fleet_replica_up / the lifecycle field)."""
         now = time.monotonic()
         stale = self.config.stale_after
         pools: Dict[Tuple[str, Tuple], List[float]] = {}
         for st in self._states.values():
-            if not st.up(now, stale):
+            if not st.serving(now, stale):
                 continue
             for key, v in st.gauges.items():
                 pools.setdefault(key, []).append(v)
@@ -461,7 +584,7 @@ class FleetAggregator:
         now = time.monotonic()
         fresh = SpaceSaving(capacity=self.config.hot_keys_k)
         for st in self._states.values():
-            if not st.up(now, self.config.stale_after):
+            if not st.serving(now, self.config.stale_after):
                 continue
             block = st.status.get("hotKeys") or {}
             fresh.merge_items(block.get("top") or [],
@@ -484,11 +607,14 @@ class FleetAggregator:
                 qps = max(0.0, (total - last_total) / dt)
         self._last_queries = (now, total)
         self._qps_gauge.set(qps)
-        n_up = sum(1 for s in self._states.values()
-                   if s.up(now, self.config.stale_after))
-        if self._knee_qps and n_up:
+        # the denominator is SERVING replicas: a draining replica's
+        # capacity is leaving, and counting it would overstate
+        # headroom exactly when the autoscaler most needs it honest
+        n_serving = sum(1 for s in self._states.values()
+                        if s.serving(now, self.config.stale_after))
+        if self._knee_qps and n_serving:
             self._headroom_gauge.set(
-                1.0 - qps / (self._knee_qps * n_up))
+                1.0 - qps / (self._knee_qps * n_serving))
         else:
             self._headroom_gauge.set(-1.0)
 
@@ -497,7 +623,7 @@ class FleetAggregator:
         now = time.monotonic()
         stale = self.config.stale_after
         out = []
-        for st in self._states.values():
+        for st in list(self._states.values()):
             status = st.status or {}
             degraded = status.get("degraded") or {}
             slo = status.get("slo") or {}
@@ -505,6 +631,7 @@ class FleetAggregator:
                 "replica": st.name,
                 "url": st.base,
                 "up": st.up(now, stale),
+                "lifecycle": status.get("lifecycle"),
                 "lastScrapeAgeSec": (
                     round(now - st.last_ok, 3)
                     if st.last_ok is not None else None),
@@ -522,12 +649,14 @@ class FleetAggregator:
     def fleet_status(self) -> Dict[str, Any]:
         now = time.monotonic()
         stale = self.config.stale_after
-        n_up = sum(1 for s in self._states.values()
-                   if s.up(now, stale))
+        states = list(self._states.values())
+        n_up = sum(1 for s in states if s.up(now, stale))
+        n_draining = sum(1 for s in states if s.draining)
         return {
             "server": "fleet",
-            "replicasConfigured": len(self._states),
+            "replicasConfigured": len(states),
             "replicasUp": n_up,
+            "replicasDraining": n_draining,
             "staleAfterSec": stale,
             "scrapeIntervalSec": self.config.scrape_interval_sec,
             # ptpu: allow[unguarded-shared-state] — display-only read
@@ -541,6 +670,9 @@ class FleetAggregator:
             "slo": (self.slo.status() if self.slo is not None
                     else {"enabled": False}),
             "hotKeys": self.hot.snapshot(),
+            "autoscale": (self.autoscaler.status()
+                          if self.autoscaler is not None
+                          else {"enabled": False}),
         }
 
     # -- trace fan-out ------------------------------------------------------
@@ -550,7 +682,7 @@ class FleetAggregator:
         404s mean "not retained HERE" and fall through; only when no
         replica holds it does the fleet answer 404."""
         errors: Dict[str, str] = {}
-        for st in self._states.values():
+        for st in list(self._states.values()):
             try:
                 code, body = self.fetch(
                     st.base + "/trace.json?id=" + trace_id,
@@ -569,7 +701,7 @@ class FleetAggregator:
         """The fleet's N slowest retained traces: every replica's
         ``?slowest=`` summaries merged and re-sorted by duration."""
         merged: List[Dict[str, Any]] = []
-        for st in self._states.values():
+        for st in list(self._states.values()):
             try:
                 code, body = self.fetch(
                     st.base + f"/trace.json?slowest={n}",
@@ -588,7 +720,7 @@ class FleetAggregator:
 
     def trace_status(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
-        for st in self._states.values():
+        for st in list(self._states.values()):
             try:
                 code, body = self.fetch(st.base + "/trace.json",
                                         self.config.timeout_sec)
@@ -668,6 +800,47 @@ def build_fleet_app(agg: FleetAggregator) -> HTTPApp:
         _auth(req)
         return json_response({"outcomes": agg.scrape_cycle(),
                               "cycles": agg._cycles})
+
+    @app.route("POST", "/scale")
+    def scale(req: Request) -> Response:
+        _auth(req)
+        if agg.autoscaler is None:
+            raise HTTPError(
+                404, "no autoscaler is attached to this fleet "
+                     "(deploy with --autoscale)")
+        to = req.query.get("to")
+        reason = req.query.get("reason", "")
+        if to is None and req.body:
+            body = req.json()
+            if isinstance(body, dict):
+                to = body.get("to")
+                reason = body.get("reason", reason)
+        if to is None:
+            raise HTTPError(400, "need ?to=N or a {\"to\": N} body")
+        try:
+            n = int(to)
+        except (TypeError, ValueError):
+            raise HTTPError(400, "to must be an integer")
+        granted = agg.autoscaler.request_target(
+            n, reason or "POST /scale")
+        return json_response({"requested": n, "target": granted,
+                              "autoscale": agg.autoscaler.status()})
+
+    @app.route("GET", "/route.json")
+    def route_json(req: Request) -> Response:
+        if agg.router is None:
+            raise HTTPError(
+                404, "no query router is attached to this fleet "
+                     "(deploy with --autoscale / router enabled)")
+        out = agg.router.status()
+        key = req.query.get("key")
+        if key is not None:
+            out["key"] = key
+            out["affinity"] = agg.router.route_key(key)
+            out["preference"] = agg.router.preference(
+                key, agg.router.config.spill_fanout
+                + agg.router.config.retries)
+        return json_response(out)
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:

@@ -194,6 +194,22 @@ class _Family:
         with self._lock:
             return list(self._children.items())
 
+    def remove_matching(self, **labels: str) -> int:
+        """Drop every child whose label set CONTAINS the given items
+        (``remove_matching(replica="h:p")`` removes that replica's
+        children whatever other labels they carry). The fleet
+        aggregator calls this when a replica is scaled in, so decades
+        of membership churn never leak gauge cardinality; merged
+        counters/histograms are left alone — their contributions are
+        monotone history."""
+        items = set(labels.items())
+        with self._lock:
+            doomed = [key for key in self._children
+                      if items <= set(key)]
+            for key in doomed:
+                del self._children[key]
+        return len(doomed)
+
     def render(self, openmetrics: bool = False) -> List[str]:
         # OpenMetrics names a counter family WITHOUT the _total suffix
         # (samples keep it); the 0.0.4 format uses the suffixed name
@@ -277,6 +293,13 @@ class MetricsRegistry:
                 raise ValueError(
                     f"metric {name!r} already registered as {fam.kind}")
         return fam
+
+    def families(self) -> List[_Family]:
+        """Every registered family (registration order) — the sweep
+        surface for cross-family cleanup like
+        :meth:`_Family.remove_matching`."""
+        with self._lock:
+            return list(self._families.values())
 
     def get(self, name: str) -> Optional[_Family]:
         """The registered family called ``name`` (None when absent) —

@@ -1,0 +1,40 @@
+"""SLO-driven autoscaling tier (ISSUE 18, docs/autoscaling.md).
+
+Three cooperating pieces turn a static ``--fleet-of N`` deployment
+into an elastic one:
+
+- :class:`QueryRouter` — consistent-hash entity affinity over a
+  :class:`HashRing` (sha256-keyed like the serving cache and pinned
+  hot tier, so per-replica hit rates survive membership changes),
+  with Space-Saving-confirmed hot-key spill, health ejection, and
+  bounded idempotent retry;
+- :class:`ReplicaLifecycle` — the spawn/warm/ready/drain/terminate
+  state machine (warm gates on ``pio_serving_warm``; drain stops new
+  assignments and lets in-flight work finish);
+- :class:`Autoscaler` — the control loop: out on fast-window SLO burn
+  or low capacity headroom, in against the CAPACITY.json knee model
+  with hysteresis + cooldown, every decision traced and logged on
+  ``/fleet.json``.
+"""
+
+from .autoscaler import Autoscaler, AutoscalePolicy
+from .lifecycle import ReplicaLifecycle
+from .ring import HashRing, key_point
+from .router import (
+    QueryRouter,
+    RouterConfig,
+    build_router_app,
+    create_router_server,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
+    "HashRing",
+    "QueryRouter",
+    "ReplicaLifecycle",
+    "RouterConfig",
+    "build_router_app",
+    "create_router_server",
+    "key_point",
+]

@@ -601,6 +601,12 @@ class QueryServer:
         from .stats import RecompileSentinel
         self.recompile_sentinel = RecompileSentinel()
         self.warm_done = threading.Event()
+        # lifecycle advertisement (ISSUE 18): the router's lifecycle
+        # manager flips this via POST /drain; the fleet aggregator
+        # reads the resulting /status.json "lifecycle" field so a
+        # draining replica leaves rollups + the headroom denominator
+        # without an up-flap when its scrapes finally stop
+        self.drain_started = threading.Event()
         self.metrics.gauge(
             "pio_compiles_since_warm",
             "XLA compiles after serving warmup finished — every one is "
@@ -708,6 +714,25 @@ class QueryServer:
     def stop_slo(self) -> None:
         if self.slo is not None:
             self.slo.stop()
+
+    # -- lifecycle advertisement (ISSUE 18) ----------------------------------
+    @property
+    def lifecycle(self) -> str:
+        """``warming`` | ``ready`` | ``draining`` — the state this
+        replica advertises on ``/status.json``. Draining means "finish
+        what's in flight, send me nothing new": the router has already
+        pulled this replica from its ring; the aggregator keeps it out
+        of rollups and treats its eventual silence as an expected
+        departure."""
+        if self.drain_started.is_set():
+            return "draining"
+        return "ready" if self.warm_done.is_set() else "warming"
+
+    def enter_drain(self) -> None:
+        """Irreversible: announce drain (``POST /drain``). The server
+        keeps answering queries — in-flight and in-deadline work must
+        complete — but every surface now reports lifecycle=draining."""
+        self.drain_started.set()
 
     def _warm_serving(self, gen: int) -> None:
         """Pre-compile the serving path's device shapes (single query +
@@ -2613,6 +2638,7 @@ def build_app(server: QueryServer) -> HTTPApp:
             "avgServingSec": server.avg_serving_sec,
             "lastServingSec": server.last_serving_sec,
             "servingWarm": server.warm_done.is_set(),
+            "lifecycle": server.lifecycle,
             "transferGuard": cfg.transfer_guard or "off",
             "transferGuardViolations": TransferGuardCounter.total(),
             "recompile": server.recompile_sentinel.snapshot(),
@@ -2871,6 +2897,18 @@ def build_app(server: QueryServer) -> HTTPApp:
         instance_id = server.reload()  # binds the re-pinned previous
         return json_response({"message": "Rolled back.",
                               "engineInstanceId": instance_id})
+
+    @app.route("POST", "/drain")
+    def drain(req: Request) -> Response:
+        """Flip this replica to lifecycle=draining (ISSUE 18): it
+        keeps serving until in-flight/in-deadline work finishes, but
+        advertises the state so the router sends nothing new and the
+        fleet aggregator retires it from rollups without an up-flap.
+        Idempotent; does NOT shut the server down — the lifecycle
+        manager (or operator) does that once inflight hits zero."""
+        _auth(req)
+        server.enter_drain()
+        return json_response({"lifecycle": server.lifecycle})
 
     @app.route("POST", "/stop")
     def stop(req: Request) -> Response:

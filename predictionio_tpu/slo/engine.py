@@ -333,6 +333,17 @@ class SLOEngine:
             return [n for n, st in self._states.items()
                     if st.state == "breach"]
 
+    def fast_burning(self) -> List[str]:
+        """Specs whose FAST window alone is burning ≥ its threshold —
+        the minutes-scale early warning the autoscaler keys scale-out
+        on. Deliberately looser than :meth:`burning` (which also
+        requires the slow window): capacity added only after the slow
+        window confirms the breach is capacity added too late."""
+        with self._lock:
+            return [n for n, st in self._states.items()
+                    if st.burn_fast is not None
+                    and st.burn_fast >= st.spec.burn_fast]
+
     def status(self) -> Dict[str, Any]:
         """The ``/slo.json`` payload (and the ``slo`` block of
         ``/status.json``)."""

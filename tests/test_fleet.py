@@ -7,6 +7,7 @@ gauge labels with min/max/sum rollups over live replicas only, the
 pio_slo_* merge skip, and cross-replica trace fan-out — all through an
 injected fetch, no sockets."""
 
+import json
 import math
 import time
 
@@ -416,3 +417,159 @@ class TestFleetAggregator:
     def test_needs_at_least_one_replica(self):
         with pytest.raises(ValueError):
             FleetAggregator(FleetConfig(replicas=[]))
+
+
+# ---------------------------------------------------------------------------
+# Dynamic membership + draining lifecycle (ISSUE 18 satellite: a
+# draining replica must leave rollups and the headroom denominator
+# without pio_fleet_replica_up flap or counter-reset noise)
+# ---------------------------------------------------------------------------
+
+class TestFleetMembership:
+    def test_draining_leaves_rollups_without_up_flap(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        f.status["r2"]["lifecycle"] = "draining"
+        f.agg.scrape_cycle()
+        # still scraped, still up — just not serving
+        assert f.value("pio_fleet_replica_up", replica="r2").value == 1.0
+        assert f.value("pio_inflight_requests", agg="sum").value == 3.0
+        assert f.value("pio_inflight_requests", agg="max").value == 2.0
+        assert f.value("pio_fleet_replicas", state="draining").value == 1.0
+        status = f.agg.fleet_status()
+        assert status["replicasDraining"] == 1
+        assert status["replicasUp"] == 3          # no up flap
+        by_name = {r["replica"]: r for r in status["replicas"]}
+        assert by_name["r2"]["lifecycle"] == "draining"
+
+    def test_draining_replica_departs_without_error_noise(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        f.status["r2"]["lifecycle"] = "draining"
+        f.agg.scrape_cycle()
+        f.dead.add("r2")                   # drained and terminated
+        outcomes = f.agg.scrape_cycle()
+        assert outcomes["r2"] == "departed"
+        # expected exit: no scrape-error counter, no lingering gauges
+        fam = f.agg.registry.get("pio_fleet_scrapes_total")
+        errs = {dict(i).get("replica"): c.value for i, c in
+                fam.children() if dict(i).get("outcome") == "error"}
+        assert "r2" not in errs
+        with pytest.raises(AssertionError):
+            f.value("pio_fleet_replica_up", replica="r2")
+        names = {r["replica"] for r in f.agg.replica_summaries()}
+        assert names == {"r0", "r1"}
+        assert f.agg.replica_health("r2") == "absent"
+
+    def test_add_replica_joins_the_merge(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        f.regs["r3"] = _replica_registry(5, [0.01], 8.0)
+        f.status["r3"] = {"servingWarm": True}
+        assert f.agg.replica_health("r3") == "absent"
+        f.agg.add_replica("r3")
+        assert f.agg.replica_health("r3") == "unknown"  # not yet scraped
+        f.agg.scrape_cycle()
+        assert f.agg.replica_health("r3") == "up"
+        child = f.value("pio_http_requests_total",
+                        route="/queries.json", status="200")
+        assert child.value == 65.0
+        assert f.value("pio_inflight_requests", agg="sum").value == 15.0
+        assert f.value("pio_fleet_replicas",
+                       state="configured").value == 4.0
+
+    def test_remove_drops_gauges_keeps_counter_history(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        assert f.agg.remove_replica("r1")
+        with pytest.raises(AssertionError):
+            f.value("pio_inflight_requests", replica="r1")
+        f.agg.scrape_cycle()
+        # merged counters are monotone history: r1's contribution stays
+        child = f.value("pio_http_requests_total",
+                        route="/queries.json", status="200")
+        assert child.value == 60.0
+        assert f.value("pio_inflight_requests", agg="sum").value == 5.0
+        assert f.value("pio_fleet_replicas",
+                       state="configured").value == 2.0
+
+    def test_rejoin_resumes_anchors_without_double_count(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        f.agg.remove_replica("r1")
+        f.agg.scrape_cycle()
+        f.agg.add_replica("r1")
+        f.agg.scrape_cycle()
+        child = f.value("pio_http_requests_total",
+                        route="/queries.json", status="200")
+        assert child.value == 60.0       # NOT 80: anchors restored
+        f.regs["r1"].get("pio_http_requests_total").labels(
+            route="/queries.json", status="200").inc(5)
+        f.agg.scrape_cycle()
+        assert child.value == 65.0       # growth arrives as a delta
+
+    def test_merge_invariant_across_membership_churn(self):
+        # the fleet total must equal the sum of what every member
+        # ever contributed, with replicas joining and leaving
+        # between scrape cycles
+        f = _Fleet()
+        f.agg.scrape_cycle()                              # 60
+        f.regs["r3"] = _replica_registry(5, [], 0.5)
+        f.status["r3"] = {"servingWarm": True}
+        f.agg.add_replica("r3")
+        f.agg.scrape_cycle()                              # +5
+        f.agg.remove_replica("r0")
+        f.agg.scrape_cycle()
+        f.agg.add_replica("r0")
+        f.regs["r0"].get("pio_http_requests_total").labels(
+            route="/queries.json", status="200").inc(2)
+        f.agg.scrape_cycle()                              # +2
+        child = f.value("pio_http_requests_total",
+                        route="/queries.json", status="200")
+        assert child.value == 67.0
+        validate_exposition(f.agg.registry.render())
+
+    def test_replica_health_down_when_stale(self):
+        f = _Fleet(stale_after_sec=0.01)
+        f.agg.scrape_cycle()
+        f.dead.add("r2")
+        time.sleep(0.03)
+        f.agg.scrape_cycle()
+        assert f.agg.replica_health("r2") == "down"
+        assert f.agg.replica_health("r0") == "up"
+
+    def test_capacity_signals_without_model(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        sig = f.agg.capacity_signals()
+        assert sig["kneeQps"] is None
+        assert sig["headroom"] is None   # no model ≠ infinite room
+
+    def test_capacity_signals_with_model(self, tmp_path):
+        cap = tmp_path / "CAPACITY.json"
+        cap.write_text(json.dumps({"configs": {
+            "default": {"knee_qps": 100.0}}}))
+        f = _Fleet(capacity_path=str(cap))
+        f.agg.scrape_cycle()
+        sig = f.agg.capacity_signals()
+        assert sig["kneeQps"] == 100.0
+        assert sig["headroom"] == pytest.approx(1.0)   # idle fleet
+
+    def test_headroom_denominator_excludes_draining(self, tmp_path):
+        cap = tmp_path / "CAPACITY.json"
+        cap.write_text(json.dumps({"configs": {
+            "default": {"knee_qps": 100.0}}}))
+        f = _Fleet(capacity_path=str(cap))
+        f.agg.scrape_cycle()
+        assert f.agg.capacity_signals()["headroom"] == pytest.approx(1.0)
+        for name in f.status:
+            f.status[name]["lifecycle"] = "draining"
+        f.agg.scrape_cycle()
+        # every replica's capacity is leaving: zero serving replicas
+        # is the over-capacity sentinel, not "100% headroom"
+        assert f.agg.capacity_signals()["headroom"] == -1.0
+
+    def test_fleet_status_reports_autoscale_block(self):
+        f = _Fleet()
+        f.agg.scrape_cycle()
+        assert f.agg.fleet_status()["autoscale"] == {"enabled": False}

@@ -1,0 +1,689 @@
+"""Autoscaling tier tests (ISSUE 18, docs/autoscaling.md): hash-ring
+placement properties (the ≤1/N remap bound, order-independent
+affinity), sketch-confirmed hot-key spill, the router's proxy behavior
+over real backends (affinity, retry, ejection, drain), the replica
+lifecycle state machine with injected fakes, and the autoscaler's
+policy arithmetic (burn/headroom triggers, hysteresis, cooldown,
+heal) under a fake clock."""
+
+import hashlib
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu import faults
+from predictionio_tpu.obs import MetricsRegistry
+from predictionio_tpu.router import (
+    Autoscaler,
+    AutoscalePolicy,
+    HashRing,
+    QueryRouter,
+    ReplicaLifecycle,
+    RouterConfig,
+    key_point,
+)
+from predictionio_tpu.server.http import (
+    AppServer,
+    HTTPApp,
+    HTTPError,
+    Response,
+    json_response,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+MEMBERS = [f"10.0.0.{i}:8000" for i in range(10)]
+KEYS = [f"user-{i}" for i in range(2000)]
+
+
+# ---------------------------------------------------------------------------
+# HashRing — consistency properties (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+class TestHashRing:
+    def test_key_point_is_sha256_derived(self):
+        # same derivation as rollout.splitter.cohort_bucket: the first
+        # 8 bytes of sha256, big-endian — deterministic across
+        # processes (hash() would randomize per run)
+        d = hashlib.sha256("user-1".encode("utf-8")).digest()
+        assert key_point("user-1") == int.from_bytes(d[:8], "big")
+
+    def test_assign_returns_a_member(self):
+        ring = HashRing(MEMBERS)
+        for k in KEYS[:100]:
+            assert ring.assign(k) in MEMBERS
+
+    def test_affinity_independent_of_membership_order(self):
+        shuffled = list(MEMBERS)
+        random.Random(7).shuffle(shuffled)
+        a, b = HashRing(MEMBERS), HashRing(shuffled)
+        assert [a.assign(k) for k in KEYS] == \
+            [b.assign(k) for k in KEYS]
+
+    def test_remove_remaps_only_the_lost_members_keys(self):
+        ring = HashRing(MEMBERS)
+        before = {k: ring.assign(k) for k in KEYS}
+        victim = MEMBERS[3]
+        ring.remove(victim)
+        moved = 0
+        for k in KEYS:
+            after = ring.assign(k)
+            if before[k] == victim:
+                assert after != victim
+                moved += 1
+            else:
+                # consistent hashing's defining property: keys NOT on
+                # the removed member do not move at all
+                assert after == before[k]
+        # the victim held ~1/N of keys (vnode placement is uniform
+        # enough at 64 vnodes to stay well inside 3x)
+        assert 0 < moved <= 3 * len(KEYS) / len(MEMBERS)
+
+    def test_add_remaps_at_most_about_1_over_n(self):
+        ring = HashRing(MEMBERS)
+        before = {k: ring.assign(k) for k in KEYS}
+        ring.add("10.0.0.99:8000")
+        moved = 0
+        for k in KEYS:
+            after = ring.assign(k)
+            if after != before[k]:
+                # a moved key can ONLY have moved to the new member
+                assert after == "10.0.0.99:8000"
+                moved += 1
+        n = len(MEMBERS) + 1
+        assert 0 < moved <= 3 * len(KEYS) / n
+
+    def test_preference_lists_distinct_members(self):
+        ring = HashRing(MEMBERS)
+        for k in KEYS[:50]:
+            pref = ring.preference(k, 4)
+            assert len(pref) == 4
+            assert len(set(pref)) == 4
+            assert pref[0] == ring.assign(k)
+
+    def test_preference_capped_at_member_count(self):
+        ring = HashRing(MEMBERS[:2])
+        assert len(ring.preference("k", 5)) == 2
+
+    def test_empty_ring(self):
+        ring = HashRing()
+        assert ring.assign("k") is None
+        assert ring.preference("k", 3) == []
+
+
+# ---------------------------------------------------------------------------
+# QueryRouter placement (no sockets)
+# ---------------------------------------------------------------------------
+
+def _router(**cfg) -> QueryRouter:
+    r = QueryRouter(RouterConfig(**cfg))
+    for m in ("127.0.0.1:9001", "127.0.0.1:9002", "127.0.0.1:9003"):
+        r.add(m)
+    return r
+
+
+class TestRouterPlacement:
+    def test_cold_key_routes_to_affinity(self):
+        r = _router()
+        ring = HashRing(r.members(), vnodes=r.config.vnodes)
+        assert r.route_key("42") == ring.assign("42")
+        # stable across calls
+        assert r.route_key("42") == r.route_key("42")
+
+    def test_spill_requires_sketch_confirmation(self):
+        # ISSUE 18 satellite: spill triggers ONLY for keys the
+        # Space-Saving sketch confirms hot (error-adjusted lower
+        # bound over spill_share of traffic) — never for a cold key,
+        # never before spill_min_total observations
+        r = _router(spill_share=0.2, spill_min_total=50,
+                    spill_fanout=2)
+        for _ in range(10):
+            r.hot.record("viral")
+        _, spilled = r.candidates("viral")
+        assert not spilled            # under spill_min_total
+        for i in range(100):
+            r.hot.record("viral")
+            r.hot.record(f"cold-{i}")
+        cands, spilled = r.candidates("viral")
+        assert spilled
+        assert len(set(cands[:2])) == 2   # fanout-wide spill set
+        _, spilled = r.candidates("cold-1")
+        assert not spilled            # 1 observation is not a hot spot
+
+    def test_drain_stops_new_assignments(self):
+        r = _router()
+        first = r.route_key("42")
+        r.drain(first)
+        assert r.route_key("42") != first
+        assert first not in r.members()
+        # the backend still exists for its in-flight accounting
+        assert r.inflight(first) == 0
+        st = {b["replica"]: b["state"]
+              for b in r.status()["replicas"]}
+        assert st[first] == "draining"
+
+    def test_remove_forgets_the_backend(self):
+        r = _router()
+        victim = r.route_key("42")
+        assert r.remove(victim)
+        assert victim not in r.members()
+        assert victim not in {b["replica"]
+                              for b in r.status()["replicas"]}
+
+    def test_health_veto_reroutes(self):
+        r = _router()
+        first = r.route_key("42")
+        r.set_health(lambda name: name != first)
+        assert r.route_key("42") != first
+        # veto everything -> no opinion wins, traffic still flows
+        r.set_health(lambda name: False)
+        assert r.route_key("42") is not None
+
+    def test_keyless_queries_rotate(self):
+        r = _router()
+        seen = {r.route_key(None) for _ in range(10)}
+        assert seen == set(r.members())
+
+
+# ---------------------------------------------------------------------------
+# QueryRouter forwarding over real backends
+# ---------------------------------------------------------------------------
+
+def _backend(name: str, behavior: str = "ok"):
+    app = HTTPApp(name=f"backend-{name}")
+    hits = []
+
+    @app.route("POST", "/queries.json")
+    def q(req):
+        hits.append(json.loads(req.body.decode("utf-8")))
+        if behavior == "shed":
+            return Response(status=503, body={"error": "shed"},
+                            headers={"Retry-After": "0.05"})
+        return json_response({"replica": name})
+
+    srv = AppServer(app, host="127.0.0.1", port=0)
+    srv.start_background()
+    return srv, hits
+
+
+@pytest.fixture()
+def trio():
+    servers = [_backend(f"b{i}") for i in range(3)]
+    router = QueryRouter(RouterConfig(retries=1, eject_failures=2,
+                                      timeout_sec=5.0),
+                         registry=MetricsRegistry())
+    for srv, _ in servers:
+        router.add(f"127.0.0.1:{srv.port}")
+    yield router, servers
+    for srv, _ in servers:
+        srv.shutdown()
+
+
+def _fwd(router, user="7"):
+    body = json.dumps({"user": user, "num": 1}).encode("utf-8")
+    return router.forward("/queries.json", body, {})
+
+
+class TestRouterForward:
+    def test_affinity_lands_on_one_backend(self, trio):
+        router, servers = trio
+        for _ in range(6):
+            resp = _fwd(router)
+            assert resp.status == 200
+        counts = [len(hits) for _, hits in servers]
+        assert sorted(counts) == [0, 0, 6]
+        assert resp.headers["X-Routed-To"] == router.route_key("7")
+
+    def test_transport_failure_retries_next_replica(self, trio):
+        router, servers = trio
+        target = router.route_key("7")
+        faults.inject("router.forward", "error",
+                      match={"replica": target})
+        resp = _fwd(router)
+        assert resp.status == 200
+        assert resp.headers["X-Routed-To"] != target
+        assert resp.headers["X-Routed-Retry"] == "1"
+        fam = router.registry.get("pio_router_retries_total")
+        assert sum(c.value for _, c in fam.children()) == 1.0
+
+    def test_repeated_failures_eject_the_replica(self, trio):
+        router, servers = trio
+        target = router.route_key("7")
+        faults.inject("router.forward", "error",
+                      match={"replica": target})
+        for _ in range(3):
+            assert _fwd(router).status == 200
+        fam = router.registry.get("pio_router_ejections_total")
+        ejected = {dict(items).get("replica"): c.value
+                   for items, c in fam.children()}
+        assert ejected.get(target, 0) >= 1.0
+        # while ejected the replica is skipped outright: no retry hop
+        faults.clear()
+        faults.inject("router.forward", "error",
+                      match={"replica": target})
+        resp = _fwd(router)
+        assert resp.status == 200
+        assert "X-Routed-Retry" not in resp.headers
+
+    def test_all_replicas_dead_is_503(self, trio):
+        router, servers = trio
+        faults.inject("router.forward", "error")
+        with pytest.raises(HTTPError) as err:
+            _fwd(router)
+        assert err.value.status == 503
+
+    def test_503_shed_retries_on_next(self):
+        shedder, _ = _backend("shed", behavior="shed")
+        ok, ok_hits = _backend("ok")
+        router = QueryRouter(RouterConfig(retries=1),
+                             registry=MetricsRegistry())
+        # force preference order: shedder first
+        router.add(f"127.0.0.1:{shedder.port}")
+        router.add(f"127.0.0.1:{ok.port}")
+        try:
+            hit_ok = 0
+            for i in range(8):
+                resp = _fwd(router, user=str(i))
+                assert resp.status == 200
+                if json.loads(resp.encoded())["replica"] == "ok":
+                    hit_ok += 1
+            assert hit_ok == 8  # every shed hop landed on the survivor
+        finally:
+            shedder.shutdown()
+            ok.shutdown()
+
+    def test_draining_backend_finishes_inflight(self, trio):
+        router, servers = trio
+        target = router.route_key("7")
+        router.drain(target)
+        resp = _fwd(router)   # re-routed, not failed
+        assert resp.status == 200
+        assert resp.headers["X-Routed-To"] != target
+
+
+# ---------------------------------------------------------------------------
+# ReplicaLifecycle state machine (injected fakes, no sockets)
+# ---------------------------------------------------------------------------
+
+class _FakeRouter:
+    def __init__(self):
+        self.added, self.drained, self.removed = [], [], []
+        self.inflight_by = {}
+
+    def add(self, base):
+        self.added.append(base)
+
+    def drain(self, name):
+        self.drained.append(name)
+
+    def remove(self, name):
+        self.removed.append(name)
+
+    def inflight(self, name):
+        return self.inflight_by.get(name, 0)
+
+
+class _FakeAgg:
+    def __init__(self):
+        self.added, self.removed = [], []
+
+    def add_replica(self, base):
+        self.added.append(base)
+
+    def remove_replica(self, name):
+        self.removed.append(name)
+
+
+def _lifecycle(spawn, warm, **kw):
+    router, agg = _FakeRouter(), _FakeAgg()
+    lc = ReplicaLifecycle(
+        spawn, router=router, aggregator=agg,
+        probe=lambda base, t: {"servingWarm": warm.get(
+            base.split("://", 1)[1], False)},
+        notify_drain=lambda base, t: None,
+        poll_interval_sec=0.01, **kw)
+    return lc, router, agg
+
+
+class TestReplicaLifecycle:
+    def test_warm_gates_ring_entry(self):
+        warm = {}
+        lc, router, agg = _lifecycle(
+            lambda: ("127.0.0.1:9500", lambda: None), warm,
+            warm_timeout_sec=5.0)
+        lc.scale_out("test")
+        time.sleep(0.05)
+        assert lc.count("warming") == 1
+        assert router.added == []        # NOT in the ring yet
+        warm["127.0.0.1:9500"] = True
+        assert lc.await_ready(1, timeout_sec=5.0)
+        assert router.added == ["http://127.0.0.1:9500"]
+        assert agg.added == ["http://127.0.0.1:9500"]
+        lc.close()
+
+    def test_warm_timeout_is_dead_not_ready(self):
+        stopped = []
+        lc, router, agg = _lifecycle(
+            lambda: ("127.0.0.1:9501", lambda: stopped.append(1)),
+            {}, warm_timeout_sec=0.05)
+        lc.scale_out("test")
+        deadline = time.time() + 5
+        while time.time() < deadline and not stopped:
+            time.sleep(0.01)
+        assert stopped == [1]
+        assert router.added == []
+        assert lc.live_count() == 0
+        lc.close()
+
+    def test_spawn_failure_is_contained(self):
+        def bad_spawn():
+            raise RuntimeError("no capacity")
+        lc, router, agg = _lifecycle(bad_spawn, {})
+        lc.scale_out("test")
+        time.sleep(0.1)
+        assert lc.live_count() == 0
+        assert router.added == []
+        lc.close()
+
+    def test_drain_waits_for_inflight_then_stops(self):
+        stopped = []
+        warm = {"127.0.0.1:9502": True}
+        lc, router, agg = _lifecycle(
+            lambda: ("127.0.0.1:9502", lambda: stopped.append(1)),
+            warm, drain_deadline_sec=5.0)
+        lc.scale_out("t")
+        assert lc.await_ready(1, 5.0)
+        router.inflight_by["127.0.0.1:9502"] = 2
+        assert lc.scale_in(reason="test") == "127.0.0.1:9502"
+        assert router.drained == ["127.0.0.1:9502"]
+        time.sleep(0.08)
+        assert not stopped               # in-flight work still running
+        router.inflight_by["127.0.0.1:9502"] = 0
+        deadline = time.time() + 5
+        while time.time() < deadline and not stopped:
+            time.sleep(0.01)
+        assert stopped == [1]
+        assert router.removed == ["127.0.0.1:9502"]
+        assert agg.removed == ["127.0.0.1:9502"]
+        lc.close()
+
+    def test_drain_deadline_forces_the_stop(self):
+        stopped = []
+        warm = {"127.0.0.1:9503": True}
+        lc, router, agg = _lifecycle(
+            lambda: ("127.0.0.1:9503", lambda: stopped.append(1)),
+            warm, drain_deadline_sec=0.05)
+        lc.scale_out("t")
+        assert lc.await_ready(1, 5.0)
+        router.inflight_by["127.0.0.1:9503"] = 99   # never drains
+        lc.scale_in(reason="stuck")
+        deadline = time.time() + 5
+        while time.time() < deadline and not stopped:
+            time.sleep(0.01)
+        assert stopped == [1]
+        lc.close()
+
+    def test_mark_dead_skips_drain(self):
+        stopped = []
+        warm = {"127.0.0.1:9504": True}
+        lc, router, agg = _lifecycle(
+            lambda: ("127.0.0.1:9504", lambda: stopped.append(1)),
+            warm)
+        lc.scale_out("t")
+        assert lc.await_ready(1, 5.0)
+        assert lc.mark_dead("127.0.0.1:9504", "chaos")
+        assert stopped == [1]
+        assert router.removed == ["127.0.0.1:9504"]
+        assert lc.live_count() == 0
+        lc.close()
+
+    def test_adopt_warm_joins_immediately(self):
+        lc, router, agg = _lifecycle(lambda: ("x", None), {})
+        lc.adopt("127.0.0.1:9505")
+        assert lc.count("ready") == 1
+        assert router.added == ["http://127.0.0.1:9505"]
+        lc.close()
+
+    def test_transition_metrics(self):
+        reg = MetricsRegistry()
+        warm = {"127.0.0.1:9506": True}
+        router, agg = _FakeRouter(), _FakeAgg()
+        lc = ReplicaLifecycle(
+            lambda: ("127.0.0.1:9506", lambda: None),
+            router=router, aggregator=agg, registry=reg,
+            probe=lambda b, t: {"servingWarm": True},
+            notify_drain=lambda b, t: None,
+            poll_interval_sec=0.01)
+        lc.scale_out("t")
+        assert lc.await_ready(1, 5.0)
+        fam = reg.get("pio_autoscale_transitions_total")
+        by_state = {dict(items)["to"]: c.value
+                    for items, c in fam.children()}
+        assert by_state.get("ready") == 1.0
+        gauge = reg.get("pio_autoscale_replicas")
+        vals = {dict(items)["state"]: c.value
+                for items, c in gauge.children()}
+        assert vals["ready"] == 1.0
+        lc.close()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler policy (fake clock, fake signals)
+# ---------------------------------------------------------------------------
+
+class _FakeSLO:
+    def __init__(self):
+        self.fast = []
+
+    def fast_burning(self):
+        return list(self.fast)
+
+
+class _SignalAgg:
+    """Just the aggregator surface the autoscaler consumes."""
+
+    def __init__(self):
+        self.headroom = None
+        self.qps = 0.0
+        self.knee = 100.0
+        self.slo = _FakeSLO()
+        self.health = {}
+
+    def capacity_signals(self):
+        return {"qps": self.qps, "kneeQps": self.knee,
+                "headroom": self.headroom}
+
+    def replica_health(self, name):
+        return self.health.get(name, "up")
+
+
+def _autoscaled(policy=None, n=2):
+    agg = _SignalAgg()
+    router, fagg = _FakeRouter(), _FakeAgg()
+    warm = {}
+    counter = iter(range(9600, 9700))
+
+    def spawn():
+        spec = f"127.0.0.1:{next(counter)}"
+        warm[spec] = True
+        return spec, lambda: None
+
+    lc = ReplicaLifecycle(
+        spawn, router=router, aggregator=fagg,
+        probe=lambda base, t: {"servingWarm": warm.get(
+            base.split("://", 1)[1], False)},
+        notify_drain=lambda base, t: None,
+        poll_interval_sec=0.01, drain_deadline_sec=0.05)
+    for i in range(n):
+        lc.adopt(f"127.0.0.1:{9590 + i}")
+    clk = [1000.0]
+    asc = Autoscaler(agg, lc, policy or AutoscalePolicy(
+        min_replicas=1, max_replicas=4, headroom_floor=0.15,
+        headroom_ceiling=0.60, scale_in_sustain_sec=10.0,
+        cooldown_sec=30.0), clock=lambda: clk[0])
+    return asc, agg, lc, clk
+
+
+def _settle(lc, n, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline and lc.live_count() != n:
+        time.sleep(0.01)
+    assert lc.live_count() == n, lc.counts()
+
+
+class TestAutoscaler:
+    def test_holds_with_no_signals(self):
+        asc, agg, lc, clk = _autoscaled()
+        d = asc.evaluate()
+        assert d["action"] == "hold"
+        assert d["target"] == 2
+        lc.close()
+
+    def test_scale_out_on_fast_burn(self):
+        asc, agg, lc, clk = _autoscaled()
+        agg.slo.fast = ["queries-availability"]
+        d = asc.evaluate()
+        assert d["action"] == "scale_out"
+        assert "fast burn" in d["reason"]
+        _settle(lc, 3)
+        lc.close()
+
+    def test_scale_out_on_low_headroom(self):
+        asc, agg, lc, clk = _autoscaled()
+        agg.headroom = 0.05
+        d = asc.evaluate()
+        assert d["action"] == "scale_out"
+        assert "headroom" in d["reason"]
+        _settle(lc, 3)
+        lc.close()
+
+    def test_no_model_means_no_headroom_action(self):
+        asc, agg, lc, clk = _autoscaled()
+        agg.headroom = None      # no CAPACITY.json
+        assert asc.evaluate()["action"] == "hold"
+        lc.close()
+
+    def test_scale_in_needs_sustained_ceiling(self):
+        asc, agg, lc, clk = _autoscaled()
+        agg.headroom = 0.9
+        assert asc.evaluate()["action"] == "hold"   # not sustained yet
+        clk[0] += 5.0
+        assert asc.evaluate()["action"] == "hold"   # still inside window
+        clk[0] += 6.0
+        d = asc.evaluate()                          # 11s over ceiling
+        assert d["action"] == "scale_in"
+        _settle(lc, 1)
+        lc.close()
+
+    def test_cooldown_blocks_consecutive_policy_actions(self):
+        asc, agg, lc, clk = _autoscaled(n=2)
+        agg.headroom = 0.05
+        assert asc.evaluate()["action"] == "scale_out"
+        _settle(lc, 3)
+        d = asc.evaluate()
+        assert d["action"] == "hold"                # cooling down
+        clk[0] += 31.0
+        assert asc.evaluate()["action"] == "scale_out"
+        lc.close()
+
+    def test_hysteresis_band_prevents_flap(self):
+        # headroom between floor and ceiling must trigger NOTHING in
+        # either direction, ever
+        asc, agg, lc, clk = _autoscaled()
+        agg.headroom = 0.4
+        for _ in range(5):
+            clk[0] += 60.0
+            assert asc.evaluate()["action"] == "hold"
+        lc.close()
+
+    def test_max_replicas_caps_scale_out(self):
+        asc, agg, lc, clk = _autoscaled(
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                   cooldown_sec=0.0), n=2)
+        agg.slo.fast = ["x"]
+        assert asc.evaluate()["action"] == "hold"
+        assert lc.live_count() == 2
+        lc.close()
+
+    def test_min_replicas_floors_scale_in(self):
+        asc, agg, lc, clk = _autoscaled(
+            policy=AutoscalePolicy(min_replicas=2, max_replicas=4,
+                                   scale_in_sustain_sec=0.0,
+                                   cooldown_sec=0.0), n=2)
+        agg.headroom = 0.95
+        clk[0] += 1.0
+        assert asc.evaluate()["action"] == "hold"
+        assert lc.live_count() == 2
+        lc.close()
+
+    def test_burning_vetoes_scale_in(self):
+        asc, agg, lc, clk = _autoscaled(
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                   scale_in_sustain_sec=0.0,
+                                   cooldown_sec=0.0))
+        agg.headroom = 0.95
+        agg.slo.fast = ["queries-latency"]
+        clk[0] += 1.0
+        d = asc.evaluate()
+        assert d["action"] != "scale_in"
+        lc.close()
+
+    def test_replace_dead_bypasses_cooldown(self):
+        asc, agg, lc, clk = _autoscaled()
+        agg.headroom = 0.05
+        asc.evaluate()                               # starts cooldown
+        _settle(lc, 3)
+        corpse = lc.names("ready")[0]
+        agg.health[corpse] = "down"
+        d = asc.evaluate()
+        assert d["action"] == "replace"
+        assert corpse in d["reason"]
+        _settle(lc, 3)                               # replaced
+        assert corpse not in lc.names()
+        lc.close()
+
+    def test_manual_target_converges_and_logs(self):
+        asc, agg, lc, clk = _autoscaled()
+        assert asc.request_target(9, "ops") == 4     # clamped to max
+        d = asc.evaluate()
+        assert d["action"] == "manual"
+        _settle(lc, 4)
+        st = asc.status()
+        assert st["target"] == 4
+        assert any(x["action"] == "manual" for x in st["decisions"])
+        lc.close()
+
+    def test_scale_in_records_intentional_exits(self):
+        asc, agg, lc, clk = _autoscaled(
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                   scale_in_sustain_sec=0.0,
+                                   cooldown_sec=0.0))
+        agg.headroom = 0.95
+        clk[0] += 1.0
+        asc.evaluate()
+        _settle(lc, 1)
+        deadline = time.time() + 5
+        while time.time() < deadline and not asc.status()["removed"]:
+            time.sleep(0.01)
+        removed = asc.status()["removed"]
+        assert len(removed) == 1     # the decision-log source ptpu
+        lc.close()                   # fleet status consults
+
+    def test_decisions_are_bounded_and_sequenced(self):
+        asc, agg, lc, clk = _autoscaled()
+        agg.slo.fast = ["x"]
+        seqs = []
+        for _ in range(3):
+            clk[0] += 31.0
+            seqs.append(asc.evaluate()["seq"])
+        assert seqs == sorted(seqs)
+        assert len(asc.status()["decisions"]) <= asc.LOG_LIMIT
+        lc.close()
