@@ -189,7 +189,8 @@ def json_post_sender(port: int, path, body_fn: Callable[[int], bytes],
                      shed_status: Iterable[int] = (503,),
                      host: str = "127.0.0.1",
                      timeout: float = 120.0,
-                     endpoints: Optional[Iterable[str]] = None
+                     endpoints: Optional[Iterable[str]] = None,
+                     content_type: str = "application/json"
                      ) -> Callable[[], Callable[[int], str]]:
     """A ``worker_factory`` POSTing JSON over one keep-alive
     connection per worker. ``path`` is a string or ``path(k)``;
@@ -218,7 +219,7 @@ def json_post_sender(port: int, path, body_fn: Callable[[int], bytes],
                 conn.request(
                     "POST", path(k) if callable(path) else path,
                     body=body,
-                    headers={"Content-Type": "application/json"})
+                    headers={"Content-Type": content_type})
                 resp = conn.getresponse()
                 payload = resp.read()
             except Exception:

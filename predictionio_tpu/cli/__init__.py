@@ -341,7 +341,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         profile_dir=args.profile_dir or None,
         slo_specs=args.slo_specs or None,
         slo_interval_ms=args.slo_interval_ms,
-        hot_keys_k=args.hot_keys_k)
+        hot_keys_k=args.hot_keys_k,
+        artifact_dir=args.artifact_dir or None)
     ssl_ctx = ssl_context_from(args.cert or None, args.key or None)
     scheme = "https" if ssl_ctx else "http"
     if args.fleet_of > 1:
@@ -1531,14 +1532,50 @@ def cmd_import(args, storage: Storage) -> int:
     return 0
 
 
+def artifact_root(arg: str = "") -> str:
+    """Resolve the AOT artifact store root: explicit flag, then
+    $PTPU_ARTIFACT_DIR, then ~/.ptpu/artifacts."""
+    return (arg or os.environ.get("PTPU_ARTIFACT_DIR", "")
+            or os.path.join(os.path.expanduser("~"), ".ptpu",
+                            "artifacts"))
+
+
 def cmd_build(args, storage: Storage) -> int:
     """No sbt here: 'build' verifies the engine variant is loadable
-    (``commands/Engine.scala:66-139`` becomes an import check)."""
+    (``commands/Engine.scala:66-139`` becomes an import check). With
+    ``--aot`` (ISSUE 19) it additionally compiles the serving entry
+    points for the latest COMPLETED instance and serializes the
+    executables into the artifact store, so a matching deploy warms by
+    loading them (docs/cold-start.md)."""
     variant = load_variant(args.engine_json)
     engine, engine_params = engine_from_variant(variant)
     n_algos = len(engine_params.algorithms)
     _out(f"Engine factory {variant.get('engineFactory')} loads OK "
          f"({n_algos} algorithm(s) configured).")
+    if getattr(args, "aot", False):
+        from ..server.engineserver import ServerConfig, build_artifacts
+
+        ctx = _make_ctx(storage)
+        config = ServerConfig(
+            batching=args.batching,
+            max_batch=args.max_batch,
+            serving_mode=args.serving_mode,
+            serving_quant=args.serving_quant,
+            serving_topk=args.serving_topk)
+        result = build_artifacts(
+            ctx, engine, engine_params,
+            artifact_root(args.artifact_dir),
+            engine_id=args.engine_id or variant.get("id", "default"),
+            engine_version=(args.engine_version
+                            or variant.get("version", "1")),
+            engine_variant=args.engine_json,
+            config=config)
+        _out(f"AOT artifacts: {result['entries']} serving "
+             f"executable(s) for instance {result['instance']} in "
+             f"{result['seconds']:.1f}s -> {result['path']}")
+        _out(f"Deploy with --artifact-dir "
+             f"{artifact_root(args.artifact_dir)} (and the same "
+             f"serving flags) to warm from them.")
     _out("Build finished successfully.")
     return 0
 
@@ -1947,6 +1984,34 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("build", help="verify the engine variant loads")
     add_engine_flags(s)
+    # AOT compile artifacts (ISSUE 19, docs/cold-start.md): serialize
+    # the serving executables at build time so deploy warms by loading
+    # them. The serving-envelope flags below are key-bearing and must
+    # match the eventual `ptpu deploy` invocation.
+    s.add_argument("--aot", action="store_true",
+                   help="ahead-of-time compile the serving entry "
+                        "points for the latest COMPLETED instance and "
+                        "serialize them into --artifact-dir; a deploy "
+                        "passing the same dir + serving flags warms "
+                        "from the artifacts in milliseconds")
+    s.add_argument("--artifact-dir", default="",
+                   help="AOT artifact store root (default "
+                        "$PTPU_ARTIFACT_DIR or ~/.ptpu/artifacts)")
+    s.add_argument("--batching", action="store_true",
+                   help="capture for a --batching deploy (pow2 batch "
+                        "ladder up to --max-batch)")
+    s.add_argument("--max-batch", type=int, default=128,
+                   help="max queries per coalesced dispatch")
+    s.add_argument("--serving-mode", default="single",
+                   choices=["auto", "single", "replicated", "sharded"],
+                   help="serving placement the deploy will use")
+    s.add_argument("--serving-quant", default="off",
+                   choices=["off", "bf16", "int8"],
+                   help="serving-table quantization the deploy will "
+                        "use")
+    s.add_argument("--serving-topk", default="auto",
+                   choices=["auto", "einsum", "fused"],
+                   help="top-k realization the deploy will use")
 
     s = sub.add_parser("train", help="train an engine")
     add_engine_flags(s)
@@ -2140,6 +2205,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CAPACITY.json for the fleet headroom gauge "
                         "and the autoscaler's knee model "
                         "(benchmarks/load_harness.py output)")
+    s.add_argument("--artifact-dir", default="",
+                   help="warm from the AOT artifact store `ptpu build "
+                        "--aot` wrote there (docs/cold-start.md): "
+                        "deploy loads serialized serving executables "
+                        "instead of compiling, with automatic "
+                        "fallback to compile on any key mismatch. "
+                        "Empty disables (the compile warm)")
 
     s = sub.add_parser("undeploy", help="stop a deployed engine")
     s.add_argument("--ip", default="127.0.0.1")

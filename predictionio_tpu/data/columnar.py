@@ -33,8 +33,11 @@ touches (event ids, tags, prId, creation time) stays in the row store.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import os
+import queue
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -618,6 +621,22 @@ _DICTS = ("event_names", "entity_types", "entity_ids", "target_types",
           "target_ids")
 
 
+def batch_digest(batch: ColumnarBatch) -> str:
+    """sha256 over every column's bytes — the per-delta term of the
+    segment log's chained content stamp."""
+    h = hashlib.sha256()
+    h.update(str(batch.n).encode())
+    cols = [batch.event, batch.entity_type, batch.entity_id,
+            batch.target_type, batch.target_id, batch.event_time,
+            batch.props_offsets, batch.props_blob]
+    cols += [batch.float_props[k] for k in sorted(batch.float_props)]
+    for arr in cols:
+        a = np.asarray(arr, order="C")
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 class SegmentLog:
     """Immutable columnar segments + manifest for one event log.
 
@@ -754,6 +773,15 @@ class SegmentLog:
         manifest["segments"].append(entry)
         manifest["count"] += batch.n
         manifest["watermark"] = watermark
+        # incremental content stamp: chain the delta digest onto the
+        # previous stamp — O(delta) per append, so ETag computation never
+        # re-hashes the full log (the former full-bytes sha256 made every
+        # poll after every append an O(total) scan, quadratic over the
+        # life of the log). Segments are immutable, so the chain value is
+        # a faithful stand-in for the full-content hash.
+        manifest["stamp"] = hashlib.sha256(
+            (manifest.get("stamp", "") + batch_digest(batch))
+            .encode()).hexdigest()[:32]
         manifest["float_props"] = sorted(
             set(manifest["float_props"]) | set(batch.float_props))
         if hash_impl is not None:
@@ -787,9 +815,22 @@ class SegmentLog:
         if changed:
             self._write_manifest(manifest)
 
+    #: canonical dtypes of the core columns — segment reads land on these
+    #: regardless of what an older writer put on disk (dtype-stable
+    #: decoding: a stray int64 code column cannot poison jax feeds)
+    _CORE_DTYPES = (("event", np.int32), ("entity_type", np.int32),
+                    ("entity_id", np.int32), ("target_type", np.int32),
+                    ("target_id", np.int32), ("event_time", np.int64))
+
     def load(self, mmap: bool = True, with_props: bool = True
              ) -> Tuple[Optional[ColumnarBatch], Optional[dict]]:
-        """(batch, manifest) — batch columns mmap the segment files.
+        """(batch, manifest) — a single-segment log mmaps its files in
+        place; a multi-segment log decodes into contiguous preallocated
+        column buffers with segment ``k+1`` read by a prefetch thread
+        while ``k`` lands (overlapping fetch with decode, the analyzed
+        dataloader discipline of arXiv 2005.04680): one allocation per
+        column at the final size instead of per-segment arrays plus an
+        O(total) concat copy.
 
         ``with_props=False`` skips the property-byte columns (and is the
         only valid mode while any segment is still props-deferred —
@@ -798,35 +839,114 @@ class SegmentLog:
         if manifest is None:
             return None, None
         dicts = self._read_dicts()
-        mode = "r" if mmap else None
-        parts: List[ColumnarBatch] = []
-        for seg in manifest["segments"]:
-            seg_dir = os.path.join(self.path, seg["name"])
+        segs = manifest["segments"]
+        for seg in segs:
+            if with_props and not seg.get("props", True):
+                raise RuntimeError(
+                    f"segment {seg['name']} is props-deferred; call "
+                    f"ensure_props() before load(with_props=True)")
+        if not segs:
+            return ColumnarBatch.empty(dicts), manifest
+        if len(segs) == 1:
+            seg_dir = os.path.join(self.path, segs[0]["name"])
+            mode = "r" if mmap else None
 
             def col(name: str) -> np.ndarray:
                 return np.load(os.path.join(seg_dir, f"{name}.npy"),
                                mmap_mode=mode, allow_pickle=False)
 
-            if with_props and not seg.get("props", True):
-                raise RuntimeError(
-                    f"segment {seg['name']} is props-deferred; call "
-                    f"ensure_props() before load(with_props=True)")
-            parts.append(ColumnarBatch(
+            return ColumnarBatch(
                 event=col("event"), entity_type=col("entity_type"),
                 entity_id=col("entity_id"), target_type=col("target_type"),
                 target_id=col("target_id"), event_time=col("event_time"),
                 props_offsets=(col("props_offsets") if with_props
-                               else np.zeros(seg["n"] + 1, np.int64)),
+                               else np.zeros(segs[0]["n"] + 1, np.int64)),
                 props_blob=(col("props_blob") if with_props
                             else np.empty(0, np.uint8)),
                 float_props={name: col(f"prop_{name}")
                              for name in manifest["float_props"]
                              if os.path.exists(os.path.join(
                                  seg_dir, f"prop_{name}.npy"))},
-                dicts=dicts))
-        if not parts:
-            return ColumnarBatch.empty(dicts), manifest
-        return ColumnarBatch.concat(parts), manifest
+                dicts=dicts), manifest
+        return self._load_contiguous(manifest, dicts, with_props), manifest
+
+    def _load_contiguous(self, manifest: dict, dicts: ColumnarDicts,
+                         with_props: bool) -> ColumnarBatch:
+        segs = manifest["segments"]
+        fp_names = list(manifest["float_props"])
+        total = int(sum(s["n"] for s in segs))
+        dest = {name: np.empty(total, dt) for name, dt in self._CORE_DTYPES}
+        if with_props:
+            # per-segment blob sizes from the npy headers only: an mmap
+            # open touches the header page, never the data pages
+            blob_total = sum(
+                int(np.load(os.path.join(self.path, s["name"],
+                                         "props_blob.npy"),
+                            mmap_mode="r", allow_pickle=False).shape[0])
+                for s in segs)
+            props_offsets = np.empty(total + 1, np.int64)
+            props_offsets[0] = 0
+            props_blob = np.empty(blob_total, np.uint8)
+        else:
+            props_offsets = np.zeros(total + 1, np.int64)
+            props_blob = np.empty(0, np.uint8)
+        fp = {k: _EMPTY_F64(total) for k in fp_names}
+
+        def read_segment(seg: dict) -> dict:
+            seg_dir = os.path.join(self.path, seg["name"])
+            out = {name: np.load(os.path.join(seg_dir, f"{name}.npy"),
+                                 allow_pickle=False)
+                   for name, _ in self._CORE_DTYPES}
+            if with_props:
+                for name in ("props_offsets", "props_blob"):
+                    out[name] = np.load(
+                        os.path.join(seg_dir, f"{name}.npy"),
+                        allow_pickle=False)
+            for name in fp_names:
+                p = os.path.join(seg_dir, f"prop_{name}.npy")
+                if os.path.exists(p):
+                    out[f"prop_{name}"] = np.load(p, allow_pickle=False)
+            return out
+
+        # maxsize=2 bounds read-ahead to segment k+1 while k decodes
+        q: queue.Queue = queue.Queue(maxsize=2)
+
+        def producer() -> None:
+            try:
+                for i, seg in enumerate(segs):
+                    q.put((i, read_segment(seg)))
+            except BaseException as e:  # surfaced on the consumer side
+                q.put((-1, e))
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="segmentlog-prefetch")
+        t.start()
+        row = blob_base = 0
+        for _ in range(len(segs)):
+            i, arrs = q.get()
+            if i < 0:
+                raise arrs
+            n = int(segs[i]["n"])
+            for name, _ in self._CORE_DTYPES:
+                dest[name][row:row + n] = arrs[name]
+            if with_props:
+                offs = arrs["props_offsets"]
+                props_offsets[row:row + n + 1] = offs + blob_base
+                blen = int(offs[-1])
+                props_blob[blob_base:blob_base + blen] = arrs["props_blob"]
+                blob_base += blen
+            for name in fp_names:
+                a = arrs.get(f"prop_{name}")
+                if a is not None:
+                    fp[name][row:row + n] = a
+            row += n
+        t.join()
+        return ColumnarBatch(
+            event=dest["event"], entity_type=dest["entity_type"],
+            entity_id=dest["entity_id"], target_type=dest["target_type"],
+            target_id=dest["target_id"], event_time=dest["event_time"],
+            props_offsets=props_offsets, props_blob=props_blob,
+            float_props=fp, dicts=dicts)
 
     def dicts_and_counts(self) -> Tuple[ColumnarDicts, Dict[str, int]]:
         d = self._read_dicts()

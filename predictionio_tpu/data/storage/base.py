@@ -202,6 +202,24 @@ class EventStore(abc.ABC):
             raise
         return done
 
+    def insert_columnar(self, batch, app_id: int,
+                        channel_id: Optional[int] = None) -> int:
+        """Bulk-write an arrow-style column block (ISSUE 19,
+        docs/streaming.md): ``batch`` is a
+        :class:`~predictionio_tpu.data.columnar.ColumnarBatch` — the
+        zero-copy ingest wire format — landed in one shot instead of a
+        per-event object stream. Returns the rows written.
+
+        Contract matches :meth:`insert_batch`: **all-or-nothing**, and
+        rows with no explicit event id get fresh ids. This default
+        decodes to :class:`Event` objects and rides ``insert_batch`` —
+        correct (and equally durable) on every backend; columnar
+        backends override with a vectorized path that never
+        materializes the per-event objects."""
+        events = list(batch.to_events())
+        self.insert_batch(events, app_id, channel_id)
+        return len(events)
+
     def import_jsonl(self, source, app_id: int,
                      channel_id: Optional[int] = None,
                      chunk: int = 100_000) -> int:

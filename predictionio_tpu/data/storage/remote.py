@@ -200,6 +200,22 @@ class RemoteEventStore(EventStore):
         doc = [e.to_json() for e in events]
         return self.c.rpc(f"{base}/batch{q}", doc).get("ids", [])
 
+    def insert_columnar(self, batch, app_id: int,
+                        channel_id: Optional[int] = None) -> int:
+        """Block ingest: ship the batch as one npz POST — the server's
+        backend writes it in a single transaction (all-or-nothing per
+        POST). NOT auto-retried: block rows get server-assigned event
+        ids, so a replay after a lost response would duplicate the
+        block — callers own the redelivery decision."""
+        from .wire import batch_to_npz
+
+        base, q = self._base(app_id, channel_id)
+        _, _, body = self.c.request(
+            "POST", f"{base}/columnar{q}", batch_to_npz(batch),
+            headers={"Content-Type": "application/octet-stream"},
+            idempotent=False)
+        return int(json.loads(body.decode()).get("accepted", 0))
+
     def import_jsonl(self, source, app_id: int,
                      channel_id: Optional[int] = None,
                      chunk: int = 100_000) -> int:

@@ -1713,6 +1713,22 @@ def _serve_topk(user_factors, item_factors, idx: jax.Array, *, k: int,
     return jax.lax.top_k(scores, k)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "n_items"))
+def _fused_topk_entry(user_table, item_table, idx, *, k: int,
+                      n_items: int) -> Tuple[jax.Array, jax.Array]:
+    """The fused-kernel serving dispatch as ONE named jit entry so the
+    AOT seam (``predictionio_tpu.aot``) can lower/serialize it whole —
+    the outer jit inlines the inner kernel jits, and quantized tables
+    split into leaves inside the traced program exactly as
+    :func:`_serve_topk` does."""
+    from ..ops.fused_topk import fused_topk_dispatch
+
+    ud, us = _table_leaves(user_table)
+    vd, vs = _table_leaves(item_table)
+    return fused_topk_dispatch(ud, idx, vd, us, vs, k=k,
+                               n_items=n_items)
+
+
 def _device_topk(user_table, item_table, idx: np.ndarray, k_dev: int,
                  n_items: int) -> Tuple[jax.Array, jax.Array]:
     """The single-device batched top-k dispatch switch (ISSUE 13):
@@ -1721,22 +1737,29 @@ def _device_topk(user_table, item_table, idx: np.ndarray, k_dev: int,
     HBM) when the autotune table resolves "fused" and the compiled k
     fits the on-chip merge, else the :func:`_serve_topk` einsum
     program. Both realizations share tie semantics (descending score,
-    lowest id first), so the switch is invisible to callers."""
-    from ..ops.fused_topk import TOPK_MAX_K, fused_topk_dispatch
+    lowest id first), so the switch is invisible to callers.
+
+    Both realizations launch through :func:`aot.dispatch` — the seam
+    that answers from a deserialized build-time executable when a warm
+    artifact store is active (ISSUE 19), and is a plain tail call
+    otherwise."""
+    from .. import aot
+    from ..ops.fused_topk import TOPK_MAX_K
 
     vd, vs = _table_leaves(item_table)
     mode = resolved_topk_mode(int(vd.shape[-1]), table_quant(item_table))
     if mode == "fused" and 1 <= k_dev <= TOPK_MAX_K:
-        ud, us = _table_leaves(user_table)
         # the index stays uncommitted numpy (int32 — the kernel's SMEM
         # staging dtype): the jitted kernel places it, no eager
         # host→device hop for the transfer guard to flag
-        out = fused_topk_dispatch(ud, np.asarray(idx, dtype=np.int32),
-                                  vd, us, vs, k=k_dev,
-                                  n_items=n_items)
+        out = aot.dispatch(
+            "fused_topk", _fused_topk_entry,
+            (user_table, item_table, np.asarray(idx, dtype=np.int32)),
+            {"k": k_dev, "n_items": n_items})
     else:
-        out = _serve_topk(user_table, item_table, idx, k=k_dev,
-                          n_items=n_items)
+        out = aot.dispatch(
+            "serve_topk", _serve_topk, (user_table, item_table, idx),
+            {"k": k_dev, "n_items": n_items})
     if _numerics.active():
         # debug_numerics: host NaN probe on the served scores (forces
         # the dispatch sync — the documented debug-mode cost);
@@ -1883,9 +1906,16 @@ def _rank_sharded(mesh: Mesh, vecs, item_factors, k_dev: int,
     # executable (jit of shard_map), not user code: it cannot re-enter
     # the dispatch lock, and serializing the launch is the lock's
     # entire purpose (concurrent mesh-collective launches deadlock)
-    if vs is None:
-        return ranked(vecs, vd)
-    return ranked(vecs, vd, vs)
+    dyn = (vecs, vd) if vs is None else (vecs, vd, vs)
+    # key_extra mirrors the _sharded_rank_fn cache key: the argument
+    # signature alone cannot distinguish two mesh programs that differ
+    # only in k/k_local/topk realization
+    from .. import aot
+    return aot.dispatch(
+        "sharded_rank", ranked, dyn,
+        key_extra=(tuple(int(s) for s in mesh.devices.shape),
+                   tuple(mesh.axis_names), k_dev, k_local, n_items,
+                   quant or "off", mode))
 
 
 @functools.lru_cache(maxsize=64)

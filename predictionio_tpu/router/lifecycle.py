@@ -231,10 +231,15 @@ class ReplicaLifecycle:
 
     def _warm_then_join(self, m: _Managed) -> None:
         deadline = self._clock() + self.warm_timeout_sec
+        artifact_warm = False
         while not self._closed.is_set():
             try:
                 status = self._probe(m.base, self.probe_timeout_sec)
                 if status.get("servingWarm"):
+                    # how the replica warmed: loaded AOT artifacts vs a
+                    # cold compile ladder — the fleet-level signal that
+                    # the sub-second cold-start path actually engaged
+                    artifact_warm = bool(status.get("artifactWarm"))
                     break
             except Exception:  # noqa: BLE001 — not up yet
                 pass
@@ -249,7 +254,8 @@ class ReplicaLifecycle:
             self.router.add(m.base)
         if self.aggregator is not None:
             self.aggregator.add_replica(m.base)
-        self._set_state(m, "ready", m.reason or "warmed")
+        self._set_state(m, "ready", m.reason or (
+            "warmed from artifact" if artifact_warm else "warmed (compile)"))
 
     # -- scale in -----------------------------------------------------------
     def pick_drain_victim(self) -> Optional[str]:

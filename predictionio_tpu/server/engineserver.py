@@ -336,6 +336,13 @@ class ServerConfig:
     #: comes back or the attempt budget is spent
     lane_restart_backoff_ms: float = 100.0
     lane_restart_max_attempts: int = 8
+    #: Warm-from-artifact deploy (ISSUE 19, docs/cold-start.md): root
+    #: of the AOT artifact store ``ptpu build --aot`` wrote. When set,
+    #: ``_warm_serving`` becomes artifact-load-then-verify — serving
+    #: executables deserialize in milliseconds instead of compiling —
+    #: with automatic fallback to compiling on any key mismatch,
+    #: missing build, or corrupt entry. None keeps the compile warm.
+    artifact_dir: Optional[str] = None
 
 
 @dataclass
@@ -616,6 +623,19 @@ class QueryServer:
             "pio_serving_warm",
             "1 once the serving shapes are pre-compiled",
             fn=lambda: 1.0 if self.warm_done.is_set() else 0.0)
+        # warm-time telemetry (ISSUE 19): where warm time actually went
+        # — artifact-store open + executable deserialize ("load"), the
+        # lane-0 warm ladder net of loads ("compile"), lanes 1..N-1
+        # ("replicate"), and the post-warm verify pass ("probe")
+        self._warmup_seconds = self.metrics.histogram(
+            "pio_warmup_seconds",
+            "Serving warm-up wall time by phase "
+            "(phase=load|compile|replicate|probe); an artifact warm "
+            "puts its mass in load, a cold warm in compile",
+            bounds=[0.01, 0.05, 0.25, 1.0, 2.0, 5.0, 15.0, 30.0, 60.0])
+        #: warm provenance for /status.json: set by _warm_serving once
+        #: per generation ({"artifact": bool, "seconds": {...}, ...})
+        self._warm_report: dict = {}
         # the initial _bind ran before this registry existed; record
         # the resolved gram + serving-kernel modes now (rebinds
         # re-record inside _bind)
@@ -734,14 +754,64 @@ class QueryServer:
         complete — but every surface now reports lifecycle=draining."""
         self.drain_started.set()
 
+    def artifact_key(self) -> dict:
+        """The AOT artifact store key for THIS binding — every field
+        that changes which executables serve: toolchain identity (jax
+        version/backend/device count, added by ``aot.store_key``), the
+        resolved serving placement (mode, mesh shape, lane count), the
+        bound tables' rank + ACTUAL quantization (the parity probe may
+        have fallen back to f32 — the requested knob is not the truth),
+        and the batching envelope. ``ptpu build`` and deploy both
+        derive the key through here, so any drift resolves to a
+        different artifact directory and deploy falls back to
+        compiling (docs/cold-start.md)."""
+        from .. import aot
+
+        with self._lock:
+            models = list(self.models)
+            lanes = len(self.lane_models)
+        ranks, quants = [], []
+        for m in models:
+            itf = getattr(m, "item_factors", None)
+            if itf is None:
+                continue
+            data = getattr(itf, "data", itf)
+            shape = getattr(data, "shape", None)
+            if shape is not None and len(shape) == 2:
+                ranks.append(int(shape[-1]))
+            quants.append(str(getattr(itf, "quant", "off")))
+        mesh = getattr(self, "serving_mesh", None)
+        return aot.store_key(
+            serving_mode=str(getattr(self, "serving_mode_resolved",
+                                     self.config.serving_mode)),
+            mesh_shape=(tuple(int(s) for s in mesh.devices.shape)
+                        if mesh is not None else None),
+            lanes=lanes,
+            rank=tuple(ranks),
+            quant=tuple(quants),
+            topk=str(self.config.serving_topk),
+            max_batch=int(self.config.max_batch),
+            batching=bool(self.config.batching or lanes),
+        )
+
     def _warm_serving(self, gen: int) -> None:
-        """Pre-compile the serving path's device shapes (single query +
-        the batcher's pow2 ladder) so first traffic never pays a
-        compile. Algorithms opt in by implementing
+        """Warm the serving path's device shapes (single query + the
+        batcher's pow2 ladder) so first traffic never pays a compile.
+        Algorithms opt in by implementing
         ``warm_serving(model, max_batch)``; failures only log — a cold
         cache is slow, not broken. ``gen`` guards against a stale
         deploy-time thread flipping ``warm_done`` while a post-reload
-        re-warm (newer generation) is still compiling new shapes."""
+        re-warm (newer generation) is still compiling new shapes.
+
+        With ``config.artifact_dir`` set this is artifact-load-then-
+        verify (ISSUE 19): the AOT store built by ``ptpu build`` is
+        opened and activated, the same ladder then ANSWERS from
+        deserialized executables (milliseconds) instead of compiling,
+        and executing every entry on real zeros is the verification.
+        Any mismatch — stale key, missing build, corrupt entry — falls
+        back to compiling that entry exactly as before."""
+        from .. import aot
+
         with self._lock:
             # snapshot: a concurrent reload/promote must not swap the
             # lists out from under the zip mid-warm
@@ -749,10 +819,28 @@ class QueryServer:
             lane_models = list(self.lane_models)
         max_b = self.config.max_batch \
             if (self.config.batching or lane_models) else 1
-        # every lane warms its own copy: executables compile PER DEVICE,
-        # so warming lane 0 alone leaves lanes 1..N-1 paying cold
-        # compiles on first fan-out
-        for models_i in (lane_models or [models]):
+        aot.reset_stats()
+        t0 = time.perf_counter()
+        store = None
+        if self.config.artifact_dir:
+            try:
+                store = aot.ArtifactStore.open(self.config.artifact_dir,
+                                               self.artifact_key())
+            except Exception as e:  # noqa: BLE001 — artifacts optional
+                log.warning("artifact store open failed: %s — "
+                            "compiling", e)
+            aot.activate(store)
+            if store is not None:
+                log.info("serving artifacts: %d entries under %s",
+                         len(store), store.path)
+            else:
+                log.warning(
+                    "no matching serving artifacts under %s (stale key "
+                    "or missing build) — falling back to compile",
+                    self.config.artifact_dir)
+        t_open = time.perf_counter() - t0
+
+        def _walk(models_i) -> None:
             for algo, model in zip(algorithms, models_i):
                 warm = getattr(algo, "warm_serving", None)
                 if warm is None:
@@ -762,11 +850,64 @@ class QueryServer:
                 except Exception as e:  # noqa: BLE001 — warm the rest
                     log.warning("serving warmup failed for %s: %s",
                                 type(algo).__name__, e)
+
+        # every lane warms its own copy: executables compile (or load)
+        # PER DEVICE, so warming lane 0 alone leaves lanes 1..N-1
+        # paying cold compiles on first fan-out. Lane 0 accounts to
+        # the "compile" phase, the rest to "replicate"; artifact
+        # deserialize time is subtracted into "load" where it belongs.
+        all_lanes = lane_models or [models]
+        t1 = time.perf_counter()
+        _walk(all_lanes[0])
+        first_walk = time.perf_counter() - t1
+        first_load = aot.stats()["load_seconds"]
+        t2 = time.perf_counter()
+        for models_i in all_lanes[1:]:
+            _walk(models_i)
+        repl_walk = time.perf_counter() - t2
+        # probe: re-run the lane-0 ladder against the now-warm caches —
+        # every shape must answer without a compile; this is the
+        # "verify" half of artifact-load-then-verify. Compile warms
+        # skip it: the compile itself proved every shape, and algo
+        # ``warm_serving`` hooks keep their one-run-per-warm contract
+        # (the reload-race tests count on it)
+        t3 = time.perf_counter()
+        if store is not None:
+            _walk(all_lanes[0])
+        t_probe = time.perf_counter() - t3
+        s = aot.stats()
+        phases = {
+            "load": t_open + s["load_seconds"],
+            "compile": max(first_walk - first_load, 0.0),
+            "replicate": max(repl_walk
+                             - (s["load_seconds"] - first_load), 0.0),
+            "probe": t_probe,
+        }
+        for phase, sec in phases.items():
+            self._warmup_seconds.labels(phase=phase).observe(sec)
+        report = {
+            # an ARTIFACT warm: a store was bound and every ladder
+            # entry answered from it (zero compile fallbacks)
+            "artifact": bool(store is not None and s["loaded_entries"]
+                             and not s["compiled_calls"]),
+            "store": store.path if store is not None else None,
+            "storeEntries": len(store) if store is not None else 0,
+            "loadedEntries": int(s["loaded_entries"]),
+            "compiledFallbacks": int(s["compiled_calls"]),
+            "corruptEntries": int(s["corrupt_entries"]),
+            "staleStores": int(s["stale"]),
+            "seconds": {k: round(v, 4) for k, v in phases.items()},
+            "totalSeconds": round(sum(phases.values()), 4),
+        }
+        if report["artifact"]:
+            log.info("serving warm from artifact in %.2fs (%d entries)",
+                     report["totalSeconds"], report["loadedEntries"])
         # check+set under the lock: unsynchronized, a stale thread could
         # pass the gen check, lose the CPU to reload()'s clear+increment,
         # then set() — reporting warm while the re-warm still compiles
         with self._lock:
             if gen == self._warm_gen:
+                self._warm_report = report
                 self.warm_done.set()
                 self.recompile_sentinel.arm()
 
@@ -2638,6 +2779,11 @@ def build_app(server: QueryServer) -> HTTPApp:
             "avgServingSec": server.avg_serving_sec,
             "lastServingSec": server.last_serving_sec,
             "servingWarm": server.warm_done.is_set(),
+            # True when THIS warm answered every ladder entry from the
+            # AOT artifact store (ISSUE 19) — the lifecycle warm gate
+            # logs artifact-vs-compile spin-ups off this flag
+            "artifactWarm": bool(server._warm_report.get("artifact")),
+            "warmReport": server._warm_report,
             "lifecycle": server.lifecycle,
             "transferGuard": cfg.transfer_guard or "off",
             "transferGuardViolations": TransferGuardCounter.total(),
@@ -3567,3 +3713,54 @@ def deploy(ctx: Context, engine: Engine, engine_params: EngineParams,
     except Exception as e:  # noqa: BLE001 — history is best-effort
         log.error("release history write failed on deploy: %s", e)
     return create_engine_server(server, host, port, ssl_context=ssl_context)
+
+
+def build_artifacts(ctx: Context, engine: Engine,
+                    engine_params: EngineParams, artifact_dir: str,
+                    engine_id: str = "default",
+                    engine_version: str = "1",
+                    engine_variant: str = "engine.json",
+                    config: Optional[ServerConfig] = None) -> dict:
+    """The ``ptpu build --aot`` flow (ISSUE 19, docs/cold-start.md):
+    bind the latest COMPLETED instance exactly as deploy would —
+    same quantize/prepare/placement — then drive the serving warm
+    ladder with AOT capture active, so every executable deploy will
+    need lands serialized in ``artifact_dir`` under the store key a
+    matching deploy derives. Deploys that pass the same dir warm by
+    loading instead of compiling.
+
+    ``config`` must match the eventual deploy on the key-bearing
+    serving knobs (mode/quant/topk/batching/max_batch); observability
+    side-cars are forced off here — they never affect the artifacts.
+    """
+    from dataclasses import replace
+
+    from .. import aot
+    from ..workflow import core as wf
+
+    config = replace(config or ServerConfig(),
+                     warm_start=False, streaming=False, feedback=False,
+                     tracing=False, slo_interval_ms=0.0, hot_keys_k=0,
+                     faults=None, artifact_dir=None)
+    instance = ctx.storage.engine_instances().get_latest_completed(
+        engine_id, engine_version, engine_variant)
+    if instance is None:
+        raise RuntimeError(
+            f"No COMPLETED engine instance for {engine_id} "
+            f"{engine_version} {engine_variant}; run train first.")
+    models = wf.load_models_for_deploy(ctx, engine, instance,
+                                       engine_params)
+    server = QueryServer(ctx, engine, engine_params, models, instance,
+                         config)
+    try:
+        key = server.artifact_key()
+        store = aot.ArtifactStore(artifact_dir, key)
+        t0 = time.perf_counter()
+        with aot.capture_into(store):
+            server._warm_serving(server._warm_gen)
+        seconds = time.perf_counter() - t0
+        path = store.flush()
+        return {"path": path, "entries": len(store), "key": key,
+                "seconds": seconds, "instance": instance.id}
+    finally:
+        server.stop_slo()

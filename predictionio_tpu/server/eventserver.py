@@ -300,6 +300,55 @@ def build_app(storage: Optional[Storage] = None, *, stats: bool = False,
             _publish_batch(auth.app_id, accepted)
         return json_response(results)
 
+    @app.route("POST", "/columnar/events.npz")
+    def post_columnar(req: Request) -> Response:
+        """Zero-copy block ingest: the body is one npz-encoded
+        ``ColumnarBatch`` (the same wire format the storage server's
+        bulk read serves). No per-event JSON parse, no per-event
+        ``Event`` objects: the backend's ``insert_columnar`` lane
+        writes the block in a single transaction and the invalidation
+        bus gets ONE coalesced publish of the block's unique
+        ``(entityType, entityId, event)`` triples. Per-event niceties
+        (input plugins, trace stamping, stats bookkeeping, per-event
+        ids in the response) deliberately don't apply — this is the
+        firehose lane; use ``/batch/events.json`` when you need them."""
+        import numpy as np
+
+        from ..data.storage.wire import batch_from_npz
+
+        auth = _auth(req)
+        try:
+            batch = batch_from_npz(req.body)
+        except Exception as e:
+            raise HTTPError(400, f"bad columnar block: {e}")
+        if auth.events:
+            names = [batch.dicts.event_names.values[int(c)]
+                     for c in np.unique(batch.event)]
+            bad = [nm for nm in names if not _allowed(auth, nm)]
+            if bad:
+                return json_response(
+                    {"message": f"{bad[0]} events are not allowed"}, 403)
+        n = st.events().insert_columnar(batch, auth.app_id,
+                                        auth.channel_id)
+        ingested.labels(route="columnar").inc(n)
+        if n:
+            try:
+                d = batch.dicts
+                uniq = np.unique(np.stack(
+                    [batch.entity_type, batch.entity_id, batch.event],
+                    axis=1), axis=0)
+                inval_bus.publish_many(auth.app_id, [
+                    (d.entity_types.values[int(a)],
+                     d.entity_ids.values[int(b)],
+                     d.event_names.values[int(c)])
+                    for a, b, c in uniq])
+                invalidations_pub.inc(n)
+            except Exception as e:  # noqa: BLE001
+                log.error("invalidation publish failed: %s", e)
+        if collector:
+            collector.bookkeeping_bulk(auth.app_id, 201, batch)
+        return json_response({"accepted": int(n)}, 201)
+
     @app.route("GET", "/stats.json")
     def get_stats(req: Request) -> Response:
         auth = _auth(req)

@@ -62,6 +62,11 @@ class EventCursor:
         #: ids of consumed events whose event_time == position (the
         #: tie-break set; stays tiny — ms-resolution timestamps)
         self.seen: List[str] = []
+        #: block-mode row watermark: how many NON-cursor storage-order
+        #: rows this consumer has already taken (see
+        #: :meth:`pending_block`); independent of the event-wise
+        #: time position — a consumer uses one mode or the other
+        self.block_rows = 0
         self.consumed_total = 0
         self.saves = 0
         self.load()
@@ -86,11 +91,12 @@ class EventCursor:
                 float(props["positionMillis"]) / 1000.0, tz=timezone.utc)
             self.seen = [str(s) for s in
                          (props.get("seen", default=None) or [])]
+            self.block_rows = int(props.get("blockRows", default=0))
             self.consumed_total = int(props.get("consumed", default=0))
         except (KeyError, TypeError, ValueError) as e:
             log.error("corrupt stream cursor %s: %s; restarting from "
                       "log start", self.cursor_event_id, e)
-            self.position, self.seen = _EPOCH, []
+            self.position, self.seen, self.block_rows = _EPOCH, [], 0
             return False
         return True
 
@@ -106,6 +112,7 @@ class EventCursor:
                   properties=DataMap(
                       {"positionMillis": to_millis(self.position),
                        "seen": list(self.seen),
+                       "blockRows": self.block_rows,
                        "consumed": self.consumed_total}),
                   event_time=_EPOCH,
                   event_id=self.cursor_event_id),
@@ -148,6 +155,51 @@ class EventCursor:
         return len(self.pending(event_names=event_names,
                                 entity_type=entity_type, limit=cap))
 
+    # -- block reads --------------------------------------------------------
+    def pending_block(self, float_props: Sequence[str] = ("rating",),
+                      with_props: bool = False):
+        """Block-granularity consumption (the columnar-ingest
+        counterpart of :meth:`pending`): the whole unconsumed suffix as
+        one zero-copy :class:`~..data.columnar.ColumnarBatch` — no
+        per-event ``Event`` objects on the hot fold-in path.
+
+        Position is a ROW WATERMARK counted over NON-cursor rows of the
+        backend's storage-order projection (``ordered=False``): the
+        cursor record itself is an ``INSERT OR REPLACE`` upsert whose
+        row can churn position on every save, so it is masked out
+        BEFORE the watermark is applied — its movement can never shift
+        which event rows are "new". On an append-only log in storage
+        order (SQLite's ``seq``), each row is returned exactly once
+        regardless of event timestamps; backends whose bulk projection
+        is time-ordered inherit the same append-order bound as
+        :meth:`pending` (docs/streaming.md).
+
+        Consume, then ``advance_block(batch.n)`` + :meth:`save`."""
+        import numpy as np
+
+        full = self.storage.events().find_columnar(
+            self.app_id, self.channel_id, EventFilter(),
+            float_props=tuple(float_props), ordered=False,
+            with_props=with_props)
+        code = full.dicts.entity_types.index.get(CURSOR_ENTITY_TYPE)
+        if code is None:
+            idx = np.arange(full.n)
+        else:
+            idx = np.flatnonzero(full.entity_type != code)
+        if self.block_rows > len(idx):
+            # deletes/compaction shrank the log under the watermark —
+            # clamp; the dropped suffix is covered by the next retrain
+            log.warning("block cursor %s: watermark %d > %d log rows; "
+                        "clamping", self.consumer, self.block_rows,
+                        len(idx))
+            self.block_rows = len(idx)
+        return full.take(idx[self.block_rows:], with_props=with_props)
+
+    def advance_block(self, n_rows: int) -> None:
+        """Move the row watermark past ``n_rows`` consumed block rows."""
+        self.block_rows += int(n_rows)
+        self.consumed_total += int(n_rows)
+
     # -- writes -------------------------------------------------------------
     def advance(self, events: Sequence[Event]) -> None:
         """Move past ``events`` (consumed, oldest-first). Events at a
@@ -173,6 +225,7 @@ class EventCursor:
             "position": (None if self.position == _EPOCH
                          else self.position.isoformat()),
             "seenAtPosition": len(self.seen),
+            "blockRows": self.block_rows,
             "consumed": self.consumed_total,
             "saves": self.saves,
         }

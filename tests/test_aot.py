@@ -1,0 +1,251 @@
+"""AOT compile-artifact tests (ISSUE 19, docs/cold-start.md).
+
+Build captures the serving warm ladder's executables into a versioned
+artifact store; deploy warms by load-and-verify with a compile
+fallback. These tests hold the contract on CPU: bitwise result parity
+between artifact-loaded and freshly-compiled executables, a stale
+store key falling back to compile (never wrong results), and corrupt
+artifact files degrading to compile — never a crash.
+
+CPU caveat: tiny models serve from host numpy (``HOST_SERVE_WORK``
+budget) and never touch device executables, so every test forces the
+device path — exactly what ``benchmarks/coldstart_smoke.py`` does.
+"""
+
+import os
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+import predictionio_tpu.models.als as als
+from predictionio_tpu import aot
+from predictionio_tpu.controller import Context
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.server.engineserver import (
+    QueryServer,
+    ServerConfig,
+    build_artifacts,
+)
+from predictionio_tpu.templates.recommendation import (
+    default_engine_params,
+    recommendation_engine,
+)
+from predictionio_tpu.workflow import core as wf
+from predictionio_tpu.workflow import run_train
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture(autouse=True)
+def _force_device_serving():
+    """Device-path serving + clean process-global AOT state per test."""
+    prev = als.HOST_SERVE_WORK
+    als.HOST_SERVE_WORK = 0
+    aot.deactivate()
+    aot.reset_stats()
+    try:
+        yield
+    finally:
+        als.HOST_SERVE_WORK = prev
+        aot.deactivate()
+
+
+@pytest.fixture(scope="module")
+def trained_ctx():
+    storage = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.apps().insert(App(0, "aotapp"))
+    es = storage.events()
+    es.init(app_id)
+    rng = np.random.default_rng(11)
+    events, t = [], T0
+    for u in range(24):
+        for i in rng.choice(18, size=6, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                event_time=t))
+            t += timedelta(seconds=30)
+    es.insert_batch(events, app_id)
+    ctx = Context(app_name="aotapp", _storage=storage)
+    engine = recommendation_engine()
+    ep = default_engine_params("aotapp", rank=4, num_iterations=4, seed=5)
+    run_train(ctx, engine, ep, engine_id="aot", engine_version="1")
+    return ctx, engine, ep
+
+
+def _config(**kw) -> ServerConfig:
+    base = dict(warm_start=False, streaming=False, feedback=False,
+                tracing=False, slo_interval_ms=0.0, hot_keys_k=0)
+    base.update(kw)
+    return ServerConfig(**base)
+
+
+def _server(trained_ctx, **cfg) -> QueryServer:
+    ctx, engine, ep = trained_ctx
+    instance = ctx.storage.engine_instances().get_latest_completed(
+        "aot", "1", "engine.json")
+    models = wf.load_models_for_deploy(ctx, engine, instance, ep)
+    return QueryServer(ctx, engine, ep, models, instance, _config(**cfg))
+
+
+def _warm(server: QueryServer) -> dict:
+    try:
+        server._warm_serving(server._warm_gen)
+    finally:
+        server.stop_slo()
+    assert server.warm_done.is_set()
+    return server._warm_report
+
+
+def _recs(trained_ctx, k: int = 5):
+    """Serving results straight through the dispatch seam."""
+    ctx, engine, ep = trained_ctx
+    instance = ctx.storage.engine_instances().get_latest_completed(
+        "aot", "1", "engine.json")
+    model = wf.load_models_for_deploy(ctx, engine, instance, ep)[0]
+    single = als.recommend_products(model, 0, k)
+    batch = als.recommend_batch(model, np.arange(4), k)
+    return [np.asarray(x) for x in (*single, *batch)]
+
+
+def _same(a, b) -> bool:
+    return len(a) == len(b) and all(
+        np.array_equal(x, y) for x, y in zip(a, b))
+
+
+class TestArtifactRoundTrip:
+    def test_build_captures_entries(self, trained_ctx, tmp_path):
+        ctx, engine, ep = trained_ctx
+        out = build_artifacts(ctx, engine, ep, str(tmp_path / "art"),
+                              engine_id="aot", config=_config())
+        assert out["entries"] > 0
+        assert os.path.isfile(os.path.join(out["path"], "manifest.json"))
+        # capture is loss-free: every captured entry made it to disk
+        assert aot.stats()["captured_entries"] == out["entries"]
+        assert aot.stats()["capture_errors"] == 0
+
+    def test_artifact_warm_bitwise_parity(self, trained_ctx, tmp_path):
+        ctx, engine, ep = trained_ctx
+        root = str(tmp_path / "art")
+        build_artifacts(ctx, engine, ep, root, engine_id="aot",
+                        config=_config())
+        aot.deactivate()
+        aot.reset_stats()
+
+        server = _server(trained_ctx, artifact_dir=root)
+        report = _warm(server)
+        assert report["artifact"] is True
+        assert report["loadedEntries"] > 0
+        assert report["compiledFallbacks"] == 0
+        assert report["corruptEntries"] == 0
+        # results with the loaded executables...
+        art = _recs(trained_ctx)
+        # ...bitwise equal to a freshly compiled run
+        aot.deactivate()
+        cold = _recs(trained_ctx)
+        assert _same(art, cold)
+        # phase decomposition: an artifact warm reports load time and
+        # all four phases are present on the report
+        assert set(report["seconds"]) == {"load", "compile",
+                                          "replicate", "probe"}
+
+    def test_status_flag_reflects_artifact_warm(self, trained_ctx,
+                                                tmp_path):
+        ctx, engine, ep = trained_ctx
+        root = str(tmp_path / "art")
+        build_artifacts(ctx, engine, ep, root, engine_id="aot",
+                        config=_config())
+        aot.deactivate()
+        server = _server(trained_ctx, artifact_dir=root)
+        report = _warm(server)
+        assert bool(report.get("artifact")) is True
+        # the /status.json route renders exactly this flag
+        assert bool(server._warm_report.get("artifact")) is True
+
+
+class TestFallbacks:
+    def test_stale_key_compiles_and_serves(self, trained_ctx, tmp_path):
+        ctx, engine, ep = trained_ctx
+        root = str(tmp_path / "art")
+        build_artifacts(ctx, engine, ep, root, engine_id="aot",
+                        config=_config(max_batch=16))
+        aot.deactivate()
+        aot.reset_stats()
+        # deploy under a DIFFERENT key (max_batch changes the store key)
+        server = _server(trained_ctx, artifact_dir=root, max_batch=32)
+        report = _warm(server)
+        assert report["artifact"] is False
+        assert report["staleStores"] >= 1
+        assert report["loadedEntries"] == 0
+        # ...but warm-up completed and serving works
+        got = _recs(trained_ctx)
+        assert got[0].shape[-1] == 5
+
+    def test_missing_store_is_a_cold_warm(self, trained_ctx, tmp_path):
+        server = _server(trained_ctx,
+                         artifact_dir=str(tmp_path / "nothing-here"))
+        report = _warm(server)
+        assert report["artifact"] is False
+        assert report["staleStores"] >= 1
+
+    def test_corrupt_artifact_falls_back_bitwise_safe(self, trained_ctx,
+                                                      tmp_path):
+        ctx, engine, ep = trained_ctx
+        root = str(tmp_path / "art")
+        out = build_artifacts(ctx, engine, ep, root, engine_id="aot",
+                              config=_config())
+        aot.deactivate()
+        # flip bytes inside one serialized executable
+        execs = [f for f in os.listdir(out["path"])
+                 if f.endswith(".exec")]
+        victim = os.path.join(out["path"], sorted(execs)[0])
+        blob = bytearray(open(victim, "rb").read())
+        blob[10] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(bytes(blob))
+
+        aot.reset_stats()
+        server = _server(trained_ctx, artifact_dir=root)
+        report = _warm(server)
+        assert report["corruptEntries"] >= 1
+        assert report["compiledFallbacks"] >= 1
+        assert report["artifact"] is False  # not a pure artifact warm
+        got = _recs(trained_ctx)
+        aot.deactivate()
+        cold = _recs(trained_ctx)
+        assert _same(got, cold)
+
+
+class TestAotUnit:
+    def test_dispatch_passthrough_without_stores(self):
+        calls = []
+
+        def fn(x, *, k):
+            calls.append((x, k))
+            return x * k
+
+        assert aot.dispatch("t", fn, (3,), {"k": 2}) == 6
+        assert calls == [(3, 2)]
+        assert aot.stats()["loaded_calls"] == 0
+
+    def test_store_key_is_deterministic_and_sensitive(self):
+        a = aot.store_key(serving_mode="auto", rank=(4,))
+        b = aot.store_key(serving_mode="auto", rank=(4,))
+        c = aot.store_key(serving_mode="auto", rank=(8,))
+        assert aot.key_digest(a) == aot.key_digest(b)
+        assert aot.key_digest(a) != aot.key_digest(c)
+        # environment facts ride in every key
+        assert "jax" in a and "backend" in a
+
+    def test_entry_key_separates_statics_and_shapes(self):
+        x4 = np.zeros(4, np.float32)
+        x8 = np.zeros(8, np.float32)
+        k1 = aot.entry_key("serve", (x4,), {"k": 5})
+        k2 = aot.entry_key("serve", (x4,), {"k": 10})
+        k3 = aot.entry_key("serve", (x8,), {"k": 5})
+        k4 = aot.entry_key("serve", (x4,), {"k": 5}, key_extra=("m",))
+        assert len({k1, k2, k3, k4}) == 4
+        assert k1 == aot.entry_key("serve", (x4,), {"k": 5})

@@ -1,0 +1,374 @@
+"""Zero-copy columnar ingest + block cursor tests (ISSUE 19).
+
+The write half of the columnar data plane: block inserts landing
+bitwise-equivalent rows to the per-event path across backends, the
+two HTTP ingest routes (event server firehose, storage server block
+lane), the chained content stamp that makes ETag revalidation
+O(delta), block-granularity exactly-once consumption, and the
+multi-segment contiguous read path (docs/streaming.md).
+"""
+
+import json
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.columnar import (
+    batch_digest,
+    columnar_from_events,
+)
+from predictionio_tpu.data.storage import App, EventFilter, Storage
+from predictionio_tpu.data.storage.base import AccessKey
+from predictionio_tpu.data.storage.sqlite import SQLiteEventStore
+from predictionio_tpu.data.storage.wire import batch_from_npz, batch_to_npz
+from predictionio_tpu.streaming.cursor import EventCursor
+
+T0 = datetime(2026, 3, 1, tzinfo=timezone.utc)
+
+
+def make_events(n=20, seed=0, start=T0):
+    rng = np.random.default_rng(seed)
+    out, t = [], start
+    for k in range(n):
+        out.append(Event(
+            event="rate" if k % 3 else "buy", entity_type="user",
+            entity_id=f"u{rng.integers(0, 8)}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.integers(0, 6)}",
+            properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            event_time=t))
+        t += timedelta(seconds=7)
+    return out
+
+
+def proj(e: Event):
+    return (e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, json.dumps(dict(e.properties),
+                                           sort_keys=True),
+            e.event_time_millis)
+
+
+@pytest.fixture
+def sq(tmp_path):
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    app_id = storage.apps().insert(App(0, "ingapp"))
+    storage.events().init(app_id)
+    return storage, app_id
+
+
+class TestInsertColumnar:
+    def test_sqlite_block_matches_event_path(self, sq):
+        storage, app_id = sq
+        events = make_events(25, seed=1)
+        block = batch_from_npz(batch_to_npz(columnar_from_events(events)))
+        n = storage.events().insert_columnar(block, app_id)
+        assert n == 25
+        got = sorted(proj(e) for e in storage.events().find(app_id))
+        want = sorted(proj(e) for e in events)
+        assert got == want
+        # block rows get server-assigned ids, all distinct
+        ids = [e.event_id for e in storage.events().find(app_id)]
+        assert len(set(ids)) == 25
+
+    def test_memory_backend_default_fallback(self):
+        # backends without a block lane inherit the base to_events
+        # fallback — same rows, same count
+        st = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+        app_id = st.apps().insert(App(0, "memapp"))
+        st.events().init(app_id)
+        events = make_events(10, seed=2)
+        n = st.events().insert_columnar(
+            columnar_from_events(events), app_id)
+        assert n == 10
+        got = sorted(proj(e) for e in st.events().find(app_id))
+        assert got == sorted(proj(e) for e in events)
+
+    def test_empty_block_is_a_noop(self, sq):
+        storage, app_id = sq
+        assert storage.events().insert_columnar(
+            columnar_from_events([]), app_id) == 0
+        assert list(storage.events().find(app_id)) == []
+
+
+class TestContentStamp:
+    def test_stamp_present_stable_and_moving(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(make_events(12, seed=3), app_id)
+        b1 = es.find_columnar(app_id, ordered=False)
+        s1 = getattr(b1, "content_stamp", None)
+        assert s1  # sqlite maintains the chained stamp at append
+        # stable across re-reads and across projections
+        b2 = es.find_columnar(app_id, ordered=False, with_props=False)
+        assert getattr(b2, "content_stamp", None) == s1
+        # append → the chain moves
+        es.insert_batch(make_events(3, seed=4,
+                                    start=T0 + timedelta(days=1)), app_id)
+        b3 = es.find_columnar(app_id, ordered=False)
+        assert getattr(b3, "content_stamp", None) != s1
+
+    def test_batch_version_fast_path(self):
+        from predictionio_tpu.server.storageserver import _batch_version
+        b = columnar_from_events(make_events(5, seed=1))
+        b.content_stamp = "a" * 32
+        # bare stamp without a request identity; folded with one
+        assert _batch_version(b) == "a" * 32
+        v_full = _batch_version(b, memo_key=(1, None, True, (), None))
+        v_shard = _batch_version(b, memo_key=(1, None, True, (), (0, 2)))
+        assert v_full != v_shard  # distinct ETag per projection
+        assert v_full == _batch_version(
+            b, memo_key=(1, None, True, (), None))  # deterministic
+        b.content_stamp = "b" * 32  # log moved → every view's moves
+        assert _batch_version(
+            b, memo_key=(1, None, True, (), None)) != v_full
+
+    def test_batch_digest_sensitivity(self):
+        a = columnar_from_events(make_events(8, seed=5))
+        b = columnar_from_events(make_events(8, seed=5))
+        c = columnar_from_events(make_events(8, seed=6))
+        assert batch_digest(a) == batch_digest(b)
+        assert batch_digest(a) != batch_digest(c)
+
+
+class TestStorageServerBlockLane:
+    @pytest.fixture
+    def served(self, tmp_path):
+        from conftest import start_sqlite_backed_storage_server
+        srv, backing = start_sqlite_backed_storage_server(
+            tmp_path, secret="s3cret")
+        app_id = backing.apps().insert(App(0, "blkapp"))
+        backing.events().init(app_id)
+        yield srv, backing, app_id
+        srv.shutdown()
+
+    @staticmethod
+    def raw(srv, method, path, body=None, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", data=body,
+            method=method,
+            headers={"X-PIO-Storage-Secret": "s3cret", **(headers or {})})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    def test_post_block_then_read_back(self, served):
+        srv, backing, app_id = served
+        events = make_events(30, seed=7)
+        payload = batch_to_npz(columnar_from_events(events))
+        status, _, body = self.raw(
+            srv, "POST", f"/v1/events/{app_id}/columnar", payload,
+            {"Content-Type": "application/octet-stream"})
+        assert status == 200
+        assert json.loads(body)["accepted"] == 30
+        status, hdrs, body = self.raw(
+            srv, "GET", f"/v1/events/{app_id}/columnar")
+        assert status == 200
+        got = batch_from_npz(body)
+        assert sorted(proj(e) for e in got.to_events()) == \
+            sorted(proj(e) for e in events)
+        assert hdrs.get("ETag")
+
+    def test_etag_304_via_chained_stamp(self, served):
+        srv, backing, app_id = served
+        self.raw(srv, "POST", f"/v1/events/{app_id}/columnar",
+                 batch_to_npz(columnar_from_events(make_events(9, seed=8))),
+                 {"Content-Type": "application/octet-stream"})
+        _, hdrs, _ = self.raw(srv, "GET",
+                              f"/v1/events/{app_id}/columnar")
+        etag = hdrs["ETag"]
+        # the served ETag derives from the sidecar's chained stamp (no
+        # serve-time re-hash) and is stable across identical reads...
+        _, hdrs2, _ = self.raw(srv, "GET",
+                               f"/v1/events/{app_id}/columnar")
+        assert hdrs2["ETag"] == etag
+        # ...but distinct per projection: a shard view must never
+        # alias the full read's ETag through a client cache
+        _, hdrs_shard, _ = self.raw(
+            srv, "GET",
+            f"/v1/events/{app_id}/columnar?shard_i=0&shard_n=2")
+        assert hdrs_shard["ETag"] != etag
+        status, _, body = self.raw(
+            srv, "GET", f"/v1/events/{app_id}/columnar", None,
+            {"If-None-Match": etag})
+        assert status == 304 and body == b""
+        # another block moves the stamp → revalidation misses
+        self.raw(srv, "POST", f"/v1/events/{app_id}/columnar",
+                 batch_to_npz(columnar_from_events(
+                     make_events(2, seed=9,
+                                 start=T0 + timedelta(days=2)))),
+                 {"Content-Type": "application/octet-stream"})
+        status, hdrs, _ = self.raw(
+            srv, "GET", f"/v1/events/{app_id}/columnar", None,
+            {"If-None-Match": etag})
+        assert status == 200 and hdrs["ETag"] != etag
+
+    def test_bad_block_is_400(self, served):
+        srv, _, app_id = served
+        status, _, _ = self.raw(
+            srv, "POST", f"/v1/events/{app_id}/columnar", b"not an npz",
+            {"Content-Type": "application/octet-stream"})
+        assert status == 400
+
+    def test_remote_store_block_ingest(self, served):
+        srv, backing, app_id = served
+        from predictionio_tpu.data.storage.remote import (
+            RemoteClient,
+            RemoteEventStore,
+        )
+        client = RemoteClient(f"http://127.0.0.1:{srv.port}",
+                              secret="s3cret")
+        es = RemoteEventStore(client)
+        events = make_events(14, seed=10)
+        assert es.insert_columnar(
+            columnar_from_events(events), app_id) == 14
+        got = sorted(proj(e) for e in backing.events().find(app_id))
+        assert got == sorted(proj(e) for e in events)
+
+
+class TestEventServerColumnarRoute:
+    @pytest.fixture
+    def server(self):
+        from predictionio_tpu.server.eventserver import create_event_server
+        st = Storage(env={"PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY"})
+        app_id = st.apps().insert(App(id=0, name="fireapp",
+                                      description=None))
+        st.access_keys().insert(AccessKey(key="KEY1", app_id=app_id,
+                                          events=[]))
+        st.access_keys().insert(AccessKey(key="KEYLIMITED", app_id=app_id,
+                                          events=["rate"]))
+        srv = create_event_server(st, host="127.0.0.1", port=0, stats=True)
+        srv.start_background()
+        yield srv, st, app_id
+        srv.shutdown()
+
+    @staticmethod
+    def post(srv, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", data=payload,
+            method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    def test_requires_auth(self, server):
+        srv, _, _ = server
+        payload = batch_to_npz(columnar_from_events(make_events(3)))
+        assert self.post(srv, "/columnar/events.npz", payload)[0] == 401
+        assert self.post(srv, "/columnar/events.npz?accessKey=WRONG",
+                         payload)[0] == 401
+
+    def test_limited_key_rejects_whole_block(self, server):
+        srv, st, app_id = server
+        # the block mixes "rate" and "buy"; KEYLIMITED allows only rate
+        payload = batch_to_npz(columnar_from_events(make_events(6, seed=1)))
+        status, body = self.post(
+            srv, "/columnar/events.npz?accessKey=KEYLIMITED", payload)
+        assert status == 403 and "not allowed" in body["message"]
+        # all-or-nothing: nothing landed
+        assert list(st.events().find(app_id)) == []
+
+    def test_accepts_block_and_counts_stats(self, server):
+        srv, st, app_id = server
+        events = make_events(12, seed=2)
+        status, body = self.post(
+            srv, "/columnar/events.npz?accessKey=KEY1",
+            batch_to_npz(columnar_from_events(events)))
+        assert status == 201 and body["accepted"] == 12
+        got = sorted(proj(e) for e in st.events().find(app_id))
+        assert got == sorted(proj(e) for e in events)
+        # bulk stats bookkeeping counted every row
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats.json?accessKey=KEY1"
+        ) as resp:
+            stats = json.loads(resp.read())
+        assert stats["statusCode"][0] == {"key": 201, "value": 12}
+
+
+class TestBlockCursor:
+    def test_exactly_once_with_restart(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(make_events(20, seed=3), app_id)
+        cur = EventCursor(storage, app_id, "fold")
+        b = cur.pending_block()
+        assert b.n == 20
+        cur.advance_block(b.n)
+        cur.save()
+        assert cur.pending_block().n == 0
+        # append → only the delta; the saved cursor record (an event
+        # row in the same log) must NOT surface as pending
+        es.insert_batch(make_events(5, seed=4,
+                                    start=T0 + timedelta(days=1)), app_id)
+        b2 = cur.pending_block()
+        assert b2.n == 5
+        names = {b2.dicts.entity_types.values[int(c)]
+                 for c in np.unique(b2.entity_type)}
+        assert names == {"user"}
+        cur.advance_block(b2.n)
+        cur.save()
+        # process restart: a fresh cursor resumes the row watermark
+        cur2 = EventCursor(storage, app_id, "fold")
+        assert cur2.block_rows == cur.block_rows
+        assert cur2.pending_block().n == 0
+
+    def test_block_and_save_churn_do_not_interact(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(make_events(8, seed=5), app_id)
+        cur = EventCursor(storage, app_id, "fold")
+        # repeated saves churn the cursor's own upserted row; the
+        # watermark over non-cursor rows must not move
+        for _ in range(4):
+            cur.save()
+        assert cur.pending_block().n == 8
+
+    def test_block_rows_clamped_when_log_shrinks(self, sq):
+        storage, app_id = sq
+        es = storage.events()
+        es.insert_batch(make_events(6, seed=6), app_id)
+        cur = EventCursor(storage, app_id, "fold")
+        cur.advance_block(cur.pending_block().n)
+        cur.block_rows += 100  # simulate a truncated/rebuilt log
+        assert cur.pending_block().n == 0
+        assert cur.block_rows == 6
+
+
+class TestMultiSegmentContiguousLoad:
+    def test_parity_and_contiguity_across_segments(self, sq, monkeypatch):
+        # force several sidecar segments: chunk bounds derive from
+        # ENCODE_SUBCHUNK, segment fill from COLUMNAR_CHUNK — both small
+        monkeypatch.setattr(SQLiteEventStore, "ENCODE_SUBCHUNK", 7)
+        monkeypatch.setattr(SQLiteEventStore, "COLUMNAR_CHUNK", 7)
+        storage, app_id = sq
+        es = storage.events()
+        events = make_events(25, seed=7)
+        es.insert_batch(events, app_id)
+        full = es.find_columnar(app_id, ordered=False)
+        assert full.n == 25
+        # host read-path discipline: one contiguous buffer per column
+        for col in (full.event, full.entity_id, full.event_time,
+                    full.props_offsets, full.props_blob):
+            assert col.flags["C_CONTIGUOUS"]
+        assert sorted(proj(e) for e in full.to_events()) == \
+            sorted(proj(e) for e in events)
+        # float-prop projection decoded across segment boundaries
+        r = full.float_prop("rating")
+        assert r.dtype == np.float64 and len(r) == 25
+        # props-free projection stays valid on the multi-segment path
+        slim = es.find_columnar(app_id, ordered=False, with_props=False)
+        assert slim.n == 25
