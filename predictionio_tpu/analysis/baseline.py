@@ -25,6 +25,7 @@ baselined finding in the same file still fails.
 from __future__ import annotations
 
 import json
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding
@@ -32,6 +33,19 @@ from .core import Finding
 BASELINE_VERSION = 1
 
 Key = Tuple[str, str, str]
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Temp-file + fsync + rename: the PR 11 durability funnel for
+    every committed baseline/manifest this package writes. A crash at
+    any instant leaves either the old file or the new one — never a
+    torn half-write that the next CI run reads as garbage."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def _key(f: Finding) -> Key:
@@ -62,9 +76,8 @@ def write_baseline(path: str, findings: Sequence[Finding],
             {"path": p, "rule": r, "message": m, "count": c}
             for (p, r, m), c in sorted(counts.items())],
     }
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh, indent=2, sort_keys=True)
-        fh.write("\n")
+    atomic_write_text(
+        path, json.dumps(doc, indent=2, sort_keys=True) + "\n")
     return len(counts)
 
 

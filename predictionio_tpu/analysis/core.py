@@ -440,11 +440,12 @@ class CallSite:
 #: effect summary slots propagated through the call graph; each maps
 #: to the rule whose `# ptpu: allow[...]` pragma at the DIRECT site
 #: stops propagation (blessing the helper blesses its callers)
-EFFECTS = ("host_sync", "blocking", "callback")
+EFFECTS = ("host_sync", "blocking", "callback", "net_wait")
 EFFECT_RULE = {
     "host_sync": "host-sync-in-hot-path",
     "blocking": "blocking-under-lock",
     "callback": "callback-under-lock",
+    "net_wait": "missing-timeout",
 }
 
 
@@ -602,6 +603,7 @@ class ProjectIndex:
         # level, so the detector tables are pulled in at call time
         from .concurrency import blocking_reason, lock_expr_name
         from .concurrency import CALLBACK_ATTRS
+        from .lifecycle import net_wait_reason
         from .rules import GATHER_CALLS, host_sync_reason
 
         mod = fn.mod
@@ -655,6 +657,10 @@ class ProjectIndex:
             why = blocking_reason(mod, node)
             if why is not None:
                 witness("blocking", node, why)
+            # timeout-less network wait (missing-timeout)
+            why = net_wait_reason(mod, node)
+            if why is not None:
+                witness("net_wait", node, why)
             # delivery-style callback
             if isinstance(node.func, ast.Attribute) \
                     and node.func.attr in CALLBACK_ATTRS:

@@ -497,9 +497,10 @@ def write_manifest(path: str, manifest: dict,
         doc = {"version": MANIFEST_VERSION,
                "devices": manifest.get("devices", AUDIT_DEVICE_COUNT),
                "entries": entries}
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh, indent=2, sort_keys=True)
-        fh.write("\n")
+    from .baseline import atomic_write_text
+
+    atomic_write_text(
+        path, json.dumps(doc, indent=2, sort_keys=True) + "\n")
 
 
 def diff_manifests(current: dict, baseline: dict

@@ -956,6 +956,13 @@ from .kernels import (  # noqa: E402 — registry assembly
     rule_missing_interpret_fallback,
     rule_vmem_overbudget,
 )
+from .lifecycle import (  # noqa: E402 — registry assembly
+    rule_hot_spin_loop,
+    rule_leaked_thread,
+    rule_missing_timeout,
+    rule_non_atomic_persist,
+    rule_unbounded_queue,
+)
 from .metrics_catalog import (  # noqa: E402 — registry assembly
     rule_metric_catalog_drift,
 )
@@ -1092,4 +1099,32 @@ RULES: Dict[str, Rule] = {r.name: r for r in (
          "docs/observability.md catalog, or documented but never "
          "emitted (both directions)",
          rule_metric_catalog_drift, project=True),
+    Rule("leaked-thread",
+         "threading.Thread with a looping target started in server/, "
+         "fleet/, router/, streaming/, or rollout/ code whose handle "
+         "is never joined — in the spawning function, the owning "
+         "class, or through a call-graph join helper",
+         rule_leaked_thread, project=True),
+    Rule("missing-timeout",
+         "urlopen/HTTPConnection/create_connection with no explicit "
+         "timeout reachable from fleet/, router/, data/, or storage/ "
+         "code — directly or through any helper chain (a wedged peer "
+         "freezes the scrape/control tick forever)",
+         rule_missing_timeout, project=True),
+    Rule("non-atomic-persist",
+         "durable state (baselines, gates, registries, artifacts) "
+         "written with a plain open(path, 'w') outside the temp-file+"
+         "fsync+os.replace funnel — a crash mid-write tears the file",
+         rule_non_atomic_persist),
+    Rule("unbounded-queue",
+         "queue.Queue()/collections.deque() constructed without a "
+         "bound on serving/streaming paths — backlog becomes an OOM "
+         "instead of backpressure under overload",
+         rule_unbounded_queue),
+    Rule("hot-spin-loop",
+         "while-True daemon loops in server/, streaming/, fleet/, "
+         "router/, rollout/, or slo/ code with neither a stop-event "
+         "check nor a pacing/blocking call — pins a core and ignores "
+         "shutdown (complements unbounded-retry)",
+         rule_hot_spin_loop),
 )}

@@ -1903,6 +1903,98 @@ def cmd_audit_numerics(args) -> int:
     return 0
 
 
+def cmd_audit_lifecycle(args) -> int:
+    """``ptpu audit-lifecycle`` — boot each subsystem (event / storage
+    / engine servers, stream trainer, fleet aggregator, router
+    autoscaler), drive start→serve→stop cycles, snapshot
+    ``/proc/self`` threads/fds/sockets around them and gate the leak
+    census against the committed golden manifest
+    (``analysis/lifecycle_baseline.json``) with the same ratchet
+    semantics as ``audit-hlo``/``audit-numerics``. The static
+    lifecycle rules catch the leaks the AST can see; this catches the
+    ones only a running process shows. Non-zero exit on any leak above
+    the recorded allowance (see --baseline-grow);
+    docs/static-analysis.md has the triage runbook."""
+    from ..analysis import lifecycle_audit as la
+
+    if args.list_entries:
+        for name, (_b, desc) in la.ENTRY_POINTS.items():
+            _out(f"{name}: {desc}")
+        return 0
+    try:
+        manifest = la.run_audit(args.entry or None, cycles=args.cycles)
+    except la.AuditError as e:
+        _err(f"ptpu audit-lifecycle: {e}")
+        return 2
+    baseline_path = args.baseline or la.DEFAULT_BASELINE
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    if args.write_baseline:
+        cap = None
+        if not args.baseline_grow and os.path.exists(baseline_path):
+            try:
+                cap = la.load_manifest(baseline_path)
+            except (OSError, ValueError) as e:
+                _err(f"ptpu audit-lifecycle: cannot read baseline: {e}")
+                return 2
+        la.write_manifest(baseline_path, manifest, cap=cap)
+        _err(f"ptpu audit-lifecycle: wrote "
+             f"{len(manifest['entries'])} entry point(s) to "
+             f"{baseline_path}"
+             f"{' (ratchet: shrink-only)' if cap is not None else ''}.")
+        if cap is not None:
+            violations, _ = la.diff_manifests(manifest, cap)
+            if violations:
+                _err(f"ptpu audit-lifecycle: {len(violations)} "
+                     f"leak(s) were NOT absorbed (the baseline only "
+                     f"ratchets down; fix them or re-record "
+                     f"deliberately with --baseline-grow):")
+                for v in violations:
+                    _err(f"  {v}")
+                return 1
+        return 0
+    if args.format == "json":
+        _out(json.dumps(manifest, indent=2, sort_keys=True))
+    else:
+        _out(la.format_text(manifest))
+    if not os.path.exists(baseline_path):
+        _err(f"ptpu audit-lifecycle: no baseline at {baseline_path} — "
+             f"record one with --write-baseline (gate skipped).")
+        return 0
+    try:
+        baseline = la.load_manifest(baseline_path)
+    except (OSError, ValueError) as e:
+        _err(f"ptpu audit-lifecycle: cannot read baseline: {e}")
+        return 2
+    if args.entry:
+        # a subset run gates only the audited entries — the others
+        # were not cycled, not "no longer reproduced"
+        keep = set(args.entry)
+        baseline = {**baseline,
+                    "entries": {k: v
+                                for k, v in baseline["entries"].items()
+                                if k in keep}}
+    violations, shrinkable = la.diff_manifests(manifest, baseline)
+    if shrinkable:
+        _err(f"ptpu audit-lifecycle: {len(shrinkable)} baseline entr"
+             f"{'y is' if len(shrinkable) == 1 else 'ies are'} no "
+             f"longer fully reproduced — ratchet down with "
+             f"--write-baseline:")
+        for s in shrinkable:
+            _err(f"  {s}")
+    if violations:
+        _err(f"ptpu audit-lifecycle: {len(violations)} resource "
+             f"leak(s) vs {baseline_path}:")
+        for v in violations:
+            _err(f"  {v}")
+        return 1
+    _err("ptpu audit-lifecycle: every start->stop cycle released its "
+         "threads, fds and sockets.")
+    return 0
+
+
 def cmd_template(args, storage: Storage) -> int:
     _out("Bundled engine templates (predictionio_tpu.templates):")
     _out("  recommendation  — ALS top-N (module: "
@@ -2602,6 +2694,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "casts/entries (deliberate precision changes) "
                         "instead of the shrink-only ratchet")
 
+    s = sub.add_parser("audit-lifecycle", help="boot each subsystem, "
+                       "drive start->serve->stop cycles, snapshot "
+                       "/proc threads/fds/sockets around them and "
+                       "gate the leak census against the committed "
+                       "golden manifest (the runtime complement of "
+                       "the ptpu check lifecycle rules)")
+    s.add_argument("--entry", action="append", default=[],
+                   help="audit only the named entry point (repeatable)")
+    s.add_argument("--list-entries", action="store_true",
+                   help="print the entry-point catalogue and exit")
+    s.add_argument("--cycles", type=int, default=3,
+                   help="measured start->stop cycles per entry "
+                        "(default 3; one extra warmup cycle always "
+                        "runs unmeasured)")
+    s.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format for the fresh manifest")
+    s.add_argument("--out", default="",
+                   help="also write the fresh manifest JSON to FILE "
+                        "(the CI artifact)")
+    s.add_argument("--baseline", default="",
+                   help="golden manifest to gate against (default: the "
+                        "committed analysis/lifecycle_baseline.json)")
+    s.add_argument("--write-baseline", action="store_true",
+                   help="record the fresh manifest as the baseline; "
+                        "against an existing one this only RATCHETS "
+                        "(shrinks the allowed leaks) and fails on "
+                        "growth")
+    s.add_argument("--baseline-grow", action="store_true",
+                   help="with --write-baseline: allow recording new "
+                        "entries / larger allowances (deliberate "
+                        "daemon changes) instead of the shrink-only "
+                        "ratchet")
+
     sub.add_parser("template", help="list bundled engine templates")
     sub.add_parser("shell", help="interactive shell with storage preloaded")
     s = sub.add_parser("run", help="run module.path:callable with storage "
@@ -2667,6 +2792,12 @@ def main(argv: Optional[List[str]] = None,
 
         ensure_cpu_devices()
         return cmd_audit_numerics(args)
+    if args.command == "audit-lifecycle":
+        # boots real (loopback) servers; the engine entries train and
+        # serve a tiny model — pin host devices before the first jax
+        # import so the audit never waits on an accelerator runtime
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return cmd_audit_lifecycle(args)
     if args.command in ("train", "eval", "deploy", "batchpredict",
                         "run", "shell", "status"):
         # device-using commands share one persistent XLA program cache

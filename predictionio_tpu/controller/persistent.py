@@ -79,8 +79,12 @@ class LocalFileSystemPersistentModel(PersistentModel):
         # attributes — that's where the device arrays live
         clone = copy.copy(self)
         clone.__dict__ = {k: to_host(v) for k, v in self.__dict__.items()}
-        with open(path, "wb") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
             pickle.dump(clone, f, protocol=4)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return True
 
     @classmethod

@@ -107,10 +107,16 @@ class RolloutController:
         return self
 
     def stop(self) -> None:
-        """Stop the loop without touching bindings (server shutdown)."""
+        """Stop the loop without touching bindings (server shutdown).
+        Joins the gate thread so a stop→start cycle never leaves a
+        stale evaluator ticking (guarded: ``_tick`` outcomes may call
+        ``stop`` from the gate thread itself)."""
         with self._lock:
             self.active = False
         self._stop.set()
+        t = self._thread
+        if t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=5)
 
     def _run(self) -> None:
         while not self._stop.wait(self.policy.window_sec):

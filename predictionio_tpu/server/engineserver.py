@@ -735,6 +735,21 @@ class QueryServer:
         if self.slo is not None:
             self.slo.stop()
 
+    def close(self, timeout: float = 5.0) -> None:
+        """Release every background worker this server owns — rollout
+        gate, stream trainer, SLO evaluator, batcher drainers /
+        pipeline stages, sniffer pump — so a deploy→shutdown cycle
+        leaks no threads (``ptpu audit-lifecycle`` gates this).
+        Idempotent. Direct ``query()`` calls still work after close;
+        batched submits do not — close after the listener is down."""
+        if self.rollout is not None:
+            self.rollout.stop()
+        self.stop_stream()
+        self.stop_slo()
+        if self.batcher is not None:
+            self.batcher.close(timeout=timeout)
+        self.plugins.close()
+
     # -- lifecycle advertisement (ISSUE 18) ----------------------------------
     @property
     def lifecycle(self) -> str:
@@ -3070,6 +3085,9 @@ def build_app(server: QueryServer) -> HTTPApp:
             # `undeploy` reports failure for a stop that worked)
             time.sleep(0.25)
             app_server_ref[0].shutdown()
+            # listener down → no new submits; drain the batcher /
+            # pipeline workers and the sniffer pump
+            server.close()
 
         threading.Thread(target=delayed_shutdown, daemon=True).start()
         return json_response({"message": "Shutting down..."})
@@ -3166,6 +3184,12 @@ class _Submit:
         self.abandoned = False
 
 
+#: close sentinel for the batcher worker queues: each worker consumes
+#: exactly one and exits; ``_form_batch`` re-queues any it pulls on a
+#: sibling's behalf (see ``MicroBatcher.close`` / ``StagedPipeline.close``)
+_CLOSE = object()
+
+
 def _deadline_submit(batcher, server: QueryServer, query_json: Any,
                      obs: Optional[dict]) -> Any:
     """Shared submit with the per-query deadline (ISSUE 9 satellite):
@@ -3221,7 +3245,7 @@ def _form_batch(q, first: _Submit, max_batch: int,
     waited = False
     while len(batch) < max_batch:
         try:
-            admit(q.get_nowait())
+            nxt = q.get_nowait()
         except queue.Empty:
             if waited or len(batch) > 1 or window <= 0:
                 break
@@ -3230,9 +3254,15 @@ def _form_batch(q, first: _Submit, max_batch: int,
             # serves solo with bounded latency
             waited = True
             try:
-                admit(q.get(timeout=window))
+                nxt = q.get(timeout=window)
             except queue.Empty:
                 break
+        if nxt is _CLOSE:
+            # a close sentinel meant for a sibling drainer — put it
+            # back for that thread and stop batching
+            q.put(nxt)
+            break
+        admit(nxt)
     return batch
 
 
@@ -3263,6 +3293,10 @@ class MicroBatcher:
         self.max_batch = max(max_batch, 1)
         self.lanes = max(lanes, 1)
         self.deadline_sec = max(deadline_ms, 0.0) / 1000.0
+        # ptpu: allow[unbounded-queue] — every entry has an HTTP worker
+        # thread blocked on its done-Event, so depth is bounded by the
+        # server's connection concurrency; past the queue deadline,
+        # _deadline_submit sheds with a counted 503
         self._q: "queue.Queue" = queue.Queue()
         self._threads = [
             threading.Thread(target=self._drain, daemon=True,
@@ -3276,9 +3310,24 @@ class MicroBatcher:
     def submit(self, query_json: Any, obs: Optional[dict] = None) -> Any:
         return _deadline_submit(self, self.server, query_json, obs)
 
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the drainer threads: one close sentinel per live
+        drainer (each consumes exactly one and exits; ``_form_batch``
+        re-queues any it pulls on a sibling's behalf), then join.
+        Queued work ahead of the sentinels still serves — no caller
+        blocked on its done-Event is stranded. Idempotent."""
+        live = [t for t in self._threads if t.is_alive()]
+        for _ in live:
+            self._q.put(_CLOSE)
+        deadline = time.monotonic() + timeout
+        for t in live:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
     def _drain(self, lane: Optional[int] = None) -> None:
         while True:
             first = self._q.get()
+            if first is _CLOSE:
+                return
             # queue depth at pickup: how much backlog this batch found —
             # the arrival-rate × service-time signal the round-4
             # unbounded-backlog pathology would have shown immediately
@@ -3419,6 +3468,10 @@ class StagedPipeline:
             except Exception:  # noqa: BLE001 — no backend: middle road
                 depth = 2
         self.depth = depth
+        # ptpu: allow[unbounded-queue] — every entry has an HTTP worker
+        # thread blocked on its done-Event, so depth is bounded by the
+        # server's connection concurrency; past the queue deadline,
+        # _deadline_submit sheds with a counted 503
         self._q: "queue.Queue" = queue.Queue()
         self._dispatch_q: "queue.Queue" = queue.Queue(
             maxsize=depth * self.lanes)
@@ -3435,16 +3488,20 @@ class StagedPipeline:
         # mean occupancy 1.7 vs the serial drainer's 4.8 at the same
         # load — and device efficiency scales with occupancy).
         self._inflight = threading.BoundedSemaphore(depth * self.lanes)
-        self._threads: List[threading.Thread] = []
+        # per-stage rosters so close() can stop the stages in pipeline
+        # order (assemble first, readback last)
+        self._assemble_threads: List[threading.Thread] = []
+        self._dispatch_threads: List[threading.Thread] = []
+        self._readback_threads: List[threading.Thread] = []
         for i in range(max(assemble_workers, 1)):
-            self._threads.append(threading.Thread(
+            self._assemble_threads.append(threading.Thread(
                 target=self._assemble_loop, daemon=True,
                 name=f"pipeline-assemble-{i}"))
         if self.lanes > 1:
             # replicated fan-out: ONE dispatcher per lane — a lane's
             # launches stay ordered on its own device
             for lane in range(self.lanes):
-                self._threads.append(threading.Thread(
+                self._dispatch_threads.append(threading.Thread(
                     target=self._dispatch_loop, daemon=True,
                     args=(lane,), name=f"pipeline-dispatch-{lane}"))
         else:
@@ -3456,15 +3513,36 @@ class StagedPipeline:
             # tunnels) this matches the serial drainer's in-flight
             # concurrency instead of regressing below it.
             for i in range(max(dispatch_workers, 1)):
-                self._threads.append(threading.Thread(
+                self._dispatch_threads.append(threading.Thread(
                     target=self._dispatch_loop, daemon=True,
                     args=(None,), name=f"pipeline-dispatch-{i}"))
         for i in range(max(readback_workers, 1)):
-            self._threads.append(threading.Thread(
+            self._readback_threads.append(threading.Thread(
                 target=self._readback_loop, daemon=True,
                 name=f"pipeline-readback-{i}"))
+        self._threads: List[threading.Thread] = (
+            self._assemble_threads + self._dispatch_threads
+            + self._readback_threads)
         for t in self._threads:
             t.start()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain and stop the pipeline stage by stage, upstream first:
+        assemble workers get their sentinels and join (nothing new
+        enters the pipeline), then dispatch, then readback. Joining a
+        stage before signalling the next guarantees a sentinel never
+        overtakes an in-flight batch — every real batch still resolves
+        and wakes its caller before the stage serving it exits.
+        Idempotent."""
+        deadline = time.monotonic() + timeout
+        for q, roster in ((self._q, self._assemble_threads),
+                          (self._dispatch_q, self._dispatch_threads),
+                          (self._readback_q, self._readback_threads)):
+            live = [t for t in roster if t.is_alive()]
+            for _ in live:
+                q.put(_CLOSE)
+            for t in live:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def submit(self, query_json: Any, obs: Optional[dict] = None) -> Any:
         return _deadline_submit(self, self.server, query_json, obs)
@@ -3480,6 +3558,8 @@ class StagedPipeline:
             handed_off = False
             try:
                 first = self._q.get()
+                if first is _CLOSE:
+                    return  # the finally releases our in-flight slot
                 depth = self._q.qsize() + 1
                 server._queue_depth.observe(depth)
                 server._pipeline_qdepth.labels(
@@ -3565,6 +3645,8 @@ class StagedPipeline:
         server = self.server
         while True:
             ab = self._dispatch_q.get()
+            if ab is _CLOSE:
+                return
             server._pipeline_qdepth.labels(queue="dispatch").observe(
                 self._dispatch_q.qsize() + 1)
             if lane is not None and ab.lane_models:
@@ -3629,6 +3711,8 @@ class StagedPipeline:
         server = self.server
         while True:
             ab = self._readback_q.get()
+            if ab is _CLOSE:
+                return
             server._pipeline_qdepth.labels(queue="readback").observe(
                 self._readback_q.qsize() + 1)
             t0 = time.monotonic()

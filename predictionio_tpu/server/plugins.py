@@ -55,21 +55,35 @@ class EngineServerPlugin(abc.ABC):
 
 
 class _SnifferPump:
-    """Async fan-out to sniffers (the reference's plugin actors)."""
+    """Async fan-out to sniffers (the reference's plugin actors).
 
-    def __init__(self):
-        self._q: "queue.Queue" = queue.Queue()
+    Sniffers observe; they must never apply backpressure to the ingest
+    or serve path — so the queue is bounded and overload DROPS the
+    oldest-unserved observation (counted) instead of growing without
+    limit or blocking the caller. ``close()`` drains to a sentinel and
+    joins the pump thread, so a server stop→start cycle leaks nothing."""
+
+    _STOP = object()
+
+    def __init__(self, maxsize: int = 1024):
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.dropped = 0
 
     def _ensure(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(target=self._run, daemon=True,
-                                            name="plugin-sniffers")
-            self._thread.start()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="plugin-sniffers")
+                self._thread.start()
 
     def _run(self) -> None:
         while True:
             fn = self._q.get()
+            if fn is self._STOP:
+                return
             try:
                 fn()
             except Exception:
@@ -77,7 +91,22 @@ class _SnifferPump:
 
     def submit(self, fn) -> None:
         self._ensure()
-        self._q.put(fn)
+        try:
+            self._q.put_nowait(fn)
+        except queue.Full:
+            # observers lose a sample under overload; the hot path
+            # never blocks on them
+            self.dropped += 1
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the pump thread after the queued work drains."""
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is None or not t.is_alive():
+            return
+        self._q.put(self._STOP)
+        t.join(timeout=timeout)
 
 
 class EventServerPlugins:
@@ -107,6 +136,9 @@ class EventServerPlugins:
         return {"inputblockers": one(self.input_blockers),
                 "inputsniffers": one(self.input_sniffers)}
 
+    def close(self) -> None:
+        self._pump.close()
+
 
 class EngineServerPlugins:
     def __init__(self):
@@ -133,6 +165,9 @@ class EngineServerPlugins:
                     for name, p in plugins.items()}
         return {"outputblockers": one(self.output_blockers),
                 "outputsniffers": one(self.output_sniffers)}
+
+    def close(self) -> None:
+        self._pump.close()
 
 
 def resolve_plugin(registry_map, ptype: str, pname: str, rest: str):

@@ -14,6 +14,7 @@ check`` baseline and ``audit-hlo`` ratchets).
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Tuple
 
 #: gate key → (capacity-model key, direction). ``min``: measured must
@@ -130,10 +131,15 @@ def ratchet_gates(capacity: Dict[str, Any], gates: Dict[str, Any],
 
 def write_gates(path: str, gates: Dict[str, Any]) -> None:
     """Rewrite only the ``capacity`` section of a committed spec file,
-    preserving the specs untouched."""
+    preserving the specs untouched. Temp+fsync+rename: a crash
+    mid-ratchet must leave the committed gates readable, not torn."""
     with open(path, encoding="utf-8") as f:
         doc = json.load(f)
     doc["capacity"] = gates
-    with open(path, "w", encoding="utf-8") as f:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2, sort_keys=True)
         f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -613,7 +613,9 @@ class TestRepoWide:
             "unsharded-capture", "missing-donation-sharded",
             "low-precision-reduction", "dequant-outside-funnel",
             "quantize-without-parity-gate", "unguarded-domain",
-            "requant-torn-pair", "metric-catalog-drift"}
+            "requant-torn-pair", "metric-catalog-drift",
+            "leaked-thread", "missing-timeout", "non-atomic-persist",
+            "unbounded-queue", "hot-spin-loop"}
 
     def test_kernel_files_clean_under_kernel_rules(self):
         # the acceptance bar: the real Pallas kernels pass the rules
@@ -2889,3 +2891,548 @@ class TestShardingPragmaCensus:
         assert all(rule in SHARDING_RULES for rule in counts)
         assert all(isinstance(n, int) and n > 0
                    for n in counts.values())
+
+
+# ---------------------------------------------------------------------------
+# the resource-lifecycle family (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+SRV = "predictionio_tpu/server/svc.py"     # thread/queue/spin scopes
+FLEET = "predictionio_tpu/fleet/scrape.py"  # net-timeout scope
+SLO = "predictionio_tpu/slo/persist.py"     # durable-state scope
+
+
+class TestLeakedThread:
+    def test_positive_looping_daemon_never_joined(self):
+        code = src("""
+            import threading
+            import time
+
+            class Poller:
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+
+                def stop(self):
+                    pass
+
+                def _run(self):
+                    while True:
+                        time.sleep(0.1)
+        """)
+        findings = check_source(code, path=SRV)
+        assert rules_of(findings) == ["leaked-thread"]
+        assert "_run" in findings[0].message
+        assert "join" in findings[0].message
+
+    def test_positive_stop_event_loop_without_join(self):
+        # signalling the event without joining still abandons the
+        # thread mid-iteration — the join half is required too
+        code = src("""
+            import threading
+
+            class Poller:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+
+                def close(self):
+                    self._stop.set()
+
+                def _run(self):
+                    while not self._stop.is_set():
+                        self._stop.wait(0.1)
+        """)
+        findings = check_source(code, path=SRV)
+        assert rules_of(findings) == ["leaked-thread"]
+
+    def test_negative_joined_in_close(self):
+        code = src("""
+            import threading
+
+            class Poller:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+
+                def close(self):
+                    self._stop.set()
+                    self._t.join()
+
+                def _run(self):
+                    while not self._stop.is_set():
+                        self._stop.wait(0.1)
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_one_shot_target(self):
+        # a warmup thread ends on its own: no loop, no finding
+        code = src("""
+            import threading
+
+            class Server:
+                def start(self):
+                    threading.Thread(
+                        target=self._warm, daemon=True).start()
+
+                def _warm(self):
+                    self.model.warm()
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_appended_to_roster_joined_elsewhere(self):
+        # handles stored via self._workers.append and joined through
+        # `for t in self._workers` in another method
+        code = src("""
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._workers = []
+
+                def start(self):
+                    for _ in range(2):
+                        self._workers.append(threading.Thread(
+                            target=self._run, daemon=True))
+                    for t in self._workers:
+                        t.start()
+
+                def close(self):
+                    for t in self._workers:
+                        t.join()
+
+                def _run(self):
+                    while True:
+                        time.sleep(1)
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_handle_returned_to_caller(self):
+        code = src("""
+            import threading
+            import time
+
+            class Spawner:
+                def spawn(self):
+                    t = threading.Thread(
+                        target=self._run, daemon=True)
+                    t.start()
+                    return t
+
+                def _run(self):
+                    while True:
+                        time.sleep(1)
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_joiner_helper_via_call_graph(self):
+        # a helper that joins its parameter blesses the spawner that
+        # hands it the handle
+        findings = check_project({
+            "pkg/server/stop.py": src("""
+                def reap(t, timeout):
+                    t.join(timeout=timeout)
+            """),
+            "pkg/server/spawn.py": src("""
+                import threading
+                import time
+
+                from pkg.server.stop import reap
+
+                class Box:
+                    def run_once(self):
+                        t = threading.Thread(
+                            target=self._run, daemon=True)
+                        t.start()
+                        reap(t, 5.0)
+
+                    def _run(self):
+                        while True:
+                            time.sleep(1)
+            """),
+        })
+        assert findings == []
+
+    def test_negative_outside_scope(self):
+        code = src("""
+            import threading
+            import time
+
+            class Poller:
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    while True:
+                        time.sleep(0.1)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import threading
+            import time
+
+            class Poller:
+                def start(self):
+                    # ptpu: allow[leaked-thread] — process-lifetime
+                    # metrics pump by design
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    while True:
+                        time.sleep(0.1)
+        """)
+        assert check_source(code, path=SRV) == []
+
+
+class TestMissingTimeout:
+    def test_positive_urlopen_no_timeout(self):
+        code = src("""
+            import urllib.request
+
+            def scrape(url):
+                with urllib.request.urlopen(url) as resp:
+                    return resp.read()
+        """)
+        findings = check_source(code, path=FLEET)
+        assert rules_of(findings) == ["missing-timeout"]
+        assert "urlopen" in findings[0].message
+
+    def test_positive_create_connection_no_timeout(self):
+        code = src("""
+            import socket
+
+            def probe(addr):
+                return socket.create_connection(addr)
+        """)
+        findings = check_source(code, path=FLEET)
+        assert rules_of(findings) == ["missing-timeout"]
+
+    def test_positive_http_connection_ctor(self):
+        code = src("""
+            import http.client
+
+            def connect(host):
+                return http.client.HTTPConnection(host)
+        """)
+        findings = check_source(code, path=FLEET)
+        assert rules_of(findings) == ["missing-timeout"]
+
+    def test_negative_timeout_keyword(self):
+        code = src("""
+            import urllib.request
+
+            def scrape(url):
+                with urllib.request.urlopen(url, timeout=5.0) as r:
+                    return r.read()
+        """)
+        assert check_source(code, path=FLEET) == []
+
+    def test_negative_timeout_positional(self):
+        code = src("""
+            import socket
+
+            def probe(addr):
+                return socket.create_connection(addr, 3.0)
+        """)
+        assert check_source(code, path=FLEET) == []
+
+    def test_negative_outside_scope(self):
+        code = src("""
+            import urllib.request
+
+            def fetch(url):
+                return urllib.request.urlopen(url)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_two_hop_chain_reported_at_fleet_site(self):
+        # the hang sits two helpers away; the finding lands at the
+        # in-scope call site with the chain down to the direct call
+        findings = check_project({
+            "pkg/net/raw.py": src("""
+                import urllib.request
+
+                def fetch(url):
+                    return urllib.request.urlopen(url)
+            """),
+            "pkg/lib/client.py": src("""
+                from pkg.net.raw import fetch
+
+                def pull(url):
+                    return fetch(url)
+            """),
+            "pkg/fleet/scrape.py": src("""
+                from pkg.lib.client import pull
+
+                def scrape(url):
+                    return pull(url)
+            """),
+        })
+        assert rules_of(findings) == ["missing-timeout"]
+        f = findings[0]
+        assert f.path == "pkg/fleet/scrape.py"
+        assert "pull" in f.message and "fetch" in f.message
+        assert [p for p, _, _ in f.related] == [
+            "pkg/lib/client.py", "pkg/net/raw.py"]
+
+    def test_pragma_at_direct_site_stops_propagation(self):
+        # blessing the helper blesses its callers: the net_wait
+        # effect dies at the pragma'd direct site
+        findings = check_project({
+            "pkg/net/raw.py": src("""
+                import urllib.request
+
+                def fetch(url):
+                    # ptpu: allow[missing-timeout] — caller sets
+                    # socket.setdefaulttimeout at boot
+                    return urllib.request.urlopen(url)
+            """),
+            "pkg/fleet/scrape.py": src("""
+                from pkg.net.raw import fetch
+
+                def scrape(url):
+                    return fetch(url)
+            """),
+        })
+        assert findings == []
+
+    def test_pragma_suppresses_direct(self):
+        code = src("""
+            import urllib.request
+
+            def scrape(url):
+                # ptpu: allow[missing-timeout] — bounded by the
+                # caller's deadline wrapper
+                return urllib.request.urlopen(url)
+        """)
+        assert check_source(code, path=FLEET) == []
+
+
+class TestNonAtomicPersist:
+    def test_positive_plain_rewrite(self):
+        code = src("""
+            import json
+
+            def save(path, state):
+                with open(path, "w") as fh:
+                    json.dump(state, fh)
+        """)
+        findings = check_source(code, path=SLO)
+        assert rules_of(findings) == ["non-atomic-persist"]
+        assert "os.replace" in findings[0].message
+
+    def test_negative_tmp_plus_replace_funnel(self):
+        code = src("""
+            import json
+            import os
+
+            def save(path, state):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(state, fh)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
+        """)
+        assert check_source(code, path=SLO) == []
+
+    def test_negative_append_only_log(self):
+        # append-only tears at most the trailing record; replay
+        # truncates it — a legitimate durable pattern
+        code = src("""
+            def log_event(path, line):
+                with open(path, "a") as fh:
+                    fh.write(line)
+        """)
+        assert check_source(code, path=SLO) == []
+
+    def test_negative_read_mode(self):
+        code = src("""
+            import json
+
+            def load(path):
+                with open(path, "r") as fh:
+                    return json.load(fh)
+        """)
+        assert check_source(code, path=SLO) == []
+
+    def test_negative_outside_scope(self):
+        code = src("""
+            def save(path, text):
+                with open(path, "w") as fh:
+                    fh.write(text)
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            def save(path, text):
+                # ptpu: allow[non-atomic-persist] — scratch file on
+                # tmpfs, rebuilt from scratch on boot
+                with open(path, "w") as fh:
+                    fh.write(text)
+        """)
+        assert check_source(code, path=SLO) == []
+
+
+class TestUnboundedQueue:
+    def test_positive_queue_and_deque(self):
+        code = src("""
+            import collections
+            import queue
+
+            class Batcher:
+                def __init__(self):
+                    self.q = queue.Queue()
+                    self.window = collections.deque()
+        """)
+        findings = check_source(code, path=SRV)
+        assert rules_of(findings) == ["unbounded-queue"] * 2
+        assert "maxsize" in findings[0].message
+        assert "maxlen" in findings[1].message
+
+    def test_positive_explicit_zero_bound(self):
+        # maxsize=0 means infinite — same finding
+        code = src("""
+            import queue
+
+            class Batcher:
+                def __init__(self):
+                    self.q = queue.Queue(maxsize=0)
+        """)
+        findings = check_source(code, path=SRV)
+        assert rules_of(findings) == ["unbounded-queue"]
+
+    def test_negative_bounded(self):
+        code = src("""
+            import collections
+            import queue
+
+            class Batcher:
+                def __init__(self):
+                    self.q = queue.Queue(maxsize=128)
+                    self.window = collections.deque(maxlen=32)
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_outside_scope(self):
+        code = src("""
+            import queue
+
+            class Batcher:
+                def __init__(self):
+                    self.q = queue.Queue()
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            import queue
+
+            class Batcher:
+                def __init__(self):
+                    # ptpu: allow[unbounded-queue] — depth bounded by
+                    # the HTTP worker pool blocked on done-Events
+                    self.q = queue.Queue()
+        """)
+        assert check_source(code, path=SRV) == []
+
+
+class TestHotSpinLoop:
+    def test_positive_busy_poll(self):
+        code = src("""
+            def pump(q):
+                while True:
+                    if q.empty():
+                        continue
+                    handle(q.get_nowait())
+        """)
+        findings = check_source(code, path=SRV)
+        assert rules_of(findings) == ["hot-spin-loop"]
+        assert "stop-event" in findings[0].message
+
+    def test_positive_itertools_count(self):
+        code = src("""
+            import itertools
+
+            def spin(work):
+                for i in itertools.count():
+                    work(i)
+        """)
+        findings = check_source(code, path=SRV)
+        assert rules_of(findings) == ["hot-spin-loop"]
+
+    def test_negative_blocking_get_paces(self):
+        code = src("""
+            def pump(q):
+                while True:
+                    handle(q.get())
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_sleep_paces(self):
+        code = src("""
+            import time
+
+            def tick(step):
+                while True:
+                    step()
+                    time.sleep(1.0)
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_stop_event_checked(self):
+        code = src("""
+            def run(stop, step):
+                while True:
+                    if stop.is_set():
+                        return
+                    step()
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_generator_is_consumer_paced(self):
+        code = src("""
+            def feed(it):
+                while True:
+                    yield next(it)
+        """)
+        assert check_source(code, path=SRV) == []
+
+    def test_negative_outside_scope(self):
+        code = src("""
+            def pump(q):
+                while True:
+                    if q.empty():
+                        continue
+                    handle(q.get_nowait())
+        """)
+        assert check_source(code, path=COLD) == []
+
+    def test_pragma_suppresses(self):
+        code = src("""
+            def pump(q):
+                # ptpu: allow[hot-spin-loop] — benchmark harness
+                # measuring poll latency on purpose
+                while True:
+                    if q.empty():
+                        continue
+                    handle(q.get_nowait())
+        """)
+        assert check_source(code, path=SRV) == []

@@ -1,0 +1,240 @@
+"""``ptpu audit-lifecycle`` tests (ISSUE 20): /proc snapshot + settle
+semantics, the manifest ratchet (shrink-only writes, violation/
+shrinkable diffs), the CLI contract, and the acceptance fixture — a
+deliberately leaked thread that must fail BOTH the static
+``leaked-thread`` rule and the runtime gate."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.analysis import check_source
+from predictionio_tpu.analysis import lifecycle_audit as la
+from predictionio_tpu.cli import main
+
+
+class TestSnapshot:
+    def test_counts_are_sane(self):
+        snap = la.snapshot()
+        assert set(snap) == set(la.RESOURCES)
+        assert snap["threads"] >= 1
+        assert all(isinstance(v, int) and v >= 0 for v in snap.values())
+
+    def test_spawned_thread_is_visible(self):
+        before = la.snapshot()
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, daemon=True)
+        t.start()
+        try:
+            assert la.snapshot()["threads"] >= before["threads"] + 1
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+    def test_leak_clamps_at_zero(self):
+        before = {"threads": 5, "fds": 10, "sockets": 2}
+        after = {"threads": 7, "fds": 8, "sockets": 2}
+        assert la._leak(before, after) == {
+            "threads": 2, "fds": 0, "sockets": 0}
+
+    def test_settle_absorbs_a_thread_mid_exit(self):
+        # a thread that finishes moments after the cycle is lag, not
+        # a leak — the settle loop waits it out
+        before = la.snapshot()
+        t = threading.Thread(target=lambda: time.sleep(0.2),
+                             daemon=True)
+        t.start()
+        after = la._settle(before, settle_sec=5.0)
+        assert not any(la._leak(before, after).values())
+
+
+class TestManifestRatchet:
+    def _manifest(self, **entries):
+        return {"version": la.MANIFEST_VERSION, "cycles": 3,
+                "entries": {
+                    name: {"threads": rec[0], "fds": rec[1],
+                           "sockets": rec[2]}
+                    for name, rec in entries.items()}}
+
+    def test_write_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        m = self._manifest(a=(0, 0, 0))
+        la.write_manifest(path, m)
+        assert la.load_manifest(path) == m
+
+    def test_load_rejects_wrong_version(self, tmp_path):
+        path = tmp_path / "bad.json"
+        path.write_text(json.dumps({"version": 99, "entries": {}}))
+        with pytest.raises(ValueError):
+            la.load_manifest(str(path))
+
+    def test_capped_write_is_shrink_only(self, tmp_path):
+        # counts clamp to the recorded allowance; entries the old
+        # baseline never held are dropped
+        path = str(tmp_path / "b.json")
+        cap = self._manifest(a=(2, 1, 0))
+        fresh = self._manifest(a=(5, 0, 0), b=(1, 1, 1))
+        la.write_manifest(path, fresh, cap=cap)
+        doc = la.load_manifest(path)
+        assert doc["entries"] == {
+            "a": {"threads": 2, "fds": 0, "sockets": 0}}
+
+    def test_diff_flags_leak_above_allowance(self):
+        cur = self._manifest(a=(3, 0, 0))
+        base = self._manifest(a=(0, 0, 0))
+        violations, shrinkable = la.diff_manifests(cur, base)
+        assert len(violations) == 1
+        assert "a:" in violations[0] and "threads" in violations[0]
+        assert "--baseline-grow" in violations[0]
+        assert shrinkable == []
+
+    def test_diff_flags_unknown_entry(self):
+        cur = self._manifest(new_entry=(0, 0, 0))
+        base = self._manifest()
+        violations, _ = la.diff_manifests(cur, base)
+        assert len(violations) == 1
+        assert "not in the baseline" in violations[0]
+
+    def test_diff_reports_shrinkable(self):
+        cur = self._manifest(a=(0, 0, 0))
+        base = self._manifest(a=(2, 0, 0))
+        violations, shrinkable = la.diff_manifests(cur, base)
+        assert violations == []
+        assert len(shrinkable) == 1 and "recorded 2" in shrinkable[0]
+
+    def test_format_text(self):
+        m = self._manifest(clean=(0, 0, 0), leaky=(2, 0, 1))
+        text = la.format_text(m)
+        assert "clean: clean over 3 cycles" in text
+        assert "leaky: LEAKING over 3 cycles" in text
+        assert "threads +2" in text and "sockets +1" in text
+
+
+class TestRunAudit:
+    def test_injected_clean_entry(self):
+        registry = {"noop": (lambda: (lambda: None), "does nothing")}
+        m = la.run_audit(entry_points=registry, cycles=2,
+                         settle_sec=0.2)
+        assert m["cycles"] == 2
+        assert m["entries"]["noop"] == {
+            "threads": 0, "fds": 0, "sockets": 0}
+
+    def test_unknown_entry_raises(self):
+        with pytest.raises(la.AuditError, match="unknown entry"):
+            la.run_audit(["nope"],
+                         entry_points={"a": (lambda: None, "")})
+
+    def test_broken_builder_is_env_error(self):
+        def boom():
+            raise RuntimeError("no storage")
+
+        with pytest.raises(la.AuditError, match="entry setup failed"):
+            la.run_audit(entry_points={"a": (boom, "")},
+                         settle_sec=0.2)
+
+    def test_committed_baseline_covers_registry(self):
+        # the golden manifest in the tree gates every entry point —
+        # adding an entry without recording it fails the gate in CI
+        doc = la.load_manifest(la.DEFAULT_BASELINE)
+        assert set(doc["entries"]) == set(la.ENTRY_POINTS)
+
+
+#: the acceptance fixture: a scrape daemon whose handle nobody joins.
+#: The SAME source is judged twice — by the static rule (the AST sees
+#: the missing join path) and by the runtime gate (the process shows
+#: one surviving thread per start→stop cycle).
+LEAKY_SRC = '''
+import threading
+import time
+
+
+class LeakyPoller:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        pass  # the bug: no stop event, no join
+
+    def _run(self):
+        while True:
+            time.sleep(0.05)
+'''
+
+
+class TestLeakedFixtureFailsBothGates:
+    def test_static_rule_flags_the_fixture(self):
+        findings = check_source(
+            LEAKY_SRC, path="predictionio_tpu/server/leaky.py")
+        assert [f.rule for f in findings] == ["leaked-thread"]
+
+    def test_runtime_gate_counts_the_leak(self):
+        ns: dict = {}
+        exec(LEAKY_SRC, ns)
+        poller_cls = ns["LeakyPoller"]
+
+        def build():
+            def cycle():
+                p = poller_cls()
+                p.start()
+                p.stop()
+
+            return cycle
+
+        m = la.run_audit(
+            entry_points={"leaky": (build, "leaks 1 thread/cycle")},
+            cycles=3, settle_sec=0.3)
+        assert m["entries"]["leaky"]["threads"] >= 3
+        baseline = {"version": la.MANIFEST_VERSION, "cycles": 3,
+                    "entries": {"leaky": {"threads": 0, "fds": 0,
+                                          "sockets": 0}}}
+        violations, _ = la.diff_manifests(m, baseline)
+        assert any("leaky" in v and "threads" in v
+                   for v in violations)
+
+
+class TestCLI:
+    def test_list_entries(self, capsys):
+        assert main(["audit-lifecycle", "--list-entries"]) == 0
+        out = capsys.readouterr().out
+        for name in la.ENTRY_POINTS:
+            assert name in out
+
+    def test_unknown_entry_is_env_error(self):
+        assert main(["audit-lifecycle", "--entry", "nope"]) == 2
+
+    def test_no_baseline_skips_gate(self, tmp_path, capsys):
+        rc = main(["audit-lifecycle", "--entry", "storage_server",
+                   "--cycles", "1",
+                   "--baseline", str(tmp_path / "none.json")])
+        assert rc == 0
+        assert "gate skipped" in capsys.readouterr().err
+
+    def test_write_then_gate_green(self, tmp_path, capsys):
+        path = str(tmp_path / "b.json")
+        assert main(["audit-lifecycle", "--entry", "storage_server",
+                     "--cycles", "1", "--baseline", path,
+                     "--write-baseline"]) == 0
+        doc = la.load_manifest(path)
+        assert "storage_server" in doc["entries"]
+        rc = main(["audit-lifecycle", "--entry", "storage_server",
+                   "--cycles", "1", "--baseline", path,
+                   "--out", str(tmp_path / "artifact.json")])
+        assert rc == 0
+        assert "released its threads" in capsys.readouterr().err
+        artifact = json.loads(
+            (tmp_path / "artifact.json").read_text())
+        assert artifact["version"] == la.MANIFEST_VERSION
+
+    def test_entry_missing_from_baseline_fails(self, tmp_path,
+                                               capsys):
+        path = tmp_path / "b.json"
+        path.write_text(json.dumps(
+            {"version": la.MANIFEST_VERSION, "cycles": 1,
+             "entries": {}}))
+        rc = main(["audit-lifecycle", "--entry", "storage_server",
+                   "--cycles", "1", "--baseline", str(path)])
+        assert rc == 1
+        assert "not in the baseline" in capsys.readouterr().err
